@@ -1,0 +1,25 @@
+"""distributed_reinforcement_learning_tpu — a TPU-native distributed RL framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+``kiminh/distributed_reinforcement_learning`` (TF1 actor/learner RL):
+
+- Three algorithms: IMPALA (V-trace), Ape-X DQN (prioritized replay),
+  R2D2 (recurrent replay with stored LSTM state + burn-in).
+- N-actor / 1-learner topology, generalized to a multi-chip data-parallel
+  learner over a ``jax.sharding.Mesh``.
+- Host-side data plane (FIFO trajectory queue, prioritized replay,
+  socket transport) replacing TF1's distributed runtime.
+
+Layout (mirrors the layer map in SURVEY.md §1):
+
+- ``ops``      — pure losses/returns: V-trace, double-Q, value rescaling.
+- ``models``   — flax networks: conv-LSTM actor-critic, dueling CNN, recurrent Q.
+- ``agents``   — pure ``init/act/learn`` functions + train states per algorithm.
+- ``envs``     — numpy CartPole (+POMDP), Atari preprocessing, synthetic envs.
+- ``data``     — trajectory structures, FIFO queue, prioritized replay.
+- ``parallel`` — device mesh, sharding rules, multi-chip learn steps.
+- ``runtime``  — actor/learner loops, transport, launchers.
+- ``utils``    — config, checkpointing, metrics, timing.
+"""
+
+__version__ = "0.1.0"

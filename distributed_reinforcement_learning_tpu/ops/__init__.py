@@ -1,0 +1,9 @@
+"""Pure-function losses and return computations (reference layer L3).
+
+Everything here is stateless, jit-safe, and static-shaped: the building
+blocks the agents compose into loss functions.
+"""
+
+from distributed_reinforcement_learning_tpu.ops import dqn, value_rescale, vtrace
+
+__all__ = ["vtrace", "dqn", "value_rescale"]

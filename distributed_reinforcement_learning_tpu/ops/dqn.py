@@ -1,0 +1,45 @@
+"""Q-value selection and double-DQN target construction.
+
+Re-design of `/root/reference/optimizer/dqn.py:3-7` and the inline target
+math of `agent/apex.py:60-69` as pure jit-safe functions. The reference's
+flat-batch (`axis=1`) and sequence-batch (`axis=2`,
+`optimizer/burn_in.py:17-21`) variants collapse into one gather over the
+trailing action axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def take_state_action_value(q_values: jax.Array, actions: jax.Array) -> jax.Array:
+    """Q(s, a) gather over the trailing action axis.
+
+    Works for `[B, A]` and `[B, T, A]` q-values alike (the reference needed
+    two copies: `optimizer/dqn.py:6` axis=1 and `optimizer/burn_in.py:20`
+    axis=2).
+    """
+    taken = jnp.take_along_axis(q_values, actions[..., None].astype(jnp.int32), axis=-1)
+    return taken[..., 0]
+
+
+def double_q_target(
+    next_main_q: jax.Array,
+    next_target_q: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+) -> jax.Array:
+    """Double-DQN target: r + gamma * Q_target(s', argmax_a Q_main(s', a)).
+
+    Parity with `agent/apex.py:60-65`: action selection by the main net,
+    evaluation by the target net, stop-gradiented.
+    """
+    next_action = jnp.argmax(next_main_q, axis=-1)
+    next_value = take_state_action_value(next_target_q, next_action)
+    return jax.lax.stop_gradient(rewards + discounts * next_value)
+
+
+def td_error(target_value: jax.Array, state_action_value: jax.Array) -> jax.Array:
+    """|target - Q(s,a)|, the priority signal (`agent/apex.py:131-133`)."""
+    return jnp.abs(target_value - state_action_value)

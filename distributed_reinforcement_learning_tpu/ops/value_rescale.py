@@ -1,0 +1,22 @@
+"""R2D2 value-function rescaling h(x) and its closed-form inverse.
+
+Parity with `/root/reference/optimizer/burn_in.py:23-32` (R2D2 paper
+table 2 / "Observe and Look Further" Prop. A.2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def value_rescale(x: jax.Array, eps: float = 1e-3) -> jax.Array:
+    """h(x) = sign(x) * (sqrt(|x| + 1) - 1) + eps * x."""
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def inverse_value_rescale(x: jax.Array, eps: float = 1e-3) -> jax.Array:
+    """h^{-1}(x), exact closed form for the eps-regularized rescaling."""
+    return jnp.sign(x) * (
+        jnp.square((jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps)) - 1.0) / (2.0 * eps)) - 1.0
+    )

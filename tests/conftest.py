@@ -1,0 +1,17 @@
+"""Test configuration: run everything on a simulated 8-device CPU mesh.
+
+Must set the XLA flags *before* jax is imported anywhere, so this lives at
+the top of conftest. Multi-chip sharding paths are exercised on virtual CPU
+devices (real TPU pods are not available in CI); the driver separately
+dry-runs `__graft_entry__.dryrun_multichip` the same way.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,44 @@
+"""Tests for Q-selection, double-Q targets, and value rescaling."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.ops import dqn, value_rescale
+
+
+def test_take_state_action_value_flat_and_sequence():
+    q = jnp.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    a = jnp.asarray([2, 0])
+    np.testing.assert_allclose(dqn.take_state_action_value(q, a), [3.0, 4.0])
+
+    q_seq = jnp.arange(12, dtype=jnp.float32).reshape(1, 4, 3)
+    a_seq = jnp.asarray([[0, 1, 2, 1]])
+    np.testing.assert_allclose(
+        dqn.take_state_action_value(q_seq, a_seq), [[0.0, 4.0, 8.0, 10.0]])
+
+
+def test_double_q_target():
+    next_main = jnp.asarray([[1.0, 9.0], [5.0, 2.0]])   # argmax -> [1, 0]
+    next_target = jnp.asarray([[10.0, 20.0], [30.0, 40.0]])
+    rewards = jnp.asarray([1.0, -1.0])
+    discounts = jnp.asarray([0.99, 0.0])
+    got = dqn.double_q_target(next_main, next_target, rewards, discounts)
+    np.testing.assert_allclose(got, [1.0 + 0.99 * 20.0, -1.0], rtol=1e-6)
+
+
+def test_value_rescale_roundtrip():
+    x = jnp.linspace(-100.0, 100.0, 41)
+    rt = value_rescale.inverse_value_rescale(value_rescale.value_rescale(x))
+    np.testing.assert_allclose(rt, x, rtol=1e-4, atol=1e-4)
+
+
+def test_value_rescale_golden():
+    # h(0) = 0, h(1) = sqrt(2) - 1 + eps
+    np.testing.assert_allclose(value_rescale.value_rescale(jnp.asarray(0.0)), 0.0, atol=1e-7)
+    np.testing.assert_allclose(
+        value_rescale.value_rescale(jnp.asarray(1.0)),
+        np.sqrt(2.0) - 1.0 + 1e-3, rtol=1e-6)
+    # Odd function.
+    x = jnp.asarray([3.7])
+    np.testing.assert_allclose(
+        value_rescale.value_rescale(-x), -value_rescale.value_rescale(x), rtol=1e-6)

@@ -1,0 +1,140 @@
+"""V-trace golden tests: lax.scan core vs a slow pure-numpy recursion."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_reinforcement_learning_tpu.ops import vtrace
+
+
+def numpy_vtrace(log_rhos, discounts, rewards, values, bootstrap_value, rho_bar=1.0, c_bar=1.0):
+    """Direct transcription of the V-trace recursion (time-major [T, B])."""
+    T = log_rhos.shape[0]
+    rhos = np.exp(log_rhos)
+    clipped_rhos = np.minimum(rho_bar, rhos)
+    cs = np.minimum(c_bar, rhos)
+    values_t1 = np.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_t1 - values)
+    vs_minus_v = np.zeros_like(values)
+    acc = np.zeros_like(bootstrap_value)
+    for t in reversed(range(T)):
+        acc = deltas[t] + discounts[t] * cs[t] * acc
+        vs_minus_v[t] = acc
+    return vs_minus_v + values, clipped_rhos
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+def test_from_importance_weights_matches_numpy(rng):
+    T, B = 19, 4
+    log_rhos = rng.uniform(-1.5, 1.5, (T, B)).astype(np.float32)
+    discounts = (rng.rand(T, B) > 0.1).astype(np.float32) * 0.99
+    rewards = rng.randn(T, B).astype(np.float32)
+    values = rng.randn(T, B).astype(np.float32)
+    bootstrap = rng.randn(B).astype(np.float32)
+
+    out = vtrace.from_importance_weights(
+        jnp.asarray(log_rhos), jnp.asarray(discounts), jnp.asarray(rewards),
+        jnp.asarray(values), jnp.asarray(bootstrap))
+    want_vs, want_rhos = numpy_vtrace(log_rhos, discounts, rewards, values, bootstrap)
+
+    np.testing.assert_allclose(out.vs, want_vs, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out.clipped_rhos, want_rhos, rtol=1e-6, atol=1e-6)
+
+
+def test_on_policy_reduces_to_n_step_returns(rng):
+    """With rho == 1 everywhere and no dones, vs_t is the discounted n-step return."""
+    T, B = 8, 2
+    gamma = 0.9
+    log_rhos = np.zeros((T, B), np.float32)
+    discounts = np.full((T, B), gamma, np.float32)
+    rewards = rng.randn(T, B).astype(np.float32)
+    values = rng.randn(T, B).astype(np.float32)
+    bootstrap = rng.randn(B).astype(np.float32)
+
+    out = vtrace.from_importance_weights(
+        jnp.asarray(log_rhos), jnp.asarray(discounts), jnp.asarray(rewards),
+        jnp.asarray(values), jnp.asarray(bootstrap))
+
+    # On-policy V-trace with rho_bar=c_bar=1: vs_t = sum_k gamma^k r_{t+k} + gamma^{T-t} * bootstrap
+    returns = np.zeros((T, B), np.float32)
+    acc = bootstrap.copy()
+    for t in reversed(range(T)):
+        acc = rewards[t] + gamma * acc
+        returns[t] = acc
+    np.testing.assert_allclose(out.vs, returns, rtol=1e-4, atol=1e-4)
+
+
+def test_split_data_views():
+    x = jnp.arange(24).reshape(2, 12)
+    first, middle, last = vtrace.split_data(x)
+    np.testing.assert_array_equal(first, x[:, :-2])
+    np.testing.assert_array_equal(middle, x[:, 1:-1])
+    np.testing.assert_array_equal(last, x[:, 2:])
+    assert first.shape == (2, 10)
+
+
+def test_from_softmax_matches_manual_rhos(rng):
+    B, T, A = 3, 10, 5
+    behavior = rng.dirichlet(np.ones(A), (B, T)).astype(np.float32)
+    target = rng.dirichlet(np.ones(A), (B, T)).astype(np.float32)
+    actions = rng.randint(0, A, (B, T))
+    discounts = np.full((B, T), 0.99, np.float32)
+    rewards = rng.randn(B, T).astype(np.float32)
+    values = rng.randn(B, T).astype(np.float32)
+    next_values = rng.randn(B, T).astype(np.float32)
+
+    out = vtrace.from_softmax(
+        jnp.asarray(behavior), jnp.asarray(target), jnp.asarray(actions),
+        jnp.asarray(discounts), jnp.asarray(rewards), jnp.asarray(values),
+        jnp.asarray(next_values))
+
+    taken_t = np.take_along_axis(target, actions[..., None], axis=-1)[..., 0]
+    taken_b = np.take_along_axis(behavior, actions[..., None], axis=-1)[..., 0]
+    log_rhos = np.log(taken_t) - np.log(taken_b)
+    want_vs, want_rhos = numpy_vtrace(
+        log_rhos.T, discounts.T, rewards.T, values.T, next_values[:, -1])
+    np.testing.assert_allclose(out.vs, want_vs.T, rtol=1e-3, atol=5e-4)
+    np.testing.assert_allclose(out.clipped_rhos, want_rhos.T, rtol=1e-3, atol=5e-4)
+
+
+def test_losses_golden():
+    probs = jnp.asarray([[[0.25, 0.75], [0.5, 0.5]]])  # [1, 2, 2]
+    actions = jnp.asarray([[1, 0]])
+    advantages = jnp.asarray([[2.0, -1.0]])
+
+    pg = vtrace.policy_gradient_loss(probs, actions, advantages)
+    want_pg = -(np.log(0.75 + 1e-8) * 2.0 + np.log(0.5 + 1e-8) * -1.0)
+    np.testing.assert_allclose(pg, want_pg, rtol=2e-3)
+
+    vs = jnp.asarray([[1.0, 2.0]])
+    values = jnp.asarray([[0.5, 2.5]])
+    np.testing.assert_allclose(
+        vtrace.baseline_loss(vs, values), 0.5 * (0.25 + 0.25), rtol=1e-6)
+
+    ent = vtrace.entropy_loss(probs)
+    want_ent = (0.25 * np.log(0.25) + 0.75 * np.log(0.75)
+                + 0.5 * np.log(0.5) + 0.5 * np.log(0.5))
+    np.testing.assert_allclose(ent, want_ent, rtol=2e-3)
+
+
+def test_entropy_loss_zero_prob_is_finite():
+    probs = jnp.asarray([[[1.0, 0.0]]])
+    assert np.isfinite(np.asarray(vtrace.entropy_loss(probs)))
+    np.testing.assert_allclose(vtrace.entropy_loss(probs), 0.0, atol=1e-7)
+
+
+def test_vs_has_no_gradient():
+    """vs and rhos are stop-gradiented like the reference's back_prop=False scan."""
+    def f(values):
+        out = vtrace.from_importance_weights(
+            jnp.zeros((4, 1)), jnp.full((4, 1), 0.9), jnp.ones((4, 1)),
+            values, jnp.zeros((1,)))
+        return jnp.sum(out.vs)
+
+    g = jax.grad(f)(jnp.ones((4, 1)))
+    np.testing.assert_allclose(g, np.zeros((4, 1)), atol=1e-7)
