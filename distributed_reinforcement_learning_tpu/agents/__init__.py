@@ -1,0 +1,20 @@
+"""Algorithm agents as pure init/act/learn functions (reference layer L4)."""
+
+from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexBatch, ApexConfig
+from distributed_reinforcement_learning_tpu.agents.common import TargetTrainState, TrainState
+from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaBatch, ImpalaConfig
+from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Batch, R2D2Config
+
+__all__ = [
+    "ApexAgent",
+    "ApexBatch",
+    "ApexConfig",
+    "ImpalaAgent",
+    "ImpalaBatch",
+    "ImpalaConfig",
+    "R2D2Agent",
+    "R2D2Batch",
+    "R2D2Config",
+    "TrainState",
+    "TargetTrainState",
+]

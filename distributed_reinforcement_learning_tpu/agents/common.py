@@ -1,0 +1,155 @@
+"""Shared agent machinery: train states, optimizers, preprocessing.
+
+Replaces the reference's TF1 graph plumbing (`tf.train.get_or_create_global_step`,
+`tf.train.polynomial_decay`, `clip_by_global_norm` + optimizer at
+`agent/impala.py:95-100`, `agent/apex.py:71-76`, `agent/r2d2.py:91-92`)
+with optax transforms composed around jit-compiled pure loss functions.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    """Learner state: params + optimizer state + step counter.
+
+    The reference kept these as TF global variables on the learner device;
+    here it is an explicit pytree that pjit shards/replicates.
+    """
+
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+
+
+@struct.dataclass
+class TargetTrainState:
+    """TrainState plus a target network (Ape-X / R2D2)."""
+
+    params: Any
+    target_params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation) -> "TargetTrainState":
+        return cls(
+            params=params,
+            target_params=jax.tree.map(jnp.copy, params),
+            opt_state=tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def sync_target(self) -> "TargetTrainState":
+        """Copy main -> target, the reference's `main_to_target` grouped assign
+        (`utils.py:23-31`)."""
+        return self.replace(target_params=jax.tree.map(jnp.copy, self.params))
+
+
+def polynomial_lr(start: float, end: float, transition_steps: int) -> optax.Schedule:
+    """Linear (power-1 polynomial) decay, parity with `tf.train.polynomial_decay`
+    as used at `agent/impala.py:96`.
+
+    `transition_steps` is clamped to int32 range: the reference's apex config
+    uses `learning_frame=1e14` (`config.json:102`), which no int32 step counter
+    ever reaches — numerically identical, and keeps optax's schedule arithmetic
+    in-range without enabling x64.
+    """
+    return optax.polynomial_schedule(
+        init_value=start,
+        end_value=end,
+        power=1.0,
+        transition_steps=min(int(transition_steps), 2**31 - 1),
+    )
+
+
+def rmsprop_with_clip(
+    lr: optax.Schedule | float,
+    clip_norm: float,
+    decay: float = 0.99,
+    eps: float = 0.1,
+) -> optax.GradientTransformation:
+    """IMPALA optimizer: global-norm clip -> RMSProp(decay, eps) -> lr.
+
+    Matches `agent/impala.py:95-100`: RMSPropOptimizer(decay=.99, momentum=0,
+    epsilon=.1) on globally-clipped gradients. optax's `scale_by_rms` uses
+    `g * rsqrt(nu + eps)` — the same eps-inside-sqrt convention as TF1 —
+    and `initial_scale=1.0` matches TF1's ones-initialized mean-square slot
+    (optax defaults to 0, which would make the first updates ~3x larger).
+    """
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.scale_by_rms(decay=decay, eps=eps, initial_scale=1.0),
+        optax.scale_by_learning_rate(lr),
+    )
+
+
+def adam_with_clip(lr: optax.Schedule | float, clip_norm: float | None) -> optax.GradientTransformation:
+    """Ape-X optimizer: global-norm clip -> Adam (`agent/apex.py:71-76`).
+
+    Pass `clip_norm=None` for R2D2, whose reference applies plain Adam with
+    no clipping (`agent/r2d2.py:91-92` — config's clip value is unused there).
+    """
+    steps = [optax.scale_by_adam(), optax.scale_by_learning_rate(lr)]
+    if clip_norm is not None:
+        steps.insert(0, optax.clip_by_global_norm(clip_norm))
+    return optax.chain(*steps)
+
+
+def clip_rewards(rewards: jax.Array, mode: str) -> jax.Array:
+    """Reward clipping, parity with `agent/impala.py:45-49` / `agent/apex.py:38-42`.
+
+    - `abs_one`: clip to [-1, 1]
+    - `soft_asymmetric`: 5*tanh(r/5), scaled by 0.3 for negative rewards
+    - `none`: pass through
+    """
+    if mode == "abs_one":
+        return jnp.clip(rewards, -1.0, 1.0)
+    if mode == "soft_asymmetric":
+        squeezed = jnp.tanh(rewards / 5.0)
+        return jnp.where(rewards < 0, 0.3 * squeezed, squeezed) * 5.0
+    if mode == "none":
+        return rewards
+    raise ValueError(f"unknown reward_clipping mode: {mode!r}")
+
+
+def normalize_obs(obs: jax.Array) -> jax.Array:
+    """uint8 frames -> float32 in [0, 1]; float observations pass through.
+
+    The reference normalizes `/255` at every feed (`agent/impala.py:119,133`);
+    keeping frames uint8 until this point minimizes host->HBM bandwidth.
+    """
+    if jnp.issubdtype(obs.dtype, jnp.integer):
+        return obs.astype(jnp.float32) / 255.0
+    return obs.astype(jnp.float32)
+
+
+def global_norm(tree) -> jax.Array:
+    return optax.global_norm(tree)
+
+
+def epsilon_greedy(
+    q_values: jax.Array, epsilon: jax.Array | float, num_actions: int, rng: jax.Array
+) -> jax.Array:
+    """Batched epsilon-greedy action selection over `[N, A]` Q-values.
+
+    Shared by Ape-X (`agent/apex.py:92-107`) and R2D2 (`agent/r2d2.py:166-186`);
+    epsilon enters as data so one compiled act function serves the whole
+    exploration schedule.
+    """
+    greedy = jnp.argmax(q_values, axis=-1)
+    key_e, key_a = jax.random.split(rng)
+    explore = jax.random.uniform(key_e, greedy.shape) <= epsilon
+    random_action = jax.random.randint(key_a, greedy.shape, 0, num_actions)
+    return jnp.where(explore, random_action, greedy)

@@ -1,0 +1,162 @@
+"""IMPALA agent: V-trace actor-critic as pure init/act/learn functions.
+
+Re-design of `/root/reference/agent/impala.py`. The reference's `Agent`
+class builds a TF1 graph with a 1-step inference head plus 3*(T-2)
+replicated training copies; here the same math is two jit-compiled pure
+functions over one flax model:
+
+- `act`: single-step policy/value + LSTM state advance (the actor hot
+  path, `agent/impala.py:118-130`).
+- `learn`: stored-state batched forward over `[B, T]`, double V-trace over
+  the first/middle time views, sum-reduced losses, RMSProp + polynomial
+  LR + global-norm clip (`agent/impala.py:63-100`).
+
+Loss math parity (`agent/impala.py:63-93`):
+    vs, rho     = vtrace(first view; next_values = middle values)
+    vs_plus_1   = vtrace(middle view; next_values = last values)
+    pg_adv      = rho * (r_first + gamma_first * vs_plus_1 - V_first)
+    total = pi_loss + c_v * baseline_loss + c_e * entropy_loss
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.agents import common
+from distributed_reinforcement_learning_tpu.models.impala_net import ImpalaActorCritic, apply_stored_state
+from distributed_reinforcement_learning_tpu.ops import vtrace
+
+
+@dataclasses.dataclass(frozen=True)
+class ImpalaConfig:
+    """Hyperparameters, mirroring the `impala` block of `config.json:25-67`."""
+
+    obs_shape: tuple[int, ...] = (84, 84, 4)
+    num_actions: int = 18
+    trajectory: int = 20
+    lstm_size: int = 256
+    discount_factor: float = 0.99
+    baseline_loss_coef: float = 1.0
+    entropy_coef: float = 0.05
+    gradient_clip_norm: float = 40.0
+    reward_clipping: str = "abs_one"
+    start_learning_rate: float = 6e-4
+    end_learning_rate: float = 0.0
+    learning_frame: int = 1_000_000_000
+    dtype: Any = jnp.float32
+
+
+class ImpalaBatch(NamedTuple):
+    """One learner batch: `[B, T, ...]` unrolls (queue payload, SURVEY §2 row 7)."""
+
+    state: jax.Array  # [B, T, *obs] uint8 (or float for vector envs)
+    reward: jax.Array  # [B, T] f32 raw rewards
+    action: jax.Array  # [B, T] i32
+    done: jax.Array  # [B, T] bool
+    behavior_policy: jax.Array  # [B, T, A] f32 softmax at act time
+    previous_action: jax.Array  # [B, T] i32
+    initial_h: jax.Array  # [B, T, H] actor-recorded per-step LSTM h
+    initial_c: jax.Array  # [B, T, H]
+
+
+class ActOutput(NamedTuple):
+    action: jax.Array
+    policy: jax.Array
+    h: jax.Array
+    c: jax.Array
+
+
+class ImpalaAgent:
+    """Thin wrapper binding config + model to jitted pure functions."""
+
+    def __init__(self, cfg: ImpalaConfig):
+        self.cfg = cfg
+        self.model = ImpalaActorCritic(
+            num_actions=cfg.num_actions, lstm_size=cfg.lstm_size, dtype=cfg.dtype
+        )
+        self._schedule = common.polynomial_lr(
+            cfg.start_learning_rate, cfg.end_learning_rate, cfg.learning_frame
+        )
+        self.tx = common.rmsprop_with_clip(self._schedule, cfg.gradient_clip_norm)
+        self.act = jax.jit(self._act)
+        self.learn = jax.jit(self._learn, donate_argnums=(0,))
+
+    # -- init ------------------------------------------------------------
+    def init_state(self, rng: jax.Array) -> common.TrainState:
+        obs = jnp.zeros((1, *self.cfg.obs_shape), jnp.float32)
+        pa = jnp.zeros((1,), jnp.int32)
+        h = c = jnp.zeros((1, self.cfg.lstm_size), jnp.float32)
+        params = self.model.init(rng, obs, pa, h, c)
+        return common.TrainState.create(params, self.tx)
+
+    def initial_lstm_state(self, batch_size: int) -> tuple[jax.Array, jax.Array]:
+        z = jnp.zeros((batch_size, self.cfg.lstm_size), jnp.float32)
+        return z, z
+
+    # -- act -------------------------------------------------------------
+    def _act(self, params, obs, prev_action, h, c, rng) -> ActOutput:
+        """Batched single-step act: sample from the softmax policy.
+
+        Parity with `agent/impala.py:118-130` (np.random.choice(p=policy) ->
+        jax.random.categorical over log-probabilities), batched over the
+        actor's parallel envs instead of one `sess.run` per env.
+        """
+        out = self.model.apply(params, common.normalize_obs(obs), prev_action, h, c)
+        action = jax.random.categorical(rng, jnp.log(out.policy + 1e-20), axis=-1)
+        return ActOutput(action, out.policy, out.h, out.c)
+
+    # -- learn -----------------------------------------------------------
+    def _loss(self, params, batch: ImpalaBatch):
+        cfg = self.cfg
+        policy, value = apply_stored_state(
+            self.model,
+            params,
+            common.normalize_obs(batch.state),
+            batch.previous_action,
+            batch.initial_h,
+            batch.initial_c,
+        )
+
+        clipped_r = common.clip_rewards(batch.reward, cfg.reward_clipping)
+        discounts = (~batch.done).astype(jnp.float32) * cfg.discount_factor
+
+        first_p, middle_p, _ = vtrace.split_data(policy)
+        first_v, middle_v, last_v = vtrace.split_data(value)
+        first_a, middle_a, _ = vtrace.split_data(batch.action)
+        first_r, middle_r, _ = vtrace.split_data(clipped_r)
+        first_d, middle_d, _ = vtrace.split_data(discounts)
+        first_b, middle_b, _ = vtrace.split_data(batch.behavior_policy)
+
+        vs, rho = vtrace.from_softmax(
+            behavior_policy=first_b, target_policy=first_p, actions=first_a,
+            discounts=first_d, rewards=first_r, values=first_v, next_values=middle_v)
+        vs_plus_1, _ = vtrace.from_softmax(
+            behavior_policy=middle_b, target_policy=middle_p, actions=middle_a,
+            discounts=middle_d, rewards=middle_r, values=middle_v, next_values=last_v)
+
+        pg_adv = jax.lax.stop_gradient(rho * (first_r + first_d * vs_plus_1 - first_v))
+
+        pi_loss = vtrace.policy_gradient_loss(first_p, first_a, pg_adv)
+        v_loss = vtrace.baseline_loss(vs, first_v)
+        ent_loss = vtrace.entropy_loss(first_p)
+        total = pi_loss + cfg.baseline_loss_coef * v_loss + cfg.entropy_coef * ent_loss
+        metrics = {
+            "pi_loss": pi_loss,
+            "baseline_loss": v_loss,
+            "entropy": ent_loss,
+            "total_loss": total,
+        }
+        return total, metrics
+
+    def _learn(self, state: common.TrainState, batch: ImpalaBatch):
+        grads, metrics = jax.grad(self._loss, has_aux=True)(state.params, batch)
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        metrics["grad_norm"] = common.global_norm(grads)
+        metrics["learning_rate"] = self._schedule(state.step)
+        new_state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
+        return new_state, metrics

@@ -1,0 +1,140 @@
+"""R2D2 agent: recurrent Q-learning with stored state, burn-in, rescaling.
+
+Re-design of `/root/reference/agent/r2d2.py` as jitted pure functions.
+Semantics preserved:
+
+- Main and target nets are unrolled over the full sequence from the
+  **sequence-start stored state** h[0], c[0] (`agent/r2d2.py:110-111,135-136`),
+  with done-masked state resets inside the unroll (`model/r2d2_lstm.py:78-80`).
+- Burn-in: the first `burn_in` steps are sliced out of the loss, not the
+  unroll (`agent/r2d2.py:64-68`).
+- Double-Q over sequences + value-function rescaling on the target
+  (`agent/r2d2.py:70-87`): target = h(h^{-1}(Q_target(s', a*)) * gamma + r).
+- Loss: mean over time of squared TD, weighted per-sequence by IS weight
+  (`agent/r2d2.py:88-89`); priority = |mean TD| per sequence
+  (`agent/r2d2.py:151-153`).
+- Optimizer: plain Adam(1e-4), no clipping (`agent/r2d2.py:91-92`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.agents import common
+from distributed_reinforcement_learning_tpu.models.r2d2_net import R2D2Net
+from distributed_reinforcement_learning_tpu.ops import dqn, value_rescale
+
+
+@dataclasses.dataclass(frozen=True)
+class R2D2Config:
+    """Hyperparameters, mirroring the `r2d2` block of `config.json:2-24`."""
+
+    obs_shape: tuple[int, ...] = (2,)
+    num_actions: int = 2
+    seq_len: int = 10
+    burn_in: int = 5
+    lstm_size: int = 512
+    discount_factor: float = 0.997
+    learning_rate: float = 1e-4
+    rescale_eps: float = 1e-3
+    dtype: Any = jnp.float32
+
+
+class R2D2Batch(NamedTuple):
+    """Sequence batch (queue payload of `distributed_queue/buffer_queue.py:7-91`)."""
+
+    state: jax.Array  # [B, T, *obs] (int32-quantized *255 upstream, like the ref)
+    previous_action: jax.Array  # [B, T] i32
+    action: jax.Array  # [B, T] i32
+    reward: jax.Array  # [B, T] f32
+    done: jax.Array  # [B, T] bool
+    initial_h: jax.Array  # [B, H] sequence-start stored h
+    initial_c: jax.Array  # [B, H]
+
+
+class R2D2Agent:
+    def __init__(self, cfg: R2D2Config):
+        self.cfg = cfg
+        self.model = R2D2Net(num_actions=cfg.num_actions, lstm_size=cfg.lstm_size, dtype=cfg.dtype)
+        self.tx = common.adam_with_clip(cfg.learning_rate, clip_norm=None)
+        self.act = jax.jit(self._act)
+        self.td_error = jax.jit(self._td_error)
+        self.learn = jax.jit(self._learn, donate_argnums=(0,))
+        self.sync_target = jax.jit(lambda s: s.sync_target())
+
+    def init_state(self, rng: jax.Array) -> common.TargetTrainState:
+        obs = jnp.zeros((1, *self.cfg.obs_shape), jnp.float32)
+        pa = jnp.zeros((1,), jnp.int32)
+        h = c = jnp.zeros((1, self.cfg.lstm_size), jnp.float32)
+        params = self.model.init(rng, obs, pa, h, c)
+        return common.TargetTrainState.create(params, self.tx)
+
+    def initial_lstm_state(self, batch_size: int) -> tuple[jax.Array, jax.Array]:
+        z = jnp.zeros((batch_size, self.cfg.lstm_size), jnp.float32)
+        return z, z
+
+    # -- act -------------------------------------------------------------
+    def _act(self, params, obs, h, c, prev_action, epsilon, rng):
+        """Batched epsilon-greedy single step (`agent/r2d2.py:166-186`)."""
+        q, new_h, new_c = self.model.apply(params, common.normalize_obs(obs), prev_action, h, c)
+        action = common.epsilon_greedy(q, epsilon, self.cfg.num_actions, rng)
+        return action, q, new_h, new_c
+
+    # -- shared sequence target math -------------------------------------
+    def _sequence_td(self, params, target_params, batch: R2D2Batch):
+        cfg = self.cfg
+        obs = common.normalize_obs(batch.state)
+        unroll = lambda p: self.model.apply(
+            p, obs, batch.previous_action, batch.done, batch.initial_h, batch.initial_c,
+            method=self.model.unroll)
+        main_q = unroll(params)
+        target_q = unroll(target_params)
+
+        discounts = (~batch.done).astype(jnp.float32) * cfg.discount_factor
+
+        # Burn-in slice, then (t, t+1) alignment (`agent/r2d2.py:64-82`).
+        b = cfg.burn_in
+        main_b, target_b = main_q[:, b:], target_q[:, b:]
+        reward_b, disc_b, action_b = batch.reward[:, b:], discounts[:, b:], batch.action[:, b:]
+
+        state_q = main_b[:, :-1]
+        next_main = main_b[:, 1:]
+        next_target = target_b[:, 1:]
+        action = action_b[:, :-1]
+
+        sav = dqn.take_state_action_value(state_q, action)
+        next_action = jnp.argmax(next_main, axis=-1)
+        next_sav = dqn.take_state_action_value(next_target, next_action)
+
+        # Rescaled double-Q target (`agent/r2d2.py:83-87`).
+        descaled = value_rescale.inverse_value_rescale(next_sav, cfg.rescale_eps)
+        raw_target = jax.lax.stop_gradient(descaled * disc_b[:, :-1] + reward_b[:, :-1])
+        target_value = value_rescale.value_rescale(raw_target, cfg.rescale_eps)
+        return target_value, sav
+
+    def _td_error(self, state: common.TargetTrainState, batch: R2D2Batch):
+        """Per-sequence priority |mean_t TD| (`agent/r2d2.py:151-153`)."""
+        tv, sav = self._sequence_td(state.params, state.target_params, batch)
+        return jnp.abs(jnp.mean(tv - sav, axis=1))
+
+    # -- learn -----------------------------------------------------------
+    def _loss(self, params, target_params, batch: R2D2Batch, is_weight):
+        tv, sav = self._sequence_td(params, target_params, batch)
+        per_seq = jnp.mean(jnp.square(tv - sav), axis=1)
+        loss = jnp.mean(per_seq * is_weight)
+        priorities = jnp.abs(jnp.mean(tv - sav, axis=1))
+        return loss, priorities
+
+    def _learn(self, state: common.TargetTrainState, batch: R2D2Batch, is_weight):
+        (loss, priorities), grads = jax.value_and_grad(self._loss, has_aux=True)(
+            state.params, state.target_params, batch, is_weight
+        )
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        new_state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
+        metrics = {"loss": loss, "grad_norm": common.global_norm(grads)}
+        return new_state, priorities, metrics

@@ -1,0 +1,24 @@
+"""Neural networks for the three algorithm families (reference layer L3)."""
+
+from distributed_reinforcement_learning_tpu.models.apex_net import DuelingQNetwork, SimpleQNetwork
+from distributed_reinforcement_learning_tpu.models.impala_net import (
+    ImpalaActorCritic,
+    ImpalaOutput,
+    apply_stored_state,
+)
+from distributed_reinforcement_learning_tpu.models.r2d2_net import R2D2Net
+from distributed_reinforcement_learning_tpu.models.recurrent import LSTMCell
+from distributed_reinforcement_learning_tpu.models.torso import MLP, ActionEmbedding, NatureConv
+
+__all__ = [
+    "DuelingQNetwork",
+    "SimpleQNetwork",
+    "ImpalaActorCritic",
+    "ImpalaOutput",
+    "apply_stored_state",
+    "R2D2Net",
+    "LSTMCell",
+    "MLP",
+    "ActionEmbedding",
+    "NatureConv",
+]

@@ -1,0 +1,80 @@
+"""R2D2 recurrent Q-network with scan-based sequence unroll.
+
+Re-design of `/root/reference/model/r2d2_lstm.py`. The reference unrolls
+main and target networks with Python loops, one full network copy per
+timestep (`model/r2d2_lstm.py:65-112`), zero-resetting (h, c) *after* the
+step whenever done[t] is set. Here the unroll is a `flax.linen.scan`
+(=> one compiled `lax.scan`), same done-masking semantics, seeded from
+the sequence-start stored state like the reference
+(`agent/r2d2.py:110-111`).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.models.recurrent import LSTMCell
+from distributed_reinforcement_learning_tpu.models.torso import ActionEmbedding
+
+_glorot = nn.initializers.xavier_uniform()
+
+
+class R2D2Net(nn.Module):
+    """MLP torso + action embed -> LSTM -> dueling head (value - mean).
+
+    Single-step signature matches `model/r2d2_lstm.py:26-47`: returns
+    (q_value [N, A], h, c).
+    """
+
+    num_actions: int
+    lstm_size: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.state_fc1 = nn.Dense(256, kernel_init=_glorot, dtype=self.dtype)
+        self.state_fc2 = nn.Dense(256, kernel_init=_glorot, dtype=self.dtype)
+        self.action_embed = ActionEmbedding(self.num_actions, dtype=self.dtype)
+        self.cell = LSTMCell(self.lstm_size, dtype=self.dtype)
+        self.head_fc = nn.Dense(128, kernel_init=_glorot, dtype=self.dtype)
+        self.value = nn.Dense(self.num_actions, kernel_init=_glorot, dtype=self.dtype)
+        self.mean = nn.Dense(1, kernel_init=_glorot, dtype=self.dtype)
+
+    def step(self, obs: jax.Array, prev_action: jax.Array, h: jax.Array, c: jax.Array):
+        x = obs.astype(self.dtype)
+        x = nn.relu(self.state_fc1(x))
+        x = nn.relu(self.state_fc2(x))
+        a = self.action_embed(prev_action)
+        z = jnp.concatenate([x, a], axis=-1)
+        new_h, new_c = self.cell(z, h, c)
+        q = nn.relu(self.head_fc(new_h))
+        q = self.value(q) - self.mean(q)
+        return q.astype(jnp.float32), new_h, new_c
+
+    def __call__(self, obs, prev_action, h, c):
+        return self.step(obs, prev_action, h, c)
+
+    def unroll(self, obs_seq, prev_action_seq, done_seq, h0, c0):
+        """Q-values over a `[B, T, ...]` sequence from stored start state.
+
+        done-masked like `model/r2d2_lstm.py:78-80`: (h, c) are zeroed
+        *after* the step at which done[t] is True. Returns `[B, T, A]`.
+        """
+
+        def body(mdl, carry, xs):
+            h, c = carry
+            obs_t, pa_t, done_t = xs
+            q, new_h, new_c = mdl.step(obs_t, pa_t, h, c)
+            keep = (~done_t).astype(new_h.dtype)[..., None]
+            return (new_h * keep, new_c * keep), q
+
+        scan = nn.scan(
+            body,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=1,
+            out_axes=1,
+        )
+        _, q_seq = scan(self, (h0, c0), (obs_seq, prev_action_seq, done_seq))
+        return q_seq

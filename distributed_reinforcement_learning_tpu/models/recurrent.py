@@ -1,0 +1,47 @@
+"""LSTM cell and unroll strategies for the recurrent agents.
+
+The reference uses TF1's `LSTMCell` + `dynamic_rnn` one step at a time
+(`model/impala_actor_critic.py:18-25`, `model/r2d2_lstm.py:10-18`) and
+unrolls sequences with Python loops that replicate the whole network per
+timestep. Here:
+
+- `LSTMCell` is a single fused `[x; h] @ W + b` matmul split into the four
+  gates (one MXU-friendly matmul per step), with TF-style forget bias 1.0.
+- Stored-state training (IMPALA) needs **no unroll at all**: each timestep
+  is seeded from the actor-recorded (h, c), so the learner applies the cell
+  to a flattened `[B*T]` batch in one shot (see `agents/impala.py`).
+- Sequential unrolls (R2D2) use `jax.lax.scan` via `flax.linen.scan` with
+  done-masked state resets, replacing the reference's Python loop
+  (`model/r2d2_lstm.py:67-112`).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LSTMCell(nn.Module):
+    """Fused-matmul LSTM cell with forget-gate bias 1.0 (TF1 parity).
+
+    State layout: (h, c) pairs of `[N, hidden]`. The fused kernel computes
+    all four gates from one `[x; h] @ W` product so XLA maps a step onto a
+    single MXU matmul.
+    """
+
+    hidden_size: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, h: jax.Array, c: jax.Array):
+        gates = nn.Dense(
+            4 * self.hidden_size,
+            kernel_init=nn.initializers.xavier_uniform(),
+            dtype=self.dtype,
+            name="gates",
+        )(jnp.concatenate([x, h], axis=-1))
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        new_c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+        return new_h, new_c

@@ -1,0 +1,70 @@
+"""Shared network torsos: Nature-DQN conv stack, action embedding, MLP.
+
+Parity targets: conv torso `model/impala_actor_critic.py:4-10` /
+`model/apex_value.py:4-10` (32/64/64, VALID, relu); action embedding
+`model/impala_actor_critic.py:12-16` (one-hot -> 256 -> 256 relu); MLP
+head builder `model/impala_actor_critic.py:27-30`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+_glorot = nn.initializers.xavier_uniform()
+
+
+class NatureConv(nn.Module):
+    """Nature-DQN conv torso: 8x8/4 x32, 4x4/2 x64, 3x3/1 x64, flatten."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for features, kernel, stride in ((32, 8, 4), (64, 4, 2), (64, 3, 1)):
+            x = nn.Conv(
+                features,
+                (kernel, kernel),
+                strides=(stride, stride),
+                padding="VALID",
+                kernel_init=_glorot,
+                dtype=self.dtype,
+            )(x)
+            x = nn.relu(x)
+        return x.reshape((x.shape[0], -1))
+
+
+class ActionEmbedding(nn.Module):
+    """One-hot previous action -> Dense 256 relu -> Dense 256 relu."""
+
+    num_actions: int
+    width: int = 256
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, action: jax.Array) -> jax.Array:
+        x = jax.nn.one_hot(action, self.num_actions, dtype=self.dtype)
+        x = nn.relu(nn.Dense(self.width, kernel_init=_glorot, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(self.width, kernel_init=_glorot, dtype=self.dtype)(x))
+        return x
+
+
+class MLP(nn.Module):
+    """relu MLP over `hidden_sizes` with a linear `output_size` head."""
+
+    hidden_sizes: Sequence[int]
+    output_size: int
+    final_activation: Callable[[jax.Array], jax.Array] | None = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for width in self.hidden_sizes:
+            x = nn.relu(nn.Dense(width, kernel_init=_glorot, dtype=self.dtype)(x))
+        x = nn.Dense(self.output_size, kernel_init=_glorot, dtype=self.dtype)(x)
+        if self.final_activation is not None:
+            x = self.final_activation(x)
+        return x

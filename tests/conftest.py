@@ -1,9 +1,14 @@
 """Test configuration: run everything on a simulated 8-device CPU mesh.
 
-Must set the XLA flags *before* jax is imported anywhere, so this lives at
-the top of conftest. Multi-chip sharding paths are exercised on virtual CPU
-devices (real TPU pods are not available in CI); the driver separately
-dry-runs `__graft_entry__.dryrun_multichip` the same way.
+Two subtleties of this environment:
+
+- The image's sitecustomize imports jax at interpreter startup with
+  `JAX_PLATFORMS=axon` (remote TPU tunnel), so setting env vars here is
+  too late — jax is already imported. `jax.config.update` still works
+  because no backend has been initialized yet.
+- Tests must NOT touch the axon/TPU tunnel at all (single remote chip,
+  serialized between processes); forcing the cpu platform keeps the whole
+  suite hermetic. Multi-chip sharding paths run on 8 virtual CPU devices.
 """
 
 import os
@@ -13,5 +18,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:  # jax >= 0.4.34: cleaner than XLA_FLAGS, but keep both.
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,222 @@
+"""Agent-level tests: learn steps run, losses decrease, semantics hold.
+
+All on vector observations (CartPole-class) — conv paths are TPU-only in
+CI (see test_models.py note).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_reinforcement_learning_tpu.agents import (
+    ApexAgent,
+    ApexBatch,
+    ApexConfig,
+    ImpalaAgent,
+    ImpalaBatch,
+    ImpalaConfig,
+    R2D2Agent,
+    R2D2Batch,
+    R2D2Config,
+)
+
+
+def impala_cfg(**kw):
+    base = dict(obs_shape=(4,), num_actions=2, trajectory=8, lstm_size=16,
+                learning_frame=1000)
+    base.update(kw)
+    return ImpalaConfig(**base)
+
+
+def make_impala_batch(cfg, key, B=3):
+    T, A, H = cfg.trajectory, cfg.num_actions, cfg.lstm_size
+    ks = jax.random.split(key, 8)
+    policy = jax.nn.softmax(jax.random.normal(ks[0], (B, T, A)), axis=-1)
+    return ImpalaBatch(
+        state=jax.random.normal(ks[1], (B, T, *cfg.obs_shape)),
+        reward=jax.random.normal(ks[2], (B, T)),
+        action=jax.random.randint(ks[3], (B, T), 0, A),
+        done=jax.random.bernoulli(ks[4], 0.1, (B, T)),
+        behavior_policy=policy,
+        previous_action=jax.random.randint(ks[5], (B, T), 0, A),
+        initial_h=jax.random.normal(ks[6], (B, T, H)) * 0.1,
+        initial_c=jax.random.normal(ks[7], (B, T, H)) * 0.1,
+    )
+
+
+class TestImpala:
+    def test_act_shapes_and_valid_actions(self):
+        agent = ImpalaAgent(impala_cfg())
+        state = agent.init_state(jax.random.PRNGKey(0))
+        obs = jnp.zeros((5, 4))
+        h, c = agent.initial_lstm_state(5)
+        out = agent.act(state.params, obs, jnp.zeros((5,), jnp.int32), h, c,
+                        jax.random.PRNGKey(1))
+        assert out.action.shape == (5,)
+        assert ((out.action >= 0) & (out.action < 2)).all()
+        np.testing.assert_allclose(out.policy.sum(-1), np.ones(5), rtol=1e-5)
+
+    def test_learn_step_updates_params_and_counts(self):
+        agent = ImpalaAgent(impala_cfg())
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = make_impala_batch(agent.cfg, jax.random.PRNGKey(1))
+        p0 = jax.tree.map(jnp.copy, state.params)
+        state2, metrics = agent.learn(state, batch)
+        assert int(state2.step) == 1
+        diffs = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), p0, state2.params)
+        assert max(jax.tree.leaves(diffs)) > 0
+        for k in ("pi_loss", "baseline_loss", "entropy", "total_loss", "grad_norm"):
+            assert np.isfinite(float(metrics[k])), k
+
+    def test_learning_rate_decays(self):
+        agent = ImpalaAgent(impala_cfg(start_learning_rate=1e-3, end_learning_rate=0.0,
+                                       learning_frame=10))
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = make_impala_batch(agent.cfg, jax.random.PRNGKey(1))
+        lrs = []
+        for _ in range(3):
+            state, metrics = agent.learn(state, batch)
+            lrs.append(float(metrics["learning_rate"]))
+        assert lrs[0] > lrs[1] > lrs[2]
+
+    def test_loss_decreases_on_repeated_batch(self):
+        agent = ImpalaAgent(impala_cfg(entropy_coef=0.0))
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = make_impala_batch(agent.cfg, jax.random.PRNGKey(1))
+        state, m0 = agent.learn(state, batch)  # learn donates its input state
+        for _ in range(30):
+            state, m = agent.learn(state, batch)
+        assert float(m["baseline_loss"]) < float(m0["baseline_loss"])
+
+
+def apex_cfg(**kw):
+    base = dict(obs_shape=(4,), num_actions=2)
+    base.update(kw)
+    return ApexConfig(**base)
+
+
+def make_apex_batch(cfg, key, B=16):
+    ks = jax.random.split(key, 6)
+    return ApexBatch(
+        state=jax.random.normal(ks[0], (B, *cfg.obs_shape)),
+        next_state=jax.random.normal(ks[1], (B, *cfg.obs_shape)),
+        previous_action=jax.random.randint(ks[2], (B,), 0, cfg.num_actions),
+        action=jax.random.randint(ks[3], (B,), 0, cfg.num_actions),
+        reward=jax.random.normal(ks[4], (B,)),
+        done=jax.random.bernoulli(ks[5], 0.2, (B,)),
+    )
+
+
+class TestApex:
+    def test_act_epsilon_extremes(self):
+        agent = ApexAgent(apex_cfg())
+        state = agent.init_state(jax.random.PRNGKey(0))
+        obs = jax.random.normal(jax.random.PRNGKey(1), (64, 4))
+        pa = jnp.zeros((64,), jnp.int32)
+        a_greedy, q = agent.act(state.params, obs, pa, 0.0, jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(a_greedy, jnp.argmax(q, axis=-1))
+        a_rand, _ = agent.act(state.params, obs, pa, 1.0, jax.random.PRNGKey(3))
+        assert not np.array_equal(np.asarray(a_rand), np.asarray(a_greedy))
+
+    def test_learn_and_target_sync(self):
+        agent = ApexAgent(apex_cfg())
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = make_apex_batch(agent.cfg, jax.random.PRNGKey(1))
+        w = jnp.ones((16,))
+        target_before = jax.tree.map(jnp.copy, state.target_params)
+        state2, td, metrics = agent.learn(state, batch, w)  # donates state
+        assert td.shape == (16,)
+        assert np.isfinite(float(metrics["loss"]))
+        # target params unchanged by learn...
+        d = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                         state2.target_params, target_before)
+        assert max(jax.tree.leaves(d)) == 0
+        # ...until sync copies main over.
+        state3 = agent.sync_target(state2)
+        d2 = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                          state3.target_params, state3.params)
+        assert max(jax.tree.leaves(d2)) == 0
+
+    def test_td_error_matches_learn_priorities(self):
+        agent = ApexAgent(apex_cfg())
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = make_apex_batch(agent.cfg, jax.random.PRNGKey(1))
+        td_score = agent.td_error(state, batch)
+        _, td_learn, _ = agent.learn(state, batch, jnp.ones((16,)))
+        np.testing.assert_allclose(td_score, td_learn, rtol=1e-5, atol=1e-5)
+
+    def test_is_weights_scale_loss(self):
+        agent = ApexAgent(apex_cfg())
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = make_apex_batch(agent.cfg, jax.random.PRNGKey(1))
+        _, _, m1 = agent.learn(state, batch, jnp.ones((16,)))
+        state_b = agent.init_state(jax.random.PRNGKey(0))
+        _, _, m2 = agent.learn(state_b, batch, jnp.full((16,), 2.0))
+        np.testing.assert_allclose(float(m2["loss"]), 2 * float(m1["loss"]), rtol=1e-5)
+
+
+def r2d2_cfg(**kw):
+    base = dict(obs_shape=(2,), num_actions=2, seq_len=10, burn_in=5, lstm_size=32)
+    base.update(kw)
+    return R2D2Config(**base)
+
+
+def make_r2d2_batch(cfg, key, B=4):
+    T, H = cfg.seq_len, cfg.lstm_size
+    ks = jax.random.split(key, 7)
+    return R2D2Batch(
+        state=jax.random.normal(ks[0], (B, T, *cfg.obs_shape)),
+        previous_action=jax.random.randint(ks[1], (B, T), 0, cfg.num_actions),
+        action=jax.random.randint(ks[2], (B, T), 0, cfg.num_actions),
+        reward=jax.random.normal(ks[3], (B, T)),
+        done=jax.random.bernoulli(ks[4], 0.1, (B, T)),
+        initial_h=jax.random.normal(ks[5], (B, H)) * 0.1,
+        initial_c=jax.random.normal(ks[6], (B, H)) * 0.1,
+    )
+
+
+class TestR2D2:
+    def test_act_shapes(self):
+        agent = R2D2Agent(r2d2_cfg())
+        state = agent.init_state(jax.random.PRNGKey(0))
+        h, c = agent.initial_lstm_state(3)
+        a, q, h2, c2 = agent.act(state.params, jnp.zeros((3, 2)), h, c,
+                                 jnp.zeros((3,), jnp.int32), 0.5, jax.random.PRNGKey(1))
+        assert a.shape == (3,)
+        assert q.shape == (3, 2)
+        assert h2.shape == (3, 32)
+
+    def test_learn_returns_sequence_priorities(self):
+        agent = R2D2Agent(r2d2_cfg())
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = make_r2d2_batch(agent.cfg, jax.random.PRNGKey(1))
+        state2, priorities, metrics = agent.learn(state, batch, jnp.ones((4,)))
+        assert priorities.shape == (4,)
+        assert (np.asarray(priorities) >= 0).all()
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(state2.step) == 1
+
+    def test_td_error_matches_learn_priorities(self):
+        agent = R2D2Agent(r2d2_cfg())
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = make_r2d2_batch(agent.cfg, jax.random.PRNGKey(1))
+        td = agent.td_error(state, batch)
+        _, priorities, _ = agent.learn(state, batch, jnp.ones((4,)))
+        np.testing.assert_allclose(td, priorities, rtol=1e-5, atol=1e-5)
+
+    def test_burn_in_excluded_from_loss(self):
+        """Rewards inside the burn-in window (except the step feeding the first
+        trained transition) don't change the loss."""
+        cfg = r2d2_cfg()
+        agent = R2D2Agent(cfg)
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = make_r2d2_batch(cfg, jax.random.PRNGKey(1))
+        _, _, m1 = agent.learn(state, batch, jnp.ones((4,)))
+
+        # Perturb rewards strictly inside burn-in (steps 0..burn_in-1).
+        new_reward = batch.reward.at[:, : cfg.burn_in].set(100.0)
+        batch2 = batch._replace(reward=new_reward)
+        state_b = agent.init_state(jax.random.PRNGKey(0))
+        _, _, m2 = agent.learn(state_b, batch2, jnp.ones((4,)))
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)

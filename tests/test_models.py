@@ -1,0 +1,135 @@
+"""Model shape, gradient, and unroll-semantics tests.
+
+Conv-torso tests are gated behind DRL_TPU_SLOW_TESTS=1: XLA:CPU convolution
+is pathologically slow on the single-core CI host (minutes per compile).
+The conv path is exercised on real TPU by bench.py and __graft_entry__.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_reinforcement_learning_tpu.models import (
+    DuelingQNetwork,
+    ImpalaActorCritic,
+    R2D2Net,
+    SimpleQNetwork,
+    apply_stored_state,
+)
+
+slow = pytest.mark.skipif(
+    os.environ.get("DRL_TPU_SLOW_TESTS") != "1",
+    reason="conv compiles take minutes on single-core CPU; set DRL_TPU_SLOW_TESTS=1",
+)
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@slow
+def test_impala_shapes_atari(rng):
+    model = ImpalaActorCritic(num_actions=18, lstm_size=64)
+    obs = jnp.zeros((3, 84, 84, 4))
+    pa = jnp.zeros((3,), jnp.int32)
+    h = c = jnp.zeros((3, 64))
+    params = model.init(rng, obs, pa, h, c)
+    out = model.apply(params, obs, pa, h, c)
+    assert out.policy.shape == (3, 18)
+    assert out.value.shape == (3,)
+    assert out.h.shape == (3, 64)
+    np.testing.assert_allclose(out.policy.sum(-1), np.ones(3), rtol=1e-5)
+
+
+def test_impala_vector_obs(rng):
+    model = ImpalaActorCritic(num_actions=2, lstm_size=32)
+    obs = jnp.zeros((5, 4))
+    pa = jnp.zeros((5,), jnp.int32)
+    h = c = jnp.zeros((5, 32))
+    params = model.init(rng, obs, pa, h, c)
+    out = model.apply(params, obs, pa, h, c)
+    assert out.policy.shape == (5, 2)
+    assert out.value.shape == (5,)
+    np.testing.assert_allclose(out.policy.sum(-1), np.ones(5), rtol=1e-5)
+
+
+def test_impala_stored_state_matches_per_step(rng):
+    """Flattened [B*T] forward == applying the net step-by-step with stored states."""
+    B, T, A, H = 2, 5, 4, 16
+    model = ImpalaActorCritic(num_actions=A, lstm_size=H)
+    key = jax.random.PRNGKey(1)
+    obs = jax.random.normal(key, (B, T, 6))
+    pa = jax.random.randint(key, (B, T), 0, A)
+    hs = jax.random.normal(key, (B, T, H))
+    cs = jax.random.normal(key, (B, T, H))
+    params = model.init(rng, obs[:, 0], pa[:, 0], hs[:, 0], cs[:, 0])
+
+    policy, value = apply_stored_state(model, params, obs, pa, hs, cs)
+    assert policy.shape == (B, T, A)
+    assert value.shape == (B, T)
+
+    for t in range(T):
+        out = model.apply(params, obs[:, t], pa[:, t], hs[:, t], cs[:, t])
+        np.testing.assert_allclose(policy[:, t], out.policy, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(value[:, t], out.value, rtol=2e-4, atol=2e-4)
+
+
+@slow
+def test_dueling_q_shapes(rng):
+    model = DuelingQNetwork(num_actions=4)
+    obs = jnp.zeros((2, 84, 84, 4))
+    pa = jnp.zeros((2,), jnp.int32)
+    params = model.init(rng, obs, pa)
+    q = model.apply(params, obs, pa)
+    assert q.shape == (2, 4)
+
+
+def test_simple_q_shapes(rng):
+    model = SimpleQNetwork(num_actions=2)
+    params = model.init(rng, jnp.zeros((2, 4)), jnp.zeros((2,), jnp.int32))
+    q = model.apply(params, jnp.zeros((2, 4)), jnp.zeros((2,), jnp.int32))
+    assert q.shape == (2, 2)
+
+
+def test_r2d2_step_and_unroll_consistency(rng):
+    """Scan unroll matches a manual Python loop with done-masked resets."""
+    B, T, A, H = 2, 6, 2, 8
+    model = R2D2Net(num_actions=A, lstm_size=H)
+    key = jax.random.PRNGKey(2)
+    obs = jax.random.normal(key, (B, T, 2))
+    pa = jax.random.randint(key, (B, T), 0, A)
+    done = jnp.asarray([[False, False, True, False, False, False],
+                        [False, False, False, False, True, False]])
+    h0 = jax.random.normal(key, (B, H))
+    c0 = jax.random.normal(key, (B, H))
+
+    params = model.init(rng, obs[:, 0], pa[:, 0], h0, c0)
+    q_seq = model.apply(params, obs, pa, done, h0, c0, method=model.unroll)
+    assert q_seq.shape == (B, T, A)
+
+    h, c = h0, c0
+    for t in range(T):
+        q, h, c = model.apply(params, obs[:, t], pa[:, t], h, c)
+        np.testing.assert_allclose(q_seq[:, t], q, rtol=2e-5, atol=2e-5)
+        keep = (~done[:, t]).astype(h.dtype)[:, None]
+        h, c = h * keep, c * keep
+
+
+def test_models_have_gradients(rng):
+    model = ImpalaActorCritic(num_actions=4, lstm_size=16)
+    obs = jnp.ones((2, 6)) * 0.5
+    pa = jnp.zeros((2,), jnp.int32)
+    h = c = jnp.zeros((2, 16))
+    params = model.init(rng, obs, pa, h, c)
+
+    def loss(p):
+        out = model.apply(p, obs, pa, h, c)
+        return jnp.sum(out.value) + jnp.sum(out.policy * out.policy)
+
+    grads = jax.grad(loss)(params)
+    total = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree.leaves(grads))
+    assert np.isfinite(total) and total > 0
