@@ -1,0 +1,24 @@
+"""Host-side data plane (reference layer L2): queues, replay, accumulators."""
+
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
+from distributed_reinforcement_learning_tpu.data.replay import (
+    PrioritizedReplay,
+    SumTree,
+    UniformBuffer,
+)
+from distributed_reinforcement_learning_tpu.data.structures import (
+    ImpalaTrajectoryAccumulator,
+    R2D2SequenceAccumulator,
+    transitions_from_unroll,
+)
+
+__all__ = [
+    "TrajectoryQueue",
+    "stack_pytrees",
+    "PrioritizedReplay",
+    "SumTree",
+    "UniformBuffer",
+    "ImpalaTrajectoryAccumulator",
+    "R2D2SequenceAccumulator",
+    "transitions_from_unroll",
+]

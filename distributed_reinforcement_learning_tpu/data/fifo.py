@@ -1,0 +1,100 @@
+"""Bounded trajectory FIFO with blocking backpressure.
+
+Host-side replacement for the reference's learner-placed `tf.FIFOQueue`
+(`distributed_queue/buffer_queue.py:28-36,153-160,368-378`): a
+thread-safe bounded queue of numpy pytrees. Producers (actor threads or
+the transport server) block when full — the same backpressure the TF
+queue kernel gave the reference. The learner drains whole batches in one
+call and gets stacked arrays ready for one host->device transfer,
+replacing the reference's 32 sequential dequeue round-trips per batch
+(`buffer_queue.py:416-435`, the anti-pattern called out in SURVEY §7).
+
+A C++ ring-buffer backend (cpp/) slots in behind the same interface for
+the multi-process data plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+
+def stack_pytrees(items: list[Any]) -> Any:
+    """Stack a list of identically-structured numpy pytrees along axis 0."""
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: stack_pytrees([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)) and not isinstance(first, np.ndarray):
+        cols = zip(*items)
+        stacked = [stack_pytrees(list(c)) for c in cols]
+        return type(first)(*stacked) if hasattr(first, "_fields") else type(first)(stacked)
+    return np.stack(items)
+
+
+class TrajectoryQueue:
+    """Bounded MPMC queue of trajectory pytrees.
+
+    put() blocks when full (backpressure on actors, like the reference's
+    blocking enqueue); get_batch(n) blocks until n items are available and
+    returns them stacked along a new leading batch axis.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def size(self) -> int:
+        """Queue depth, the learner's readiness poll (`buffer_queue.py:437-439`)."""
+        return len(self)
+
+    def close(self) -> None:
+        """Wake all blocked producers/consumers; subsequent puts raise."""
+        with self._lock:
+            self._closed = True
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+
+    def put(self, item: Any, timeout: float | None = None) -> bool:
+        with self._not_full:
+            if not self._not_full.wait_for(
+                lambda: len(self._items) < self.capacity or self._closed, timeout
+            ):
+                return False
+            if self._closed:
+                raise RuntimeError("queue closed")
+            self._items.append(item)
+            self._not_empty.notify()
+            return True
+
+    def get(self, timeout: float | None = None) -> Any | None:
+        with self._not_empty:
+            if not self._not_empty.wait_for(lambda: self._items or self._closed, timeout):
+                return None
+            if not self._items:  # closed and drained
+                return None
+            item = self._items.popleft()
+            self._not_full.notify()
+            return item
+
+    def get_batch(self, batch_size: int, timeout: float | None = None) -> Any | None:
+        """Dequeue `batch_size` items and stack them into `[B, ...]` arrays."""
+        items = []
+        for _ in range(batch_size):
+            item = self.get(timeout)
+            if item is None:
+                return None
+            items.append(item)
+        return stack_pytrees(items)

@@ -1,0 +1,152 @@
+"""Prioritized experience replay: SumTree + Memory.
+
+Re-design of `distributed_queue/buffer_queue.py:256-346`. Same sampling
+semantics — priority `(|err| + 0.001) ** 0.6`, stratified sampling over
+`total/n` segments, IS weights `(N * p) ** -beta` normalized by the batch
+max, beta annealed 0.4 -> 1.0 by 0.001 per sample() call — but the tree
+is array-based with *iterative* propagate/retrieve (the reference recurses
+per-element, a Python hotspot flagged in SURVEY §2 E7) and supports batch
+add/update. One reference bug is deliberately fixed: `train_r2d2.py:159`
+updates only a single stale index per train step; `update_batch` here
+updates every sampled index.
+
+A C++ backend (cpp/sumtree) plugs in behind the same interface.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class SumTree:
+    """Array-backed binary sum tree over `capacity` leaf priorities."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._tree = np.zeros(2 * capacity - 1, np.float64)
+        self._data: list[Any] = [None] * capacity
+        self._write = 0
+        self._count = 0
+
+    def __len__(self) -> int:
+        return self._count
+
+    @property
+    def total(self) -> float:
+        return float(self._tree[0])
+
+    def add(self, priority: float, data: Any) -> int:
+        idx = self._write + self.capacity - 1
+        self._data[self._write] = data
+        self.set_priority(idx, priority)
+        self._write = (self._write + 1) % self.capacity
+        self._count = min(self._count + 1, self.capacity)
+        return idx
+
+    def set_priority(self, idx: int, priority: float) -> None:
+        delta = priority - self._tree[idx]
+        while True:
+            self._tree[idx] += delta
+            if idx == 0:
+                break
+            idx = (idx - 1) // 2
+
+    def get(self, value: float) -> tuple[int, float, Any]:
+        """Find the leaf whose cumulative-priority interval contains `value`."""
+        idx = 0
+        while True:
+            left = 2 * idx + 1
+            if left >= len(self._tree):
+                break
+            if value <= self._tree[left]:
+                idx = left
+            else:
+                value -= self._tree[left]
+                idx = left + 1
+        data_idx = idx - (self.capacity - 1)
+        return idx, float(self._tree[idx]), self._data[data_idx]
+
+
+class PrioritizedReplay:
+    """The reference's `Memory` surface: add / sample / update.
+
+    `sample(n)` returns (items, tree_idxs, is_weights) with stratified
+    sampling and annealed-beta importance weights
+    (`buffer_queue.py:323-342`).
+    """
+
+    EPS = 0.001
+    ALPHA = 0.6
+    BETA_INCREMENT = 0.001
+
+    def __init__(self, capacity: int, beta: float = 0.4):
+        self.tree = SumTree(capacity)
+        self.beta = beta
+
+    def __len__(self) -> int:
+        return len(self.tree)
+
+    def _priority(self, error: float) -> float:
+        return (abs(error) + self.EPS) ** self.ALPHA
+
+    def add(self, error: float, sample: Any) -> int:
+        return self.tree.add(self._priority(error), sample)
+
+    def add_batch(self, errors: np.ndarray, samples: list[Any]) -> list[int]:
+        return [self.tree.add(self._priority(e), s) for e, s in zip(errors, samples)]
+
+    def sample(self, n: int, rng: np.random.RandomState | None = None):
+        rng = rng or np.random
+        self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
+        segment = self.tree.total / n
+        idxs = np.empty(n, np.int64)
+        priorities = np.empty(n, np.float64)
+        items = []
+        for i in range(n):
+            value = rng.uniform(segment * i, segment * (i + 1))
+            idx, p, data = self.tree.get(value)
+            idxs[i] = idx
+            priorities[i] = p
+            items.append(data)
+        probs = priorities / self.tree.total
+        weights = np.power(len(self.tree) * probs, -self.beta)
+        weights /= weights.max()
+        return items, idxs, weights.astype(np.float32)
+
+    def update(self, idx: int, error: float) -> None:
+        self.tree.set_priority(int(idx), self._priority(error))
+
+    def update_batch(self, idxs: np.ndarray, errors: np.ndarray) -> None:
+        """Re-prioritize every sampled index (fixes `train_r2d2.py:159`)."""
+        for idx, err in zip(idxs, errors):
+            self.update(int(idx), float(err))
+
+
+class UniformBuffer:
+    """Actor-local uniform-random transition store.
+
+    Parity with `LocalBuffer` (`buffer_queue.py:213-254`): bounded deque,
+    uniform sample of `batch_size` transitions.
+    """
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._items: list[Any] = []
+        self._write = 0
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def append(self, item: Any) -> None:
+        if len(self._items) < self.capacity:
+            self._items.append(item)
+        else:
+            self._items[self._write] = item
+        self._write = (self._write + 1) % self.capacity
+
+    def sample(self, batch_size: int) -> list[Any]:
+        idx = self._rng.randint(0, len(self._items), size=batch_size)
+        return [self._items[i] for i in idx]

@@ -1,0 +1,108 @@
+"""Client-side trajectory accumulators.
+
+Batched re-design of the reference's per-env Python-list accumulators
+(`utils.py:47-86` UnrolledTrajectory, `buffer_queue.py:94-134`
+R2D2TrajectoryBuffer): one accumulator holds a whole vectorized actor's
+unroll as `[T]`-lists of `[N, ...]` arrays and emits per-env trajectory
+pytrees keyed to the agents' batch NamedTuples.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.agents.apex import ApexBatch
+from distributed_reinforcement_learning_tpu.agents.impala import ImpalaBatch
+from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Batch
+
+
+class ImpalaTrajectoryAccumulator:
+    """Collects T steps of a `[N]`-env actor, emits N `ImpalaBatch`-shaped
+    pytrees with leading `[T]` axis (no batch dim — the queue stacks them)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._steps: list[dict] = []
+
+    def append(self, **step_fields: np.ndarray) -> None:
+        self._steps.append(step_fields)
+
+    def __len__(self) -> int:
+        return len(self._steps)
+
+    def extract(self) -> list[ImpalaBatch]:
+        """-> one `[T, ...]` ImpalaBatch per env slot."""
+        fields = {
+            k: np.stack([s[k] for s in self._steps], axis=1)  # [N, T, ...]
+            for k in self._steps[0]
+        }
+        n = next(iter(fields.values())).shape[0]
+        return [ImpalaBatch(**{k: v[i] for k, v in fields.items()}) for i in range(n)]
+
+
+class R2D2SequenceAccumulator:
+    """Collects seq_len steps + the sequence-start LSTM state per env.
+
+    Mirrors `R2D2TrajectoryBuffer` (`buffer_queue.py:94-134`) but batched:
+    the per-step (h, c) of the reference collapse to the sequence-start
+    state, which is all the learner seeds from (`agent/r2d2.py:110-111`).
+    """
+
+    def __init__(self):
+        self._steps: list[dict] = []
+        self._initial_h: np.ndarray | None = None
+        self._initial_c: np.ndarray | None = None
+
+    def reset(self, initial_h: np.ndarray, initial_c: np.ndarray) -> None:
+        self._steps = []
+        self._initial_h = np.asarray(initial_h).copy()
+        self._initial_c = np.asarray(initial_c).copy()
+
+    def append(self, **step_fields: np.ndarray) -> None:
+        self._steps.append(step_fields)
+
+    def __len__(self) -> int:
+        return len(self._steps)
+
+    def extract(self) -> list[R2D2Batch]:
+        fields = {
+            k: np.stack([s[k] for s in self._steps], axis=1) for k in self._steps[0]
+        }
+        n = next(iter(fields.values())).shape[0]
+        return [
+            R2D2Batch(
+                state=fields["state"][i],
+                previous_action=fields["previous_action"][i],
+                action=fields["action"][i],
+                reward=fields["reward"][i],
+                done=fields["done"][i],
+                initial_h=self._initial_h[i],
+                initial_c=self._initial_c[i],
+            )
+            for i in range(n)
+        ]
+
+
+def transitions_from_unroll(
+    state: np.ndarray,
+    next_state: np.ndarray,
+    previous_action: np.ndarray,
+    action: np.ndarray,
+    reward: np.ndarray,
+    done: np.ndarray,
+) -> list[ApexBatch]:
+    """Split `[T, ...]` unroll arrays into per-transition ApexBatch rows
+    (the per-transition replay insertion of `train_apex.py:114-122`)."""
+    return [
+        ApexBatch(
+            state=state[t],
+            next_state=next_state[t],
+            previous_action=previous_action[t],
+            action=action[t],
+            reward=reward[t],
+            done=done[t],
+        )
+        for t in range(state.shape[0])
+    ]

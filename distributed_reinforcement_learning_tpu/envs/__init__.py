@@ -1,0 +1,23 @@
+"""Environments and preprocessing (reference layer L2 env adapters)."""
+
+from distributed_reinforcement_learning_tpu.envs.atari import (
+    AtariPreprocessor,
+    SyntheticAtari,
+    area_resize,
+    preprocess_frame,
+)
+from distributed_reinforcement_learning_tpu.envs.cartpole import (
+    CartPoleEnv,
+    VectorCartPole,
+    pomdp_project,
+)
+
+__all__ = [
+    "AtariPreprocessor",
+    "SyntheticAtari",
+    "area_resize",
+    "preprocess_frame",
+    "CartPoleEnv",
+    "VectorCartPole",
+    "pomdp_project",
+]

@@ -1,0 +1,58 @@
+"""Environment API.
+
+The reference drives OpenAI Gym envs (`wrappers.py`, `train_*.py` loops).
+This image has no gym/ALE, so the framework defines its own minimal env
+protocol with the same step/reset contract, an in-tree CartPole physics
+implementation, and adapters/wrappers mirroring the reference's Atari
+pipeline. Anything needing a real Atari emulator is gated behind the
+`RawFrameEnv` protocol — plug in ALE when available.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+import numpy as np
+
+
+class Env(Protocol):
+    """Single environment: the reference's gym surface (`train_impala.py:145`)."""
+
+    num_actions: int
+
+    def reset(self) -> np.ndarray: ...
+
+    def step(self, action: int) -> tuple[np.ndarray, float, bool, dict[str, Any]]: ...
+
+
+class VectorEnv(Protocol):
+    """N synchronized environments stepped with an `[N]` action vector.
+
+    The TPU-first actor batches envs so one jitted act call serves all of
+    them (replacing the reference's one `sess.run` per env step per actor,
+    SURVEY §3.5).
+    """
+
+    num_envs: int
+    num_actions: int
+
+    def reset(self) -> np.ndarray: ...
+
+    def step(self, actions: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray, list[dict]]: ...
+
+
+class RawFrameEnv(Protocol):
+    """Raw RGB frame source (what gym.make('...Deterministic-v4') provides).
+
+    step/reset return `[H, W, 3]` uint8 frames; `lives()` exposes the ALE
+    life counter used by the reference's life-loss shaping
+    (`train_impala.py:149-154`).
+    """
+
+    num_actions: int
+
+    def reset(self) -> np.ndarray: ...
+
+    def step(self, action: int) -> tuple[np.ndarray, float, bool, dict[str, Any]]: ...
+
+    def lives(self) -> int: ...

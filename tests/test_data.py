@@ -1,0 +1,214 @@
+"""Data-plane tests: FIFO queue, prioritized replay, accumulators."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_reinforcement_learning_tpu.agents import ImpalaBatch
+from distributed_reinforcement_learning_tpu.data import (
+    ImpalaTrajectoryAccumulator,
+    PrioritizedReplay,
+    R2D2SequenceAccumulator,
+    SumTree,
+    TrajectoryQueue,
+    UniformBuffer,
+    transitions_from_unroll,
+)
+
+
+class TestTrajectoryQueue:
+    def test_fifo_order_and_size(self):
+        q = TrajectoryQueue(capacity=8)
+        for i in range(3):
+            q.put({"x": np.full((2,), i)})
+        assert q.size() == 3
+        assert q.get()["x"][0] == 0
+        assert q.get()["x"][0] == 1
+
+    def test_get_batch_stacks(self):
+        q = TrajectoryQueue(capacity=8)
+        for i in range(4):
+            q.put({"x": np.full((3,), i, np.float32)})
+        batch = q.get_batch(4)
+        assert batch["x"].shape == (4, 3)
+        np.testing.assert_array_equal(batch["x"][:, 0], [0, 1, 2, 3])
+
+    def test_put_blocks_when_full_backpressure(self):
+        q = TrajectoryQueue(capacity=2)
+        q.put(1)
+        q.put(2)
+        assert not q.put(3, timeout=0.05)  # times out: full
+        q.get()
+        assert q.put(3, timeout=0.5)
+
+    def test_producer_consumer_threads(self):
+        q = TrajectoryQueue(capacity=4)
+        produced = 50
+
+        def producer():
+            for i in range(produced):
+                q.put({"i": np.asarray(i)})
+
+        t = threading.Thread(target=producer)
+        t.start()
+        got = [q.get(timeout=5.0) for _ in range(produced)]
+        t.join(timeout=5.0)
+        assert [int(g["i"]) for g in got] == list(range(produced))
+
+    def test_close_unblocks_consumer(self):
+        q = TrajectoryQueue(capacity=2)
+        result = {}
+
+        def consumer():
+            result["value"] = q.get(timeout=5.0)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        q.close()
+        t.join(timeout=5.0)
+        assert result["value"] is None
+
+    def test_namedtuple_payloads_stack(self):
+        q = TrajectoryQueue(capacity=4)
+        for i in range(2):
+            q.put(ImpalaBatch(
+                state=np.zeros((5, 4)), reward=np.zeros(5), action=np.zeros(5, np.int32),
+                done=np.zeros(5, bool), behavior_policy=np.zeros((5, 2)),
+                previous_action=np.zeros(5, np.int32),
+                initial_h=np.zeros((5, 8)), initial_c=np.zeros((5, 8))))
+        batch = q.get_batch(2)
+        assert isinstance(batch, ImpalaBatch)
+        assert batch.state.shape == (2, 5, 4)
+
+
+class TestSumTree:
+    def test_total_tracks_priorities(self):
+        tree = SumTree(capacity=4)
+        tree.add(1.0, "a")
+        tree.add(2.0, "b")
+        tree.add(3.0, "c")
+        np.testing.assert_allclose(tree.total, 6.0)
+
+    def test_get_finds_correct_leaf(self):
+        tree = SumTree(capacity=4)
+        for p, d in [(1.0, "a"), (2.0, "b"), (3.0, "c"), (4.0, "d")]:
+            tree.add(p, d)
+        # Cumulative intervals: a:[0,1], b:(1,3], c:(3,6], d:(6,10]
+        assert tree.get(0.5)[2] == "a"
+        assert tree.get(2.5)[2] == "b"
+        assert tree.get(5.9)[2] == "c"
+        assert tree.get(9.9)[2] == "d"
+
+    def test_overwrite_oldest_when_full(self):
+        tree = SumTree(capacity=2)
+        tree.add(1.0, "a")
+        tree.add(1.0, "b")
+        tree.add(5.0, "c")  # overwrites "a"
+        assert len(tree) == 2
+        np.testing.assert_allclose(tree.total, 6.0)
+        assert tree.get(0.5)[2] == "c"
+
+    def test_set_priority_updates_total(self):
+        tree = SumTree(capacity=4)
+        idx = tree.add(1.0, "a")
+        tree.set_priority(idx, 10.0)
+        np.testing.assert_allclose(tree.total, 10.0)
+
+
+class TestPrioritizedReplay:
+    def test_priority_exponent(self):
+        mem = PrioritizedReplay(capacity=8)
+        mem.add(1.0, "x")
+        want = (1.0 + 0.001) ** 0.6
+        np.testing.assert_allclose(mem.tree.total, want, rtol=1e-6)
+
+    def test_sample_shapes_and_weights(self):
+        mem = PrioritizedReplay(capacity=64)
+        rng = np.random.RandomState(0)
+        for i in range(64):
+            mem.add(rng.rand() * 5, i)
+        items, idxs, weights = mem.sample(16, rng)
+        assert len(items) == 16 and idxs.shape == (16,) and weights.shape == (16,)
+        assert weights.max() == pytest.approx(1.0)
+        assert (weights > 0).all()
+
+    def test_beta_anneals(self):
+        mem = PrioritizedReplay(capacity=8)
+        mem.add(1.0, "x")
+        b0 = mem.beta
+        mem.sample(2, np.random.RandomState(0))
+        assert mem.beta == pytest.approx(b0 + 0.001)
+
+    def test_high_priority_sampled_more(self):
+        mem = PrioritizedReplay(capacity=64)
+        for i in range(64):
+            mem.add(100.0 if i == 7 else 0.01, i)
+        rng = np.random.RandomState(0)
+        counts = 0
+        for _ in range(50):
+            items, _, _ = mem.sample(8, rng)
+            counts += sum(1 for it in items if it == 7)
+        assert counts > 100  # dominates sampling
+
+    def test_update_batch_changes_all(self):
+        mem = PrioritizedReplay(capacity=8)
+        idxs = [mem.add(1.0, i) for i in range(4)]
+        mem.update_batch(np.asarray(idxs), np.zeros(4))
+        want = 4 * (0.001**0.6)
+        np.testing.assert_allclose(mem.tree.total, want, rtol=1e-6)
+
+
+class TestUniformBuffer:
+    def test_bounded_and_samples(self):
+        buf = UniformBuffer(capacity=10)
+        for i in range(25):
+            buf.append(i)
+        assert len(buf) == 10
+        s = buf.sample(5)
+        assert len(s) == 5
+        assert all(15 <= x < 25 for x in s)  # only newest retained
+
+
+class TestAccumulators:
+    def test_impala_accumulator_shapes(self):
+        acc = ImpalaTrajectoryAccumulator()
+        N, T = 3, 5
+        for t in range(T):
+            acc.append(
+                state=np.zeros((N, 4), np.float32), reward=np.ones(N, np.float32),
+                action=np.full(N, t, np.int32), done=np.zeros(N, bool),
+                behavior_policy=np.zeros((N, 2), np.float32),
+                previous_action=np.zeros(N, np.int32),
+                initial_h=np.zeros((N, 8), np.float32), initial_c=np.zeros((N, 8), np.float32))
+        trajs = acc.extract()
+        assert len(trajs) == N
+        assert trajs[0].state.shape == (T, 4)
+        np.testing.assert_array_equal(trajs[0].action, np.arange(T))
+
+    def test_r2d2_accumulator_keeps_start_state(self):
+        acc = R2D2SequenceAccumulator()
+        N, T, H = 2, 4, 8
+        h0 = np.arange(N * H, dtype=np.float32).reshape(N, H)
+        acc.reset(h0, h0 * 2)
+        for t in range(T):
+            acc.append(
+                state=np.zeros((N, 2), np.int32), previous_action=np.zeros(N, np.int32),
+                action=np.zeros(N, np.int32), reward=np.zeros(N, np.float32),
+                done=np.zeros(N, bool))
+        seqs = acc.extract()
+        assert len(seqs) == N
+        np.testing.assert_array_equal(seqs[1].initial_h, h0[1])
+        np.testing.assert_array_equal(seqs[1].initial_c, h0[1] * 2)
+        assert seqs[0].state.shape == (T, 2)
+
+    def test_transitions_from_unroll(self):
+        T = 6
+        rows = transitions_from_unroll(
+            state=np.zeros((T, 4)), next_state=np.ones((T, 4)),
+            previous_action=np.zeros(T, np.int32), action=np.arange(T, dtype=np.int32),
+            reward=np.ones(T, np.float32), done=np.zeros(T, bool))
+        assert len(rows) == T
+        assert rows[3].action == 3

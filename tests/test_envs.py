@@ -1,0 +1,126 @@
+"""Environment and preprocessing tests."""
+
+import numpy as np
+import pytest
+
+from distributed_reinforcement_learning_tpu.envs import (
+    AtariPreprocessor,
+    CartPoleEnv,
+    SyntheticAtari,
+    VectorCartPole,
+    area_resize,
+    pomdp_project,
+    preprocess_frame,
+)
+
+
+class TestCartPole:
+    def test_reset_and_step(self):
+        env = CartPoleEnv(seed=0)
+        obs = env.reset()
+        assert obs.shape == (4,)
+        assert (np.abs(obs) <= 0.05).all()
+        obs2, r, done, _ = env.step(1)
+        assert obs2.shape == (4,)
+        assert r == 1.0
+        assert not done
+
+    def test_episode_terminates(self):
+        env = CartPoleEnv(seed=0)
+        env.reset()
+        done = False
+        steps = 0
+        while not done and steps < 300:
+            _, _, done, _ = env.step(1)  # constant push falls over quickly
+            steps += 1
+        assert done and steps < 200
+
+    def test_max_steps_cap(self):
+        env = CartPoleEnv(seed=0, max_steps=5)
+        env.reset()
+        for i in range(5):
+            _, _, done, _ = env.step(i % 2)
+        assert done
+
+    def test_vector_matches_single_physics(self):
+        single = CartPoleEnv(seed=1)
+        vec = VectorCartPole(num_envs=3, seed=1)
+        s0 = single.reset()
+        v0 = vec.reset()
+        # Same seed stream, different draw counts — just verify dynamics by
+        # forcing identical states.
+        vec._state[:] = np.stack([s0, s0, s0])
+        obs, r, done, _ = vec.step(np.array([0, 0, 0]))
+        s1, _, _, _ = single.step(0)
+        np.testing.assert_allclose(obs[0], s1, rtol=1e-6)
+        np.testing.assert_allclose(obs[1], s1, rtol=1e-6)
+
+    def test_vector_autoreset(self):
+        vec = VectorCartPole(num_envs=4, seed=0, max_steps=3)
+        vec.reset()
+        for _ in range(3):
+            obs, r, done, infos = vec.step(np.ones(4, np.int64))
+        assert done.all()
+        assert (infos["episode_return"] == 3).all()
+        # Auto-reset: states back inside init range.
+        assert (np.abs(obs) <= 0.05).all()
+
+    def test_pomdp_projection(self):
+        obs = np.array([0.1, 2.0, -0.05, 3.0], np.float32)
+        proj = pomdp_project(obs)
+        assert proj.dtype == np.int32
+        np.testing.assert_array_equal(proj, [int(0.1 * 255), int(-0.05 * 255)])
+
+
+class TestAtariPreprocessing:
+    def test_area_resize_constant_image(self):
+        img = np.full((210, 160), 7.0, np.float32)
+        out = area_resize(img, 110, 84)
+        assert out.shape == (110, 84)
+        np.testing.assert_allclose(out, 7.0, rtol=1e-5)
+
+    def test_area_resize_preserves_mean(self):
+        rng = np.random.RandomState(0)
+        img = rng.rand(210, 160).astype(np.float32) * 255
+        out = area_resize(img, 110, 84)
+        np.testing.assert_allclose(out.mean(), img.mean(), rtol=1e-3)
+
+    def test_area_resize_integer_factor_exact(self):
+        img = np.arange(16, dtype=np.float32).reshape(4, 4)
+        out = area_resize(img, 2, 2)
+        want = np.array([[img[:2, :2].mean(), img[:2, 2:].mean()],
+                         [img[2:, :2].mean(), img[2:, 2:].mean()]])
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_preprocess_frame_shape_dtype(self):
+        frame = np.random.RandomState(0).randint(0, 255, (210, 160, 3)).astype(np.uint8)
+        out = preprocess_frame(frame)
+        assert out.shape == (84, 84)
+        assert out.dtype == np.uint8
+
+    def test_preprocess_rejects_bad_shape(self):
+        with pytest.raises(ValueError):
+            preprocess_frame(np.zeros((100, 100, 3), np.uint8))
+
+    def test_pipeline_stack_and_lives(self):
+        env = AtariPreprocessor(SyntheticAtari(num_actions=4, episode_len=64))
+        obs = env.reset()
+        assert obs.shape == (84, 84, 4)
+        assert obs.dtype == np.uint8
+        # Newest frame occupies the last channel; early frames zero-padded.
+        assert obs[:, :, -1].any()
+        obs2, r, done, info = env.step(0)
+        assert "lives" in info
+        # Stack shifted: previous newest is now second-newest.
+        np.testing.assert_array_equal(obs2[:, :, -2], obs[:, :, -1])
+
+    def test_synthetic_episode_structure(self):
+        env = SyntheticAtari(num_actions=4, episode_len=32, life_every=8, reward_every=4)
+        env.reset()
+        total_r, steps, done = 0.0, 0, False
+        while not done:
+            _, r, done, info = env.step(0)
+            total_r += r
+            steps += 1
+        assert steps == 32 and total_r == 8.0
+        assert env.lives() == 1
