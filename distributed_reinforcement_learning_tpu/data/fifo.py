@@ -16,6 +16,7 @@ the multi-process data plane.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any
 
@@ -24,14 +25,9 @@ import numpy as np
 
 def stack_pytrees(items: list[Any]) -> Any:
     """Stack a list of identically-structured numpy pytrees along axis 0."""
-    first = items[0]
-    if isinstance(first, dict):
-        return {k: stack_pytrees([it[k] for it in items]) for k in first}
-    if isinstance(first, (tuple, list)) and not isinstance(first, np.ndarray):
-        cols = zip(*items)
-        stacked = [stack_pytrees(list(c)) for c in cols]
-        return type(first)(*stacked) if hasattr(first, "_fields") else type(first)(stacked)
-    return np.stack(items)
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack(xs), *items)
 
 
 class TrajectoryQueue:
@@ -90,11 +86,22 @@ class TrajectoryQueue:
             return item
 
     def get_batch(self, batch_size: int, timeout: float | None = None) -> Any | None:
-        """Dequeue `batch_size` items and stack them into `[B, ...]` arrays."""
+        """Dequeue `batch_size` items and stack them into `[B, ...]` arrays.
+
+        `timeout` is a total deadline across the whole batch. On timeout the
+        already-dequeued items are pushed back to the FRONT of the queue in
+        order (no data loss, no reordering) and None is returned.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
         items = []
         for _ in range(batch_size):
-            item = self.get(timeout)
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            item = self.get(remaining)
             if item is None:
+                if items:
+                    with self._lock:
+                        self._items.extendleft(reversed(items))
+                        self._not_empty.notify_all()
                 return None
             items.append(item)
         return stack_pytrees(items)

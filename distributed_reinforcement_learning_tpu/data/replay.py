@@ -105,8 +105,19 @@ class PrioritizedReplay:
         priorities = np.empty(n, np.float64)
         items = []
         for i in range(n):
-            value = rng.uniform(segment * i, segment * (i + 1))
-            idx, p, data = self.tree.get(value)
+            # Retry guards against float64 rounding in the subtractive
+            # descent landing on an unwritten zero-priority leaf while the
+            # tree is partially filled.
+            for _ in range(4):
+                value = rng.uniform(segment * i, segment * (i + 1))
+                idx, p, data = self.tree.get(value)
+                if data is not None:
+                    break
+            if data is None:  # final fallback: a uniformly random filled leaf
+                leaf = int(rng.randint(0, len(self.tree)))
+                idx = leaf + self.tree.capacity - 1
+                p = float(self.tree._tree[idx])
+                data = self.tree._data[leaf]
             idxs[i] = idx
             priorities[i] = p
             items.append(data)

@@ -1,0 +1,62 @@
+"""Batch N single envs behind the VectorEnv interface.
+
+The reference runs one env per actor process and one `sess.run` per env
+step (SURVEY §3.5). The TPU-first actor instead steps N envs and issues
+ONE jitted act call per timestep; this wrapper provides that batching for
+any single-env implementation (AtariPreprocessor, CartPoleEnv, custom).
+Auto-resets on done and surfaces per-env episode returns and ALE-style
+life counters for the life-loss shaping done in the actor loop
+(`train_impala.py:149-154`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.envs.base import Env
+
+
+class BatchedEnv:
+    def __init__(self, env_fns: Sequence[Callable[[], Env]]):
+        self.envs = [fn() for fn in env_fns]
+        self.num_envs = len(self.envs)
+        self.num_actions = self.envs[0].num_actions
+        self._returns = np.zeros(self.num_envs, np.float64)
+        self._lengths = np.zeros(self.num_envs, np.int64)
+
+    def reset(self) -> np.ndarray:
+        self._returns[:] = 0
+        self._lengths[:] = 0
+        return np.stack([env.reset() for env in self.envs])
+
+    def step(self, actions: np.ndarray):
+        obs_list, rewards, dones, lives = [], [], [], []
+        episode_returns = np.zeros(self.num_envs, np.float64)
+        episode_lengths = np.zeros(self.num_envs, np.int64)
+        for i, env in enumerate(self.envs):
+            obs, r, done, info = env.step(int(actions[i]))
+            self._returns[i] += r
+            self._lengths[i] += 1
+            if done:
+                episode_returns[i] = self._returns[i]
+                episode_lengths[i] = self._lengths[i]
+                self._returns[i] = 0
+                self._lengths[i] = 0
+                obs = env.reset()
+            obs_list.append(obs)
+            rewards.append(r)
+            dones.append(done)
+            lives.append(info.get("lives", -1))
+        infos = {
+            "episode_return": episode_returns,
+            "episode_length": episode_lengths,
+            "lives": np.asarray(lives),
+        }
+        return (
+            np.stack(obs_list),
+            np.asarray(rewards, np.float32),
+            np.asarray(dones, bool),
+            infos,
+        )

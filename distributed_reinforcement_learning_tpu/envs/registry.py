@@ -1,0 +1,42 @@
+"""Env registry: name -> constructor, the `gym.make` seam.
+
+The reference resolves env names via `gym.make` (`train_impala.py:117`,
+`wrappers.py:115-138`). This image has no gym/ALE, so:
+
+- `CartPole-v0` maps to the in-tree physics implementation.
+- Atari names (`*Deterministic-v4`, `*NoFrameskip-v4`) map to the full
+  preprocessing pipeline over `SyntheticAtari` — the real ALE emulator
+  plugs into the same `RawFrameEnv` seam when available (install
+  `ale-py` and register a factory via `register_env`).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable
+
+from distributed_reinforcement_learning_tpu.envs.atari import AtariPreprocessor, SyntheticAtari
+from distributed_reinforcement_learning_tpu.envs.base import Env
+from distributed_reinforcement_learning_tpu.envs.cartpole import CartPoleEnv
+
+_REGISTRY: dict[str, Callable[..., Env]] = {}
+
+_ATARI_PATTERN = re.compile(r".*(Deterministic|NoFrameskip)-v\d+$")
+
+
+def register_env(name: str, factory: Callable[..., Env]) -> None:
+    _REGISTRY[name] = factory
+
+
+def make_env(name: str, seed: int = 0, num_actions: int = 18) -> Env:
+    if name in _REGISTRY:
+        return _REGISTRY[name](seed=seed)
+    if name == "CartPole-v0":
+        return CartPoleEnv(seed=seed)
+    if name == "CartPole-v1":
+        return CartPoleEnv(seed=seed, max_steps=500)
+    if _ATARI_PATTERN.match(name):
+        # No emulator in this environment: synthetic frames through the
+        # real preprocessing pipeline (same shapes/dtypes/life semantics).
+        return AtariPreprocessor(SyntheticAtari(num_actions=num_actions, seed=seed))
+    raise ValueError(f"unknown env {name!r}; register a factory with register_env")

@@ -1,0 +1,5 @@
+"""Actor/learner loops, weight distribution, transport (reference layer L6)."""
+
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+__all__ = ["WeightStore"]

@@ -1,0 +1,196 @@
+"""Ape-X actor/learner loops.
+
+Re-design of `train_apex.py:82-231`:
+
+- `ApexActor`: N batched envs, epsilon-greedy act with per-env epsilon
+  `1/(0.05*episode+1)` (`train_apex.py:229`), life-loss shaping, local
+  uniform buffer; once warm, pushes a random `trajectory`-sized
+  re-sample of its buffer to the queue every env step
+  (`train_apex.py:207-217` — the reference's distributed-replay
+  approximation, kept for parity).
+- `ApexLearner`: ingests unrolls, scores TD, inserts per-transition into
+  prioritized replay (`train_apex.py:106-122`), trains with IS weights,
+  updates priorities, syncs the target net every `target_sync_interval`
+  steps (`train_apex.py:151-155`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexBatch
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
+from distributed_reinforcement_learning_tpu.data.replay import PrioritizedReplay, UniformBuffer
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
+
+
+class ApexActor:
+    def __init__(
+        self,
+        agent: ApexAgent,
+        env,
+        queue: TrajectoryQueue,
+        weights: WeightStore,
+        seed: int = 0,
+        unroll_size: int = 32,  # "trajectory" in the apex config (`config.json:99`)
+        local_capacity: int = 10_000,  # `train_apex.py:159-160`
+        warmup_factor: int = 3,  # push once len > 3*unroll (`train_apex.py:207`)
+        epsilon_decay: float = 0.05,  # `train_apex.py:229`
+        sync_every_steps: int = 100,
+        life_loss_shaping: bool = False,
+    ):
+        self.agent = agent
+        self.env = env
+        self.queue = queue
+        self.weights = weights
+        self.unroll_size = unroll_size
+        self.warmup = warmup_factor * unroll_size
+        self.epsilon_decay = epsilon_decay
+        self.sync_every_steps = sync_every_steps
+        self.life_loss_shaping = life_loss_shaping
+
+        self._rng = jax.random.PRNGKey(seed)
+        self._buffer = UniformBuffer(local_capacity, seed=seed)
+        self._obs = env.reset()
+        n = self._obs.shape[0]
+        self._prev_action = np.zeros(n, np.int32)
+        self._episodes = np.zeros(n, np.int64)
+        self._lives = np.full(n, -1)
+        self._params = None
+        self._version = -1
+        self._steps = 0
+        self.episode_returns: list[float] = []
+
+    @property
+    def epsilon(self) -> np.ndarray:
+        """Per-env epsilon from per-env episode counts (`train_apex.py:229`)."""
+        return 1.0 / (self.epsilon_decay * self._episodes + 1.0)
+
+    def _sync_params(self) -> None:
+        got = self.weights.get_if_newer(self._version)
+        if got is not None:
+            self._params, self._version = got
+
+    def run_steps(self, num_steps: int) -> int:
+        """Step the envs `num_steps` times; push buffer re-samples when warm."""
+        if self._steps % self.sync_every_steps == 0 or self._params is None:
+            self._sync_params()
+        if self._params is None:
+            raise RuntimeError("no weights published yet")
+
+        for _ in range(num_steps):
+            self._rng, sub = jax.random.split(self._rng)
+            actions, _ = self.agent.act(
+                self._params, self._obs, self._prev_action, self.epsilon, sub
+            )
+            actions = np.asarray(actions)
+            next_obs, reward, done, infos = self.env.step(actions)
+
+            rec_reward, rec_done = reward.astype(np.float32), done.copy()
+            if self.life_loss_shaping:
+                lives = infos.get("lives")
+                lost = (lives != self._lives) & (self._lives >= 0) & ~done
+                rec_reward = np.where(lost, -1.0, rec_reward)
+                rec_done = rec_done | lost
+                self._lives = np.where(done, -1, lives)
+
+            for i in range(self._obs.shape[0]):
+                self._buffer.append(
+                    ApexBatch(
+                        state=self._obs[i],
+                        next_state=next_obs[i],
+                        previous_action=self._prev_action[i],
+                        action=actions[i],
+                        reward=rec_reward[i],
+                        done=rec_done[i],
+                    )
+                )
+
+            self._episodes += done
+            for ret in infos.get("episode_return", [])[done]:
+                self.episode_returns.append(float(ret))
+            self._prev_action = np.where(done, 0, actions).astype(np.int32)
+            self._obs = next_obs
+            self._steps += 1
+
+            if len(self._buffer) > self.warmup:
+                unroll = stack_pytrees(self._buffer.sample(self.unroll_size))
+                self.queue.put(unroll)
+        return num_steps * self._obs.shape[0]
+
+
+class ApexLearner:
+    def __init__(
+        self,
+        agent: ApexAgent,
+        queue: TrajectoryQueue,
+        weights: WeightStore,
+        batch_size: int = 32,
+        replay_capacity: int = 100_000,
+        target_sync_interval: int = 100,
+        train_start_unrolls: int = 10,  # `train_apex.py:124` buffer_step gate
+        logger: MetricsLogger | None = None,
+        rng: jax.Array | None = None,
+        seed: int = 0,
+    ):
+        self.agent = agent
+        self.queue = queue
+        self.weights = weights
+        self.batch_size = batch_size
+        self.replay = PrioritizedReplay(replay_capacity)
+        self.target_sync_interval = target_sync_interval
+        self.train_start_unrolls = train_start_unrolls
+        self.logger = logger or MetricsLogger(None)
+        self.state = agent.init_state(rng if rng is not None else jax.random.PRNGKey(0))
+        self.state = agent.sync_target(self.state)
+        self._np_rng = np.random.RandomState(seed)
+        self.ingested_unrolls = 0
+        self.train_steps = 0
+        weights.publish(self.state.params, 0)
+
+    def ingest(self, timeout: float | None = 0.0) -> bool:
+        """Drain one unroll, score TD per transition, insert into replay
+        (`train_apex.py:98-122`)."""
+        unroll = self.queue.get(timeout=timeout)
+        if unroll is None:
+            return False
+        td = np.asarray(self.agent.td_error(self.state, unroll))
+        for i in range(len(td)):
+            self.replay.add(float(td[i]), jax.tree.map(lambda x: x[i], unroll))
+        self.ingested_unrolls += 1
+        return True
+
+    def train(self) -> dict | None:
+        """One prioritized train step (`train_apex.py:124-155`)."""
+        if self.ingested_unrolls < self.train_start_unrolls:
+            return None
+        items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
+        batch = stack_pytrees(items)
+        self.state, td, metrics = self.agent.learn(self.state, batch, is_weight)
+        self.replay.update_batch(idxs, np.asarray(td))
+        self.train_steps += 1
+        self.weights.publish(self.state.params, self.train_steps)
+        if self.train_steps % self.target_sync_interval == 0:
+            self.state = self.agent.sync_target(self.state)
+        metrics = {k: float(v) for k, v in metrics.items()}
+        self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
+        return metrics
+
+
+def run_sync(learner: ApexLearner, actors: list[ApexActor], num_updates: int,
+             actor_steps_per_round: int = 8) -> dict:
+    """Interleaved stepping for tests/single-host training."""
+    metrics: dict = {}
+    while learner.train_steps < num_updates:
+        for actor in actors:
+            actor.run_steps(actor_steps_per_round)
+        while learner.ingest(timeout=0.0):
+            pass
+        m = learner.train()
+        if m is not None:
+            metrics = m
+    returns = [r for a in actors for r in a.episode_returns]
+    return {"last_metrics": metrics, "episode_returns": returns}

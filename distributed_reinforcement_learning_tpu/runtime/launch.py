@@ -1,0 +1,108 @@
+"""Build-and-run helpers shared by the CLI launchers.
+
+Replaces the reference's per-launcher graph assembly
+(`train_impala.py:22-87` and analogues): resolves envs from the
+registry, instantiates agent + queue + weight store + learner + actors
+from a config section, and runs either the synchronous single-process
+loop or free-running threads. The multi-process topology (one learner
+process + N actor processes over the socket transport) layers on top in
+runtime/transport.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexConfig
+from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Config
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+from distributed_reinforcement_learning_tpu.envs.batched import BatchedEnv
+from distributed_reinforcement_learning_tpu.envs.cartpole import pomdp_project
+from distributed_reinforcement_learning_tpu.envs.registry import make_env
+from distributed_reinforcement_learning_tpu.runtime import apex_runner, impala_runner, r2d2_runner
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+from distributed_reinforcement_learning_tpu.utils.config import RuntimeConfig, load_config
+from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
+
+
+def _make_batched_env(rt: RuntimeConfig, actor_index: int, num_actions: int) -> BatchedEnv:
+    name = rt.envs[actor_index % len(rt.envs)]
+    n = rt.envs_per_actor
+    return BatchedEnv([
+        (lambda s=seed: make_env(name, seed=s, num_actions=num_actions))
+        for seed in range(actor_index * n, actor_index * n + n)
+    ])
+
+
+def _is_atari(rt: RuntimeConfig) -> bool:
+    return any("v4" in e for e in rt.envs)
+
+
+def build_local(agent_cfg: Any, rt: RuntimeConfig, run_dir: str | None = None, seed: int = 0):
+    """-> (learner, actors, queue) for single-host training."""
+    logger = MetricsLogger(run_dir)
+    queue = TrajectoryQueue(rt.queue_size)
+    weights = WeightStore()
+    rng = jax.random.PRNGKey(seed)
+    atari = _is_atari(rt)
+
+    if isinstance(agent_cfg, ImpalaConfig):
+        agent = ImpalaAgent(agent_cfg)
+        learner = impala_runner.ImpalaLearner(
+            agent, queue, weights, rt.batch_size, logger=logger, rng=rng)
+        actors = [
+            impala_runner.ImpalaActor(
+                agent, _make_batched_env(rt, i, agent_cfg.num_actions), queue, weights,
+                seed=seed + 1 + i,
+                available_action=rt.available_action[i % len(rt.available_action)],
+                life_loss_shaping=atari)
+            for i in range(rt.num_actors)
+        ]
+        run_fn = impala_runner.run_sync
+    elif isinstance(agent_cfg, ApexConfig):
+        agent = ApexAgent(agent_cfg)
+        learner = apex_runner.ApexLearner(
+            agent, queue, weights, rt.batch_size,
+            replay_capacity=rt.replay_capacity,
+            target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng)
+        actors = [
+            apex_runner.ApexActor(
+                agent, _make_batched_env(rt, i, agent_cfg.num_actions), queue, weights,
+                seed=seed + 1 + i, life_loss_shaping=atari)
+            for i in range(rt.num_actors)
+        ]
+        run_fn = apex_runner.run_sync
+    elif isinstance(agent_cfg, R2D2Config):
+        agent = R2D2Agent(agent_cfg)
+        learner = r2d2_runner.R2D2Learner(
+            agent, queue, weights, rt.batch_size,
+            replay_capacity=rt.replay_capacity,
+            target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng)
+        transform = pomdp_project if agent_cfg.obs_shape == (2,) else None
+        actors = [
+            r2d2_runner.R2D2Actor(
+                agent, _make_batched_env(rt, i, agent_cfg.num_actions), queue, weights,
+                seed=seed + 1 + i, obs_transform=transform)
+            for i in range(rt.num_actors)
+        ]
+        run_fn = r2d2_runner.run_sync
+    else:
+        raise TypeError(f"unknown agent config {type(agent_cfg)}")
+    return learner, actors, run_fn
+
+
+def train_local(config_path: str, section: str, num_updates: int,
+                run_dir: str | None = None, seed: int = 0) -> dict:
+    """Single-process training entry used by the CLI launchers."""
+    agent_cfg, rt = load_config(config_path, section)
+    learner, actors, run_fn = build_local(agent_cfg, rt, run_dir=run_dir, seed=seed)
+    result = run_fn(learner, actors, num_updates)
+    returns = result["episode_returns"]
+    if returns:
+        import numpy as np
+
+        result["mean_return_last20"] = float(np.mean(returns[-20:]))
+    return result

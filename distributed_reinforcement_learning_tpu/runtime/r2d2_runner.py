@@ -1,0 +1,181 @@
+"""R2D2 actor/learner loops.
+
+Re-design of `train_r2d2.py:86-238`:
+
+- `R2D2Actor`: N batched envs on the CartPole POMDP projection
+  (`train_r2d2.py:176-178`), per-env epsilon `1/(0.1*episode+1)`
+  (`train_r2d2.py:221`), seq_len unrolls carrying the sequence-start
+  LSTM state, per-unroll weight pull.
+- `R2D2Learner`: drains sequences, scores |mean TD| priorities
+  (`train_r2d2.py:100-119`), trains with IS weights once warm
+  (`:121-154`), updates ALL sampled priorities (fixing the `:159`
+  single-index bug), target sync every `target_sync_interval` steps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Batch
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
+from distributed_reinforcement_learning_tpu.data.replay import PrioritizedReplay
+from distributed_reinforcement_learning_tpu.data.structures import R2D2SequenceAccumulator
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
+
+
+class R2D2Actor:
+    def __init__(
+        self,
+        agent: R2D2Agent,
+        env,  # VectorEnv over full observations
+        queue: TrajectoryQueue,
+        weights: WeightStore,
+        seed: int = 0,
+        epsilon_decay: float = 0.1,  # `train_r2d2.py:221`
+        obs_transform=None,  # e.g. envs.cartpole.pomdp_project
+    ):
+        self.agent = agent
+        self.env = env
+        self.queue = queue
+        self.weights = weights
+        self.epsilon_decay = epsilon_decay
+        self.obs_transform = obs_transform or (lambda x: x)
+
+        self._rng = jax.random.PRNGKey(seed)
+        self._obs = self.obs_transform(env.reset())
+        n = self._obs.shape[0]
+        self._prev_action = np.zeros(n, np.int32)
+        h, c = agent.initial_lstm_state(n)
+        self._h, self._c = np.asarray(h), np.asarray(c)
+        self._episodes = np.zeros(n, np.int64)
+        self._params = None
+        self._version = -1
+        self.episode_returns: list[float] = []
+
+    @property
+    def epsilon(self) -> np.ndarray:
+        return 1.0 / (self.epsilon_decay * self._episodes + 1.0)
+
+    def _sync_params(self) -> None:
+        got = self.weights.get_if_newer(self._version)
+        if got is not None:
+            self._params, self._version = got
+
+    def run_unroll(self) -> int:
+        """One seq_len unroll from all envs -> N sequences into the queue."""
+        cfg = self.agent.cfg
+        self._sync_params()
+        if self._params is None:
+            raise RuntimeError("no weights published yet")
+        acc = R2D2SequenceAccumulator()
+        acc.reset(self._h, self._c)
+        n = self._obs.shape[0]
+
+        for _ in range(cfg.seq_len):
+            self._rng, sub = jax.random.split(self._rng)
+            action, _, h, c = self.agent.act(
+                self._params, self._obs, self._h, self._c, self._prev_action, self.epsilon, sub
+            )
+            action = np.asarray(action)
+            next_obs_raw, reward, done, infos = self.env.step(action)
+            next_obs = self.obs_transform(next_obs_raw)
+
+            acc.append(
+                state=self._obs,
+                previous_action=self._prev_action,
+                action=action,
+                reward=reward.astype(np.float32),
+                done=done,
+            )
+
+            keep = (~done).astype(np.float32)[:, None]
+            self._h = np.asarray(h) * keep
+            self._c = np.asarray(c) * keep
+            self._prev_action = np.where(done, 0, action).astype(np.int32)
+            self._obs = next_obs
+            self._episodes += done
+            for ret in infos.get("episode_return", [])[done]:
+                self.episode_returns.append(float(ret))
+
+        for seq in acc.extract():
+            self.queue.put(seq)
+        return n * cfg.seq_len
+
+
+class R2D2Learner:
+    def __init__(
+        self,
+        agent: R2D2Agent,
+        queue: TrajectoryQueue,
+        weights: WeightStore,
+        batch_size: int = 32,
+        replay_capacity: int = 100_000,
+        target_sync_interval: int = 100,
+        logger: MetricsLogger | None = None,
+        rng: jax.Array | None = None,
+        seed: int = 0,
+    ):
+        self.agent = agent
+        self.queue = queue
+        self.weights = weights
+        self.batch_size = batch_size
+        self.replay = PrioritizedReplay(replay_capacity)
+        self.target_sync_interval = target_sync_interval
+        self.logger = logger or MetricsLogger(None)
+        self.state = agent.init_state(rng if rng is not None else jax.random.PRNGKey(0))
+        self.state = agent.sync_target(self.state)
+        self._np_rng = np.random.RandomState(seed)
+        self.ingested_sequences = 0
+        self.train_steps = 0
+        weights.publish(self.state.params, 0)
+
+    def ingest_batch(self, timeout: float | None = 0.0) -> int:
+        """Drain up to batch_size sequences; priority-score them in ONE
+        batched td_error call (vs per-sequence `sess.run`s at
+        `train_r2d2.py:104-119`)."""
+        seqs = []
+        for _ in range(self.batch_size):
+            seq = self.queue.get(timeout=timeout)
+            if seq is None:
+                break
+            seqs.append(seq)
+        if not seqs:
+            return 0
+        batch = stack_pytrees(seqs)
+        td = np.asarray(self.agent.td_error(self.state, batch))
+        for i, seq in enumerate(seqs):
+            self.replay.add(float(td[i]), seq)
+        self.ingested_sequences += len(seqs)
+        return len(seqs)
+
+    def train(self) -> dict | None:
+        """One prioritized train step over sequences (`train_r2d2.py:121-164`)."""
+        if self.ingested_sequences < 2 * self.batch_size:  # `train_r2d2.py:121`
+            return None
+        items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
+        batch = stack_pytrees(items)
+        self.state, priorities, metrics = self.agent.learn(self.state, batch, is_weight)
+        self.replay.update_batch(idxs, np.asarray(priorities))
+        self.train_steps += 1
+        self.weights.publish(self.state.params, self.train_steps)
+        if self.train_steps % self.target_sync_interval == 0:
+            self.state = self.agent.sync_target(self.state)
+        metrics = {k: float(v) for k, v in metrics.items()}
+        self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
+        return metrics
+
+
+def run_sync(learner: R2D2Learner, actors: list[R2D2Actor], num_updates: int) -> dict:
+    metrics: dict = {}
+    while learner.train_steps < num_updates:
+        for actor in actors:
+            actor.run_unroll()
+        learner.ingest_batch(timeout=0.0)
+        m = learner.train()
+        if m is not None:
+            metrics = m
+    returns = [r for a in actors for r in a.episode_returns]
+    return {"last_metrics": metrics, "episode_returns": returns}

@@ -1,0 +1,51 @@
+"""Versioned weight publication: learner -> actors.
+
+Replaces the reference's cross-process `tf.assign` pulls
+(`utils.py:5-21`, run once per unroll at `train_impala.py:135`). The
+learner publishes a version-stamped params snapshot; actors poll
+`get_if_newer` at their unroll cadence. Same staleness semantics
+(actors may act on weights a few updates old — standard IMPALA
+off-policyness, corrected by V-trace), but publication is a single
+atomic reference swap instead of per-variable assigns.
+
+In-process this is shared memory; the transport server (runtime/transport)
+serves the same object over the wire to remote actors.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class WeightStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._params: Any = None
+        self._version: int = -1
+
+    def publish(self, params: Any, version: int) -> None:
+        """Store a host-side snapshot of `params` (device arrays -> numpy)."""
+        host_params = jax.tree.map(np.asarray, params)
+        with self._lock:
+            self._params = host_params
+            self._version = version
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def get(self) -> tuple[Any, int]:
+        with self._lock:
+            return self._params, self._version
+
+    def get_if_newer(self, have_version: int) -> tuple[Any, int] | None:
+        """None if the caller already holds the newest version."""
+        with self._lock:
+            if self._version <= have_version:
+                return None
+            return self._params, self._version

@@ -1,0 +1,6 @@
+"""Config, logging, checkpointing, profiling utilities (reference layer L5)."""
+
+from distributed_reinforcement_learning_tpu.utils.config import RuntimeConfig, check_config, load_config
+from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
+
+__all__ = ["RuntimeConfig", "check_config", "load_config", "MetricsLogger"]

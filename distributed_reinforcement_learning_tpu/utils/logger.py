@@ -1,0 +1,64 @@
+"""Metrics logging: JSONL scalar streams per run directory.
+
+Replaces the reference's tensorboardX `SummaryWriter` usage
+(`train_impala.py:91,109-113`): same add_scalar surface, but writes
+newline-delimited JSON records (`{"tag", "value", "step", "time"}`) that
+need no external dependency to read or plot. If tensorboardX is present
+it mirrors scalars there too.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import IO
+
+try:  # optional, absent in this image
+    from tensorboardX import SummaryWriter  # type: ignore
+except Exception:  # pragma: no cover
+    SummaryWriter = None
+
+
+class MetricsLogger:
+    def __init__(self, run_dir: str | Path | None, print_every: int = 0):
+        self._file: IO[str] | None = None
+        self._tb = None
+        self._print_every = print_every
+        self._counts: dict[str, int] = {}
+        if run_dir is not None:
+            path = Path(run_dir)
+            path.mkdir(parents=True, exist_ok=True)
+            self._file = (path / "metrics.jsonl").open("a")
+            if SummaryWriter is not None:
+                self._tb = SummaryWriter(str(path))
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        value = float(value)
+        if self._file is not None:
+            self._file.write(
+                json.dumps({"tag": tag, "value": value, "step": int(step), "time": time.time()})
+                + "\n"
+            )
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+        if self._print_every:
+            n = self._counts.get(tag, 0)
+            if n % self._print_every == 0:
+                print(f"[{tag}] step={step} {value:.4g}", flush=True)
+            self._counts[tag] = n + 1
+
+    def add_scalars(self, scalars: dict[str, float], step: int) -> None:
+        for tag, value in scalars.items():
+            self.add_scalar(tag, value, step)
+
+    def flush(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if self._tb is not None:
+            self._tb.close()

@@ -57,6 +57,16 @@ class TestTrajectoryQueue:
         t.join(timeout=5.0)
         assert [int(g["i"]) for g in got] == list(range(produced))
 
+    def test_get_batch_timeout_restores_items(self):
+        q = TrajectoryQueue(capacity=8)
+        q.put({"x": np.asarray(1)})
+        q.put({"x": np.asarray(2)})
+        assert q.get_batch(4, timeout=0.05) is None  # not enough items
+        # The two dequeued items went back in order.
+        assert q.size() == 2
+        assert int(q.get()["x"]) == 1
+        assert int(q.get()["x"]) == 2
+
     def test_close_unblocks_consumer(self):
         q = TrajectoryQueue(capacity=2)
         result = {}

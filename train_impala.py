@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""IMPALA launcher.
+
+TPU-native counterpart of the reference's `train_impala.py`:
+
+- `--mode local` (default): single-process actor/learner training, the
+  reference's whole localhost cluster collapsed into one process.
+- `--mode learner` / `--mode actor --task k`: multi-process topology over
+  the socket transport (reference: `tf.train.Server` + shared FIFOQueue,
+  `train_impala.py:31-46`).
+
+Examples:
+    python train_impala.py --section impala_cartpole --updates 300
+    python train_impala.py --mode learner &
+    python train_impala.py --mode actor --task 0 &
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="config.json")
+    p.add_argument("--section", default="impala")
+    p.add_argument("--mode", default="local", choices=["local", "learner", "actor"])
+    p.add_argument("--task", type=int, default=-1, help="actor index (actor mode)")
+    p.add_argument("--updates", type=int, default=1000)
+    p.add_argument("--run_dir", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. 'cpu'); actors default to cpu "
+                        "so they never grab the TPU chip")
+    args = p.parse_args()
+
+    platform = args.platform or ("cpu" if args.mode == "actor" else None)
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+    if args.mode == "local":
+        from distributed_reinforcement_learning_tpu.runtime.launch import train_local
+
+        result = train_local(args.config, args.section, args.updates,
+                             run_dir=args.run_dir, seed=args.seed)
+        print({k: v for k, v in result.items() if k != "episode_returns"})
+    else:
+        from distributed_reinforcement_learning_tpu.runtime.transport import run_role
+
+        run_role("impala", args.config, args.section, args.mode, args.task,
+                 num_updates=args.updates, run_dir=args.run_dir, seed=args.seed)
+
+
+if __name__ == "__main__":
+    main()
