@@ -1,0 +1,77 @@
+"""Headline benchmark: IMPALA learner throughput in env-frames/sec.
+
+Measures the jitted learn step (stored-state [B,T] forward + double
+V-trace + RMSProp) on the reference's own Atari config — 84x84x4 uint8
+frames, T=20 unrolls, batch 32 (`config.json:25-67`) — and reports
+env-frames consumed per second against the BASELINE.md north-star of
+50,000 frames/s/chip.
+
+Prints ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+import jax
+import jax.numpy as jnp
+
+
+def _make_batch(cfg, B: int):
+    from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_impala_batch
+
+    return synthetic_impala_batch(
+        B, cfg.trajectory, cfg.obs_shape, cfg.num_actions, cfg.lstm_size,
+        uniform_behavior=False,
+    )
+
+
+def main() -> None:
+    from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+
+    platform = jax.default_backend()
+    on_accel = platform not in ("cpu",)
+    # bfloat16 compute on TPU keeps the matmuls on the MXU's fast path.
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+    B = int(os.environ.get("BENCH_BATCH", "32"))
+    iters = int(os.environ.get("BENCH_ITERS", "30" if on_accel else "3"))
+
+    cfg = ImpalaConfig(dtype=dtype)
+    agent = ImpalaAgent(cfg)
+    state = agent.init_state(jax.random.PRNGKey(0))
+    batch = jax.device_put(jax.tree.map(jnp.asarray, _make_batch(cfg, B)))
+
+    t0 = time.perf_counter()
+    state, metrics = agent.learn(state, batch)  # compile + 1 step
+    jax.block_until_ready(state)
+    print(f"[bench] {platform} compile+first step {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = agent.learn(state, batch)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - start
+
+    frames_per_s = B * cfg.trajectory * iters / dt
+    print(
+        f"[bench] {iters} steps in {dt:.3f}s, loss={float(metrics['total_loss']):.4f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "impala_learn_env_frames_per_s",
+                "value": round(frames_per_s, 1),
+                "unit": "frames/s",
+                "vs_baseline": round(frames_per_s / 50_000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

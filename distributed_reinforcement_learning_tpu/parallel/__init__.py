@@ -1,0 +1,23 @@
+from distributed_reinforcement_learning_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    make_mesh,
+    model_kernel_sharding,
+    replicated,
+)
+from distributed_reinforcement_learning_tpu.parallel.learner import (
+    ShardedLearner,
+    train_state_sharding,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "ShardedLearner",
+    "data_sharding",
+    "make_mesh",
+    "model_kernel_sharding",
+    "replicated",
+    "train_state_sharding",
+]

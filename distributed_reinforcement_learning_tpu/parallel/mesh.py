@@ -1,0 +1,65 @@
+"""Device mesh construction and named shardings.
+
+The reference has no multi-device learner at all — one `/job:learner/task:0`
+process owns the weights (`train_impala.py:33,37`), and "distributed" means
+actor processes over gRPC. The TPU-native generalization (SURVEY §2.3, §5.8)
+is a learner spanning a `jax.sharding.Mesh` of chips:
+
+- `data` axis: batch-dimension data parallelism. Params replicated (or
+  model-sharded, below), batch split; XLA inserts the gradient `psum` over
+  ICI automatically because the output params must be consistent.
+- `model` axis: optional tensor parallelism for large kernels (LSTM and
+  head matmuls sharded on their output feature dim, Megatron column style).
+  Size 1 by default — the reference-parity configs are small enough that
+  DP is the only axis that pays.
+
+Everything here is plain `jax.sharding`; no torch-style process groups.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    model_parallel: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a `(data, model)` mesh over the first `n_devices` devices.
+
+    `model_parallel` chips are adjacent in device order so the model axis
+    rides the fastest ICI links on real TPU topologies.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devices)} visible; "
+                "set XLA_FLAGS=--xla_force_host_platform_device_count=N for CPU simulation"
+            )
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % model_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    arr = np.array(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over the `data` axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def model_kernel_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard a kernel's last (output-feature) dim over the `model` axis."""
+    return NamedSharding(mesh, P(*([None] * (ndim - 1)), MODEL_AXIS))

@@ -1,0 +1,43 @@
+"""Synthetic batch builders shared by bench.py, __graft_entry__.py and tests.
+
+One parameterized constructor per batch type so a field change in the
+agents' Batch NamedTuples breaks every consumer at the same place.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synthetic_impala_batch(
+    B: int,
+    T: int,
+    obs_shape: tuple[int, ...],
+    num_actions: int,
+    lstm_size: int,
+    seed: int = 0,
+    obs_dtype=np.uint8,
+    uniform_behavior: bool = True,
+):
+    """Random ImpalaBatch ([B, T] unrolls with actor-recorded LSTM state)."""
+    from distributed_reinforcement_learning_tpu.agents.impala import ImpalaBatch
+
+    rng = np.random.default_rng(seed)
+    if np.issubdtype(obs_dtype, np.integer):
+        state = rng.integers(0, 255, (B, T, *obs_shape)).astype(obs_dtype)
+    else:
+        state = rng.random((B, T, *obs_shape), dtype=np.float32)
+    if uniform_behavior:
+        behavior = np.full((B, T, num_actions), 1.0 / num_actions, np.float32)
+    else:
+        behavior = rng.dirichlet(np.ones(num_actions), (B, T)).astype(np.float32)
+    return ImpalaBatch(
+        state=state,
+        reward=rng.random((B, T), dtype=np.float32),
+        action=rng.integers(0, num_actions, (B, T)).astype(np.int32),
+        done=rng.random((B, T)) < 0.05,
+        behavior_policy=behavior,
+        previous_action=rng.integers(0, num_actions, (B, T)).astype(np.int32),
+        initial_h=(rng.standard_normal((B, T, lstm_size)) * 0.1).astype(np.float32),
+        initial_c=(rng.standard_normal((B, T, lstm_size)) * 0.1).astype(np.float32),
+    )
