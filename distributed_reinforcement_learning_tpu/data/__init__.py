@@ -2,9 +2,11 @@
 
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
 from distributed_reinforcement_learning_tpu.data.replay import (
+    NativePrioritizedReplay,
     PrioritizedReplay,
     SumTree,
     UniformBuffer,
+    make_replay,
 )
 from distributed_reinforcement_learning_tpu.data.structures import (
     ImpalaTrajectoryAccumulator,
@@ -16,6 +18,8 @@ __all__ = [
     "TrajectoryQueue",
     "stack_pytrees",
     "PrioritizedReplay",
+    "NativePrioritizedReplay",
+    "make_replay",
     "SumTree",
     "UniformBuffer",
     "ImpalaTrajectoryAccumulator",

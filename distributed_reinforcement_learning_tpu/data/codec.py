@@ -1,0 +1,117 @@
+"""Zero-copy-ish pytree <-> bytes codec for the native queue and the wire.
+
+The reference never serializes — TF's FIFOQueue kernel moves tensors
+through its own gRPC runtime (`distributed_queue/buffer_queue.py:28-36`).
+Our data plane is explicit: a trajectory pytree of numpy arrays is packed
+into one contiguous blob (header + raw array bytes) that the C++ ring
+queue and the TCP transport move without touching Python object graphs.
+
+Layout: [u32 magic][u32 header_len][header json][payload]
+  header = {"treedef": ..., "arrays": [{"dtype","shape","offset","nbytes"}]}
+Payload arrays are C-contiguous raw bytes at 64-byte aligned offsets (so
+a reader can np.frombuffer without copies and downstream device DMA sees
+aligned hosts buffers).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import namedtuple
+from functools import lru_cache
+from typing import Any
+
+import numpy as np
+
+
+@lru_cache(maxsize=None)
+def _namedtuple_cls(name: str, fields: tuple[str, ...]):
+    return namedtuple(name, fields)
+
+_MAGIC = 0x445254A1  # "DRT" + version 1
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _flatten(tree: Any, path: str, out: list[tuple[str, np.ndarray]]) -> Any:
+    """Flatten nested dict/list/tuple/namedtuple of arrays; return skeleton."""
+    if isinstance(tree, dict):
+        return {k: _flatten(v, f"{path}.{k}", out) for k, v in sorted(tree.items())}
+    if hasattr(tree, "_fields"):  # namedtuple
+        vals = {f: _flatten(getattr(tree, f), f"{path}.{f}", out) for f in tree._fields}
+        return {"__namedtuple__": type(tree).__name__, "fields": vals}
+    if isinstance(tree, (list, tuple)):
+        kind = "list" if isinstance(tree, list) else "tuple"
+        return {
+            "__seq__": kind,
+            "items": [_flatten(v, f"{path}[{i}]", out) for i, v in enumerate(tree)],
+        }
+    arr = np.asarray(tree)
+    if arr.ndim and not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)  # 0-d stays 0-d (ascontiguousarray would promote it)
+    out.append((path, arr))
+    return {"__leaf__": len(out) - 1}
+
+
+def _unflatten(skel: Any, arrays: list[np.ndarray]) -> Any:
+    if isinstance(skel, dict):
+        if "__leaf__" in skel:
+            return arrays[skel["__leaf__"]]
+        if "__seq__" in skel:
+            items = [_unflatten(v, arrays) for v in skel["items"]]
+            return items if skel["__seq__"] == "list" else tuple(items)
+        if "__namedtuple__" in skel:
+            # Rebuilt as a structurally-equal namedtuple (same type name and
+            # fields) so consumers' attribute access keeps working after a
+            # queue/wire round trip.
+            fields = skel["fields"]
+            cls = _namedtuple_cls(skel["__namedtuple__"], tuple(fields))
+            return cls(**{k: _unflatten(v, arrays) for k, v in fields.items()})
+        return {k: _unflatten(v, arrays) for k, v in skel.items()}
+    raise ValueError(f"corrupt skeleton node: {skel!r}")
+
+
+def encode(tree: Any) -> bytes:
+    """Pack a pytree of numpy arrays into one contiguous blob."""
+    leaves: list[tuple[str, np.ndarray]] = []
+    skel = _flatten(tree, "$", leaves)
+    metas = []
+    offset = 0
+    for _, arr in leaves:
+        offset = _align(offset)
+        metas.append(
+            {"dtype": arr.dtype.str, "shape": list(arr.shape), "offset": offset}
+        )
+        offset += arr.nbytes
+    header = json.dumps({"skel": skel, "arrays": metas}).encode()
+    payload_start = _align(8 + len(header))
+    total = payload_start + offset
+    buf = bytearray(total)
+    buf[0:4] = _MAGIC.to_bytes(4, "little")
+    buf[4:8] = len(header).to_bytes(4, "little")
+    buf[8 : 8 + len(header)] = header
+    for meta, (_, arr) in zip(metas, leaves):
+        start = payload_start + meta["offset"]
+        buf[start : start + arr.nbytes] = arr.tobytes()
+    return bytes(buf)
+
+
+def decode(blob: bytes | memoryview, copy: bool = False) -> Any:
+    """Unpack a blob; arrays view the blob unless copy=True."""
+    view = memoryview(blob)
+    if int.from_bytes(view[0:4], "little") != _MAGIC:
+        raise ValueError("bad magic: not a codec blob")
+    header_len = int.from_bytes(view[4:8], "little")
+    header = json.loads(bytes(view[8 : 8 + header_len]))
+    payload_start = _align(8 + header_len)
+    arrays = []
+    for meta in header["arrays"]:
+        dtype = np.dtype(meta["dtype"])
+        shape = tuple(meta["shape"])
+        nbytes = dtype.itemsize * int(np.prod(shape)) if shape else dtype.itemsize
+        start = payload_start + meta["offset"]
+        arr = np.frombuffer(view[start : start + nbytes], dtype=dtype).reshape(shape)
+        arrays.append(arr.copy() if copy else arr)
+    return _unflatten(header["skel"], arrays)

@@ -1,0 +1,322 @@
+"""ctypes bindings for the C++ data plane (cpp/ring_queue.cc, sumtree.cc).
+
+Builds the shared library on first import if missing or stale (g++ is in
+the image; pybind11 is not, so the ABI is plain C + ctypes). Public:
+
+- `NativeByteQueue` — bounded MPMC blob queue (the reference's
+  tf.FIFOQueue kernel role, SURVEY §2.2 E3), backpressure included.
+- `NativeTrajectoryQueue` — same interface as `fifo.TrajectoryQueue`
+  (put/get/get_batch/size/close) but pytrees cross through the C++
+  queue as codec blobs; `put_bytes` lets the transport server enqueue
+  wire payloads without a decode/encode round trip.
+- `NativeSumTree` — batch add/sample/update priority tree
+  (SURVEY §2.2 E7); payloads stay in Python.
+
+`native_available()` gates tests and fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.data import codec
+from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
+
+_CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "build", "libdistrl_native.so")
+_SOURCES = ("ring_queue.cc", "sumtree.cc")
+
+_RQ_OK, _RQ_TIMEOUT, _RQ_CLOSED, _RQ_TOO_SMALL = 0, -1, -2, -3
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: str | None = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_CPP_DIR, s)) > lib_mtime for s in _SOURCES
+    )
+
+
+def _build() -> None:
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O2", "-std=c++17", "-fPIC", "-shared",
+        "-o", _LIB_PATH,
+        *[os.path.join(_CPP_DIR, s) for s in _SOURCES],
+        "-lpthread",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _load():
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise RuntimeError(f"native library unavailable: {_build_error}")
+        try:
+            if _needs_build():
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (subprocess.CalledProcessError, OSError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _build_error = detail
+            raise RuntimeError(f"native library unavailable: {detail}") from e
+
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        sigs = {
+            "rq_create": ([ctypes.c_int64], ctypes.c_void_p),
+            "rq_destroy": ([ctypes.c_void_p], None),
+            "rq_size": ([ctypes.c_void_p], ctypes.c_int64),
+            "rq_close": ([ctypes.c_void_p], None),
+            "rq_put": ([ctypes.c_void_p, u8p, ctypes.c_int64, ctypes.c_double], ctypes.c_int64),
+            "rq_peek_size": ([ctypes.c_void_p, ctypes.c_double], ctypes.c_int64),
+            "rq_get": ([ctypes.c_void_p, u8p, ctypes.c_int64, ctypes.c_double], ctypes.c_int64),
+            "rq_get_batch": (
+                [ctypes.c_void_p, ctypes.c_int64, u8p, ctypes.c_int64, i64p, ctypes.c_double],
+                ctypes.c_int64,
+            ),
+            "st_create": ([ctypes.c_int64], ctypes.c_void_p),
+            "st_destroy": ([ctypes.c_void_p], None),
+            "st_total": ([ctypes.c_void_p], ctypes.c_double),
+            "st_size": ([ctypes.c_void_p], ctypes.c_int64),
+            "st_leaf_priority": ([ctypes.c_void_p, ctypes.c_int64], ctypes.c_double),
+            "st_add_batch": ([ctypes.c_void_p, f64p, ctypes.c_int64, i64p], None),
+            "st_update_batch": ([ctypes.c_void_p, i64p, f64p, ctypes.c_int64], None),
+            "st_get_batch": ([ctypes.c_void_p, f64p, ctypes.c_int64, i64p, f64p], None),
+        }
+        for name, (argtypes, restype) in sigs.items():
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = restype
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+def _as_u8p(buf) -> Any:
+    return (ctypes.c_uint8 * len(buf)).from_buffer(buf) if isinstance(buf, bytearray) else \
+        ctypes.cast(ctypes.c_char_p(buf), ctypes.POINTER(ctypes.c_uint8))
+
+
+class NativeByteQueue:
+    """Bounded MPMC queue of byte blobs backed by cpp/ring_queue.cc."""
+
+    def __init__(self, capacity: int):
+        self._lib = _load()
+        self._h = self._lib.rq_create(capacity)
+        if not self._h:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+
+    def __len__(self) -> int:
+        return int(self._lib.rq_size(self._h))
+
+    def size(self) -> int:
+        return len(self)
+
+    def close(self) -> None:
+        self._lib.rq_close(self._h)
+
+    def put(self, blob: bytes, timeout: float | None = None) -> bool:
+        rc = self._lib.rq_put(
+            self._h, _as_u8p(blob), len(blob), -1.0 if timeout is None else timeout
+        )
+        if rc == _RQ_CLOSED:
+            raise RuntimeError("queue closed")
+        return rc == _RQ_OK
+
+    def peek_size(self, timeout: float | None = None) -> int | None:
+        size = self._lib.rq_peek_size(self._h, -1.0 if timeout is None else timeout)
+        return None if size < 0 else int(size)
+
+    def get(self, timeout: float | None = None) -> bytes | None:
+        # `timeout` is a total deadline across the peek + pop (+ regrow) calls.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        remaining = lambda: -1.0 if deadline is None else max(0.0, deadline - time.monotonic())
+        size = self._lib.rq_peek_size(self._h, remaining())
+        if size < 0:
+            return None
+        buf = bytearray(int(size) + 256)  # slack: a racing consumer may swap heads
+        while True:
+            n = self._lib.rq_get(self._h, _as_u8p(buf), len(buf), remaining())
+            if n == _RQ_TOO_SMALL:
+                size = self._lib.rq_peek_size(self._h, remaining())
+                if size < 0:
+                    return None
+                buf = bytearray(int(size) + 256)
+                continue
+            if n < 0:
+                return None
+            return bytes(buf[: int(n)])
+
+    def get_batch_blobs(self, n: int, item_cap: int, timeout: float | None = None):
+        """Pop n blobs in ONE native call; None on timeout (nothing consumed).
+
+        If an item exceeds `item_cap`, the stride doubles and the call
+        retries within the same deadline (rather than masquerading as a
+        timeout and livelocking the caller).
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        lens = np.zeros(n, np.int64)
+        while True:
+            buf = bytearray(n * item_cap)
+            rc = self._lib.rq_get_batch(
+                self._h,
+                n,
+                _as_u8p(buf),
+                item_cap,
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                -1.0 if deadline is None else max(0.0, deadline - time.monotonic()),
+            )
+            if rc == _RQ_TOO_SMALL:
+                item_cap *= 2
+                continue
+            if rc != _RQ_OK:
+                return None
+            view = memoryview(buf)
+            return [view[i * item_cap : i * item_cap + int(lens[i])] for i in range(n)]
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.rq_destroy(self._h)
+            self._h = None
+
+
+class NativeTrajectoryQueue:
+    """`fifo.TrajectoryQueue` interface over the C++ byte queue.
+
+    Pytrees are codec-encoded on put and decoded on get; the transport
+    server can `put_bytes` wire payloads directly (no re-serialize). The
+    blob size of the first item fixes the batch-dequeue stride, so all
+    trajectories in one queue must share a schema — true by construction
+    (fixed unroll shapes, like the reference's fixed-shape placeholders at
+    `buffer_queue.py:40-50`).
+    """
+
+    def __init__(self, capacity: int):
+        self._q = NativeByteQueue(capacity)
+        self.capacity = capacity
+        self._item_cap = 0  # learned from the first put
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def size(self) -> int:
+        return len(self._q)
+
+    def close(self) -> None:
+        self._q.close()
+
+    def put(self, item: Any, timeout: float | None = None) -> bool:
+        return self.put_bytes(codec.encode(item), timeout)
+
+    def put_bytes(self, blob: bytes, timeout: float | None = None) -> bool:
+        if len(blob) > self._item_cap:
+            self._item_cap = len(blob)
+        return self._q.put(blob, timeout)
+
+    def get(self, timeout: float | None = None) -> Any | None:
+        blob = self._q.get(timeout)
+        return None if blob is None else codec.decode(blob, copy=True)
+
+    def get_batch(self, batch_size: int, timeout: float | None = None) -> Any | None:
+        item_cap = self._item_cap
+        if item_cap == 0:
+            # Nothing put through *this* wrapper yet (e.g. learner polling at
+            # startup, or a fresh wrapper over a shared queue): size the
+            # stride from the head item instead of guessing.
+            head = self._q.peek_size(timeout)
+            if head is None:
+                return None
+            item_cap = head + 256
+        blobs = self._q.get_batch_blobs(batch_size, item_cap, timeout)
+        if blobs is None:
+            return None
+        return stack_pytrees([codec.decode(b) for b in blobs])
+
+
+class NativeSumTree:
+    """Priority tree backed by cpp/sumtree.cc; same surface as replay.SumTree
+    plus batch entry points. Data payloads live in the Python caller."""
+
+    def __init__(self, capacity: int):
+        self._lib = _load()
+        self._h = self._lib.st_create(capacity)
+        if not self._h:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+
+    def __len__(self) -> int:
+        return int(self._lib.st_size(self._h))
+
+    @property
+    def total(self) -> float:
+        return float(self._lib.st_total(self._h))
+
+    def leaf_priority(self, tree_idx: int) -> float:
+        return float(self._lib.st_leaf_priority(self._h, tree_idx))
+
+    def add_batch(self, priorities: np.ndarray) -> np.ndarray:
+        """Returns the data slots written (tree idx = slot + capacity - 1)."""
+        p = np.ascontiguousarray(priorities, np.float64)
+        out = np.empty(len(p), np.int64)
+        self._lib.st_add_batch(
+            self._h,
+            p.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(p),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return out
+
+    def update_batch(self, tree_idxs: np.ndarray, priorities: np.ndarray) -> None:
+        i = np.ascontiguousarray(tree_idxs, np.int64)
+        p = np.ascontiguousarray(priorities, np.float64)
+        self._lib.st_update_batch(
+            self._h,
+            i.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            p.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(i),
+        )
+
+    def get_batch(self, values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Subtractive descent per value -> (tree_idxs, priorities)."""
+        v = np.ascontiguousarray(values, np.float64)
+        idxs = np.empty(len(v), np.int64)
+        prios = np.empty(len(v), np.float64)
+        self._lib.st_get_batch(
+            self._h,
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(v),
+            idxs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            prios.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        )
+        return idxs, prios
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.st_destroy(self._h)
+            self._h = None

@@ -15,6 +15,7 @@ A C++ backend (cpp/sumtree) plugs in behind the same interface.
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 import numpy as np
@@ -133,6 +134,103 @@ class PrioritizedReplay:
         """Re-prioritize every sampled index (fixes `train_r2d2.py:159`)."""
         for idx, err in zip(idxs, errors):
             self.update(int(idx), float(err))
+
+
+class NativePrioritizedReplay:
+    """`PrioritizedReplay` surface over the C++ SumTree (cpp/sumtree.cc).
+
+    Same priority/IS-weight math; tree walks and priority propagation run
+    in native code via batch FFI calls (one call per batch, not one per
+    element — the learner-host hotspot of SURVEY §2.2 E7). Payloads stay
+    in a Python slot list aligned with the native write cursor.
+    """
+
+    EPS = PrioritizedReplay.EPS
+    ALPHA = PrioritizedReplay.ALPHA
+    BETA_INCREMENT = PrioritizedReplay.BETA_INCREMENT
+
+    def __init__(self, capacity: int, beta: float = 0.4):
+        from distributed_reinforcement_learning_tpu.data.native import NativeSumTree
+
+        self.tree = NativeSumTree(capacity)
+        self.beta = beta
+        self._data: list[Any] = [None] * capacity
+        # Guards the slot-reserve (native) + payload-write (Python) pair so a
+        # threaded ingest can't expose a priority whose payload isn't stored
+        # yet (or has been wrapped over) to a concurrent sample().
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.tree)
+
+    def _priority(self, errors) -> np.ndarray:
+        return (np.abs(np.asarray(errors, np.float64)) + self.EPS) ** self.ALPHA
+
+    def add(self, error: float, sample: Any) -> int:
+        return self.add_batch(np.array([error]), [sample])[0]
+
+    def add_batch(self, errors: np.ndarray, samples: list[Any]) -> list[int]:
+        with self._lock:
+            slots = self.tree.add_batch(self._priority(errors))
+            for slot, s in zip(slots, samples):
+                self._data[slot] = s
+            return [int(s) + self.tree.capacity - 1 for s in slots]
+
+    def sample(self, n: int, rng: np.random.RandomState | None = None):
+        with self._lock:
+            return self._sample_locked(n, rng)
+
+    def _sample_locked(self, n: int, rng):
+        rng = rng or np.random
+        self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
+        segment = self.tree.total / n
+        lo = segment * np.arange(n)
+        idxs = np.empty(n, np.int64)
+        priorities = np.empty(n, np.float64)
+        filled = np.zeros(n, bool)
+        cap = self.tree.capacity
+        # Same retry-then-fallback policy as the Python impl: rounding in
+        # the descent can land on unwritten leaves while partially filled.
+        for _ in range(4):
+            todo = np.flatnonzero(~filled)
+            if todo.size == 0:
+                break
+            values = lo[todo] + rng.uniform(0.0, segment, size=todo.size)
+            got_idx, got_p = self.tree.get_batch(values)
+            ok = np.array([self._data[int(i) - (cap - 1)] is not None for i in got_idx])
+            hit = todo[ok]
+            idxs[hit] = got_idx[ok]
+            priorities[hit] = got_p[ok]
+            filled[hit] = True
+        for i in np.flatnonzero(~filled):
+            leaf = int(rng.randint(0, len(self.tree)))
+            idxs[i] = leaf + cap - 1
+            priorities[i] = self.tree.leaf_priority(int(idxs[i]))
+        items = [self._data[int(i) - (cap - 1)] for i in idxs]
+        probs = priorities / self.tree.total
+        weights = np.power(len(self.tree) * probs, -self.beta)
+        weights /= weights.max()
+        return items, idxs, weights.astype(np.float32)
+
+    def update(self, idx: int, error: float) -> None:
+        self.update_batch(np.array([idx]), np.array([error]))
+
+    def update_batch(self, idxs: np.ndarray, errors: np.ndarray) -> None:
+        self.tree.update_batch(np.asarray(idxs, np.int64), self._priority(errors))
+
+
+def make_replay(capacity: int, beta: float = 0.4, backend: str = "auto"):
+    """Pick the replay implementation: 'python', 'native', or 'auto'."""
+    if backend == "python":
+        return PrioritizedReplay(capacity, beta)
+    if backend == "native":
+        return NativePrioritizedReplay(capacity, beta)
+    if backend == "auto":
+        from distributed_reinforcement_learning_tpu.data.native import native_available
+
+        cls = NativePrioritizedReplay if native_available() else PrioritizedReplay
+        return cls(capacity, beta)
+    raise ValueError(f"unknown replay backend {backend!r}")
 
 
 class UniformBuffer:

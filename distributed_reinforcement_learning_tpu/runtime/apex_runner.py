@@ -22,7 +22,7 @@ import jax
 
 from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexBatch
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
-from distributed_reinforcement_learning_tpu.data.replay import PrioritizedReplay, UniformBuffer
+from distributed_reinforcement_learning_tpu.data.replay import UniformBuffer, make_replay
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
 
@@ -140,7 +140,7 @@ class ApexLearner:
         self.queue = queue
         self.weights = weights
         self.batch_size = batch_size
-        self.replay = PrioritizedReplay(replay_capacity)
+        self.replay = make_replay(replay_capacity)
         self.target_sync_interval = target_sync_interval
         self.train_start_unrolls = train_start_unrolls
         self.logger = logger or MetricsLogger(None)
@@ -158,8 +158,9 @@ class ApexLearner:
         if unroll is None:
             return False
         td = np.asarray(self.agent.td_error(self.state, unroll))
-        for i in range(len(td)):
-            self.replay.add(float(td[i]), jax.tree.map(lambda x: x[i], unroll))
+        self.replay.add_batch(
+            td, [jax.tree.map(lambda x: x[i], unroll) for i in range(len(td))]
+        )
         self.ingested_unrolls += 1
         return True
 

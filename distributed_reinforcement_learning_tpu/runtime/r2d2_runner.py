@@ -20,7 +20,7 @@ import jax
 
 from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Batch
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
-from distributed_reinforcement_learning_tpu.data.replay import PrioritizedReplay
+from distributed_reinforcement_learning_tpu.data.replay import make_replay
 from distributed_reinforcement_learning_tpu.data.structures import R2D2SequenceAccumulator
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
@@ -122,7 +122,7 @@ class R2D2Learner:
         self.queue = queue
         self.weights = weights
         self.batch_size = batch_size
-        self.replay = PrioritizedReplay(replay_capacity)
+        self.replay = make_replay(replay_capacity)
         self.target_sync_interval = target_sync_interval
         self.logger = logger or MetricsLogger(None)
         self.state = agent.init_state(rng if rng is not None else jax.random.PRNGKey(0))
@@ -146,8 +146,7 @@ class R2D2Learner:
             return 0
         batch = stack_pytrees(seqs)
         td = np.asarray(self.agent.td_error(self.state, batch))
-        for i, seq in enumerate(seqs):
-            self.replay.add(float(td[i]), seq)
+        self.replay.add_batch(td, seqs)
         self.ingested_sequences += len(seqs)
         return len(seqs)
 
