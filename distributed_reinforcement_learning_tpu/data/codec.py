@@ -73,8 +73,14 @@ def _unflatten(skel: Any, arrays: list[np.ndarray]) -> Any:
     raise ValueError(f"corrupt skeleton node: {skel!r}")
 
 
-def encode(tree: Any) -> bytes:
-    """Pack a pytree of numpy arrays into one contiguous blob."""
+def encode(tree: Any) -> bytearray:
+    """Pack a pytree of numpy arrays into one contiguous blob.
+
+    Returns a bytearray (bytes-like everywhere it's consumed) and writes
+    each array exactly once via buffer assignment — the hot path moves
+    every trajectory and every weight snapshot, so no intermediate
+    `tobytes()` copies and no final `bytes()` copy.
+    """
     leaves: list[tuple[str, np.ndarray]] = []
     skel = _flatten(tree, "$", leaves)
     metas = []
@@ -92,10 +98,11 @@ def encode(tree: Any) -> bytes:
     buf[0:4] = _MAGIC.to_bytes(4, "little")
     buf[4:8] = len(header).to_bytes(4, "little")
     buf[8 : 8 + len(header)] = header
+    view = memoryview(buf)
     for meta, (_, arr) in zip(metas, leaves):
         start = payload_start + meta["offset"]
-        buf[start : start + arr.nbytes] = arr.tobytes()
-    return bytes(buf)
+        view[start : start + arr.nbytes] = memoryview(arr.reshape(-1)).cast("B")
+    return buf
 
 
 def decode(blob: bytes | memoryview, copy: bool = False) -> Any:

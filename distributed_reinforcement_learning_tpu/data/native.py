@@ -51,14 +51,23 @@ def _needs_build() -> bool:
 
 def _build() -> None:
     os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    # Compile to a per-process temp path and rename into place: run_role
+    # launches learner + N actor processes at once, and a partially written
+    # .so must never be CDLL'd by a sibling.
+    tmp = f"{_LIB_PATH}.{os.getpid()}"
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O2", "-std=c++17", "-fPIC", "-shared",
-        "-o", _LIB_PATH,
+        "-o", tmp,
         *[os.path.join(_CPP_DIR, s) for s in _SOURCES],
         "-lpthread",
     ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB_PATH)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _load():
@@ -244,16 +253,21 @@ class NativeTrajectoryQueue:
         return None if blob is None else codec.decode(blob, copy=True)
 
     def get_batch(self, batch_size: int, timeout: float | None = None) -> Any | None:
+        deadline = None if timeout is None else time.monotonic() + timeout
         item_cap = self._item_cap
         if item_cap == 0:
             # Nothing put through *this* wrapper yet (e.g. learner polling at
             # startup, or a fresh wrapper over a shared queue): size the
-            # stride from the head item instead of guessing.
+            # stride from the head item instead of guessing. Shares the one
+            # total deadline with the batch pop below.
             head = self._q.peek_size(timeout)
             if head is None:
                 return None
             item_cap = head + 256
-        blobs = self._q.get_batch_blobs(batch_size, item_cap, timeout)
+        remaining = (
+            None if deadline is None else max(0.0, deadline - time.monotonic())
+        )
+        blobs = self._q.get_batch_blobs(batch_size, item_cap, remaining)
         if blobs is None:
             return None
         return stack_pytrees([codec.decode(b) for b in blobs])

@@ -41,57 +41,82 @@ def _is_atari(rt: RuntimeConfig) -> bool:
     return any("v4" in e for e in rt.envs)
 
 
+def _algo_of(agent_cfg: Any) -> str:
+    if isinstance(agent_cfg, ImpalaConfig):
+        return "impala"
+    if isinstance(agent_cfg, ApexConfig):
+        return "apex"
+    if isinstance(agent_cfg, R2D2Config):
+        return "r2d2"
+    raise TypeError(f"unknown agent config {type(agent_cfg)}")
+
+
+_AGENT_CLS = {"impala": ImpalaAgent, "apex": ApexAgent, "r2d2": R2D2Agent}
+
+
+def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
+                 logger: MetricsLogger | None = None, rng: Any = None, agent=None):
+    """Learner runner over any queue/weight-store (in-process or served)."""
+    agent = agent or _AGENT_CLS[algo](agent_cfg)
+    if algo == "impala":
+        return impala_runner.ImpalaLearner(
+            agent, queue, weights, rt.batch_size, logger=logger, rng=rng)
+    if algo == "apex":
+        return apex_runner.ApexLearner(
+            agent, queue, weights, rt.batch_size,
+            replay_capacity=rt.replay_capacity,
+            target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng)
+    return r2d2_runner.R2D2Learner(
+        agent, queue, weights, rt.batch_size,
+        replay_capacity=rt.replay_capacity,
+        target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng)
+
+
+def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, weights,
+               seed: int = 0, agent=None):
+    """Actor `task` of the topology, over any queue/weight-store.
+
+    The queue/weights may be the learner's own objects (single process) or
+    transport adapters (multi-process) — same construction either way.
+    Pass `agent` to share one jit cache across runners in-process.
+    """
+    agent = agent or _AGENT_CLS[algo](agent_cfg)
+    env = _make_batched_env(rt, task, agent_cfg.num_actions)
+    atari = _is_atari(rt)
+    if algo == "impala":
+        return impala_runner.ImpalaActor(
+            agent, env, queue, weights, seed=seed,
+            available_action=rt.available_action[task % len(rt.available_action)],
+            life_loss_shaping=atari)
+    if algo == "apex":
+        return apex_runner.ApexActor(
+            agent, env, queue, weights, seed=seed, life_loss_shaping=atari)
+    transform = pomdp_project if agent_cfg.obs_shape == (2,) else None
+    return r2d2_runner.R2D2Actor(
+        agent, env, queue, weights, seed=seed, obs_transform=transform)
+
+
+_RUN_SYNC = {
+    "impala": impala_runner.run_sync,
+    "apex": apex_runner.run_sync,
+    "r2d2": r2d2_runner.run_sync,
+}
+
+
 def build_local(agent_cfg: Any, rt: RuntimeConfig, run_dir: str | None = None, seed: int = 0):
-    """-> (learner, actors, queue) for single-host training."""
+    """-> (learner, actors, run_fn) for single-host training."""
+    algo = _algo_of(agent_cfg)
     logger = MetricsLogger(run_dir)
     queue = TrajectoryQueue(rt.queue_size)
     weights = WeightStore()
-    rng = jax.random.PRNGKey(seed)
-    atari = _is_atari(rt)
-
-    if isinstance(agent_cfg, ImpalaConfig):
-        agent = ImpalaAgent(agent_cfg)
-        learner = impala_runner.ImpalaLearner(
-            agent, queue, weights, rt.batch_size, logger=logger, rng=rng)
-        actors = [
-            impala_runner.ImpalaActor(
-                agent, _make_batched_env(rt, i, agent_cfg.num_actions), queue, weights,
-                seed=seed + 1 + i,
-                available_action=rt.available_action[i % len(rt.available_action)],
-                life_loss_shaping=atari)
-            for i in range(rt.num_actors)
-        ]
-        run_fn = impala_runner.run_sync
-    elif isinstance(agent_cfg, ApexConfig):
-        agent = ApexAgent(agent_cfg)
-        learner = apex_runner.ApexLearner(
-            agent, queue, weights, rt.batch_size,
-            replay_capacity=rt.replay_capacity,
-            target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng)
-        actors = [
-            apex_runner.ApexActor(
-                agent, _make_batched_env(rt, i, agent_cfg.num_actions), queue, weights,
-                seed=seed + 1 + i, life_loss_shaping=atari)
-            for i in range(rt.num_actors)
-        ]
-        run_fn = apex_runner.run_sync
-    elif isinstance(agent_cfg, R2D2Config):
-        agent = R2D2Agent(agent_cfg)
-        learner = r2d2_runner.R2D2Learner(
-            agent, queue, weights, rt.batch_size,
-            replay_capacity=rt.replay_capacity,
-            target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng)
-        transform = pomdp_project if agent_cfg.obs_shape == (2,) else None
-        actors = [
-            r2d2_runner.R2D2Actor(
-                agent, _make_batched_env(rt, i, agent_cfg.num_actions), queue, weights,
-                seed=seed + 1 + i, obs_transform=transform)
-            for i in range(rt.num_actors)
-        ]
-        run_fn = r2d2_runner.run_sync
-    else:
-        raise TypeError(f"unknown agent config {type(agent_cfg)}")
-    return learner, actors, run_fn
+    agent = _AGENT_CLS[algo](agent_cfg)  # one jit cache for all runners
+    learner = make_learner(algo, agent_cfg, rt, queue, weights,
+                           logger=logger, rng=jax.random.PRNGKey(seed), agent=agent)
+    actors = [
+        make_actor(algo, agent_cfg, rt, i, queue, weights, seed=seed + 1 + i, agent=agent)
+        for i in range(rt.num_actors)
+    ]
+    return learner, actors, _RUN_SYNC[algo]
 
 
 def train_local(config_path: str, section: str, num_updates: int,

@@ -1,0 +1,366 @@
+"""Socket data plane: the learner serves trajectories-in / weights-out.
+
+TPU-native replacement for the reference's TF distributed runtime
+(`tf.train.Server` + ClusterSpec gRPC at `train_impala.py:31-35`, shared
+FIFOQueue `distributed_queue/buffer_queue.py:28-36`, cross-process weight
+assigns `utils.py:5-21`). The three traffic classes SURVEY §5.8
+identifies map to three ops on one length-prefixed TCP protocol:
+
+  (i)  PUT_TRAJ   actor -> learner  bulk codec blobs, blocking enqueue
+                                    (backpressure = the reply waits until
+                                    the bounded queue accepts the item)
+  (ii) GET_WEIGHTS learner -> actor versioned snapshot; the encoded blob
+                                    is cached per version so N actors
+                                    cost one encode
+  (iii) QUEUE_SIZE / PING           polls & liveness
+
+Framing: request [u8 op][u32 len][payload], response
+[u8 status][u32 len][payload]. The learner binds `rt.server_port`; actors
+connect with bounded-retry reconnect (the reference had none — a dead
+peer hung the cluster, SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.data import codec
+
+OP_PUT_TRAJ = 1
+OP_GET_WEIGHTS = 2
+OP_QUEUE_SIZE = 3
+OP_PING = 4
+
+ST_OK = 0
+ST_ERROR = 1
+ST_CLOSED = 2
+
+_HDR = struct.Struct("<BI")  # (op|status, payload_len)
+_I64 = struct.Struct("<q")
+
+
+class TransportError(ConnectionError):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        k = sock.recv_into(view[got:], n - got)
+        if k == 0:
+            raise TransportError("peer closed")
+        got += k
+    return bytes(buf)
+
+
+def _send_msg(sock: socket.socket, tag: int, payload: bytes | bytearray = b"") -> None:
+    sock.sendall(_HDR.pack(tag, len(payload)))
+    if payload:  # separate send: no header+payload concat copy of bulk blobs
+        sock.sendall(payload)
+
+
+def _recv_msg(sock: socket.socket) -> tuple[int, bytes]:
+    tag, length = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    payload = _recv_exact(sock, length) if length else b""
+    return tag, payload
+
+
+class TransportServer:
+    """Learner-side service: owns nothing, serves the queue + weight store."""
+
+    def __init__(self, queue, weights, host: str = "0.0.0.0", port: int = 8000):
+        self.queue = queue
+        self.weights = weights
+        self.host, self.port = host, port
+        self._sock: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._enc_lock = threading.Lock()
+        self._enc_cache: tuple[int, bytes] = (-1, b"")
+
+    def start(self) -> "TransportServer":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self._sock.listen(128)
+        self._sock.settimeout(0.5)
+        t = threading.Thread(target=self._accept_loop, daemon=True, name="transport-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            self._sock.close()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _weights_blob(self) -> tuple[int, bytes]:
+        params, version = self.weights.get()
+        with self._enc_lock:
+            if self._enc_cache[0] != version and params is not None:
+                self._enc_cache = (version, codec.encode(params))
+            return self._enc_cache
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    op, payload = _recv_msg(conn)
+                except (TransportError, OSError):
+                    return
+                try:
+                    if op == OP_PUT_TRAJ:
+                        # Blocking enqueue: replying only after acceptance is
+                        # the actors' backpressure (reference: blocking
+                        # enqueue op, buffer_queue.py:398-414). Bounded wait
+                        # so a wedged learner surfaces as ST_ERROR, not a
+                        # silent hang of every actor connection.
+                        if hasattr(self.queue, "put_bytes"):
+                            ok = self.queue.put_bytes(payload, timeout=120.0)
+                        else:
+                            ok = self.queue.put(codec.decode(payload, copy=True), timeout=120.0)
+                        _send_msg(conn, ST_OK if ok else ST_ERROR)
+                    elif op == OP_GET_WEIGHTS:
+                        have = _I64.unpack(payload)[0]
+                        version, blob = self._weights_blob()
+                        if version <= have:
+                            _send_msg(conn, ST_OK, _I64.pack(have))
+                        else:
+                            _send_msg(conn, ST_OK, _I64.pack(version) + blob)
+                    elif op == OP_QUEUE_SIZE:
+                        _send_msg(conn, ST_OK, _I64.pack(self.queue.size()))
+                    elif op == OP_PING:
+                        _send_msg(conn, ST_OK)
+                    else:
+                        _send_msg(conn, ST_ERROR)
+                except RuntimeError:  # queue closed -> learner shutting down
+                    try:
+                        _send_msg(conn, ST_CLOSED)
+                    except OSError:
+                        pass
+                    return
+                except (TransportError, OSError):
+                    return
+
+
+class TransportClient:
+    """Actor-side connection with bounded-retry reconnect."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        connect_retries: int = 60,
+        retry_interval: float = 1.0,
+    ):
+        self.host, self.port = host, port
+        self.connect_retries = connect_retries
+        self.retry_interval = retry_interval
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self) -> None:
+        last: Exception | None = None
+        for _ in range(self.connect_retries):
+            try:
+                sock = socket.create_connection((self.host, self.port), timeout=300.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = sock
+                return
+            except OSError as e:
+                last = e
+                time.sleep(self.retry_interval)
+        raise TransportError(f"cannot reach learner at {self.host}:{self.port}: {last}")
+
+    def _call(self, op: int, payload: bytes = b"", retry: bool = True) -> bytes:
+        with self._lock:
+            try:
+                assert self._sock is not None
+                _send_msg(self._sock, op, payload)
+                status, resp = _recv_msg(self._sock)
+            except (TransportError, OSError):
+                if not retry:
+                    raise
+                self.close()
+                self._connect()  # one reconnect cycle, then retry the op once
+                assert self._sock is not None
+                _send_msg(self._sock, op, payload)
+                status, resp = _recv_msg(self._sock)
+        if status == ST_CLOSED:
+            raise TransportError("learner closed the data plane")
+        if status != ST_OK:
+            raise TransportError(f"op {op} failed on the learner side")
+        return resp
+
+    def put_trajectory(self, tree: Any) -> None:
+        self._call(OP_PUT_TRAJ, codec.encode(tree))
+
+    def get_weights_if_newer(self, have_version: int) -> tuple[Any, int] | None:
+        resp = self._call(OP_GET_WEIGHTS, _I64.pack(have_version))
+        version = _I64.unpack(resp[: _I64.size])[0]
+        if version <= have_version:
+            return None
+        return codec.decode(resp[_I64.size :], copy=True), version
+
+    def queue_size(self) -> int:
+        return _I64.unpack(self._call(OP_QUEUE_SIZE))[0]
+
+    def ping(self) -> bool:
+        try:
+            self._call(OP_PING, retry=False)
+            return True
+        except (TransportError, OSError):
+            return False
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+
+class RemoteQueue:
+    """`TrajectoryQueue` put/size surface for actor runners, over the wire."""
+
+    def __init__(self, client: TransportClient):
+        self._client = client
+
+    def put(self, item: Any, timeout: float | None = None) -> bool:
+        self._client.put_trajectory(item)
+        return True
+
+    def size(self) -> int:
+        return self._client.queue_size()
+
+
+class RemoteWeights:
+    """`WeightStore.get_if_newer` surface for actor runners, over the wire."""
+
+    def __init__(self, client: TransportClient):
+        self._client = client
+
+    def get_if_newer(self, have_version: int) -> tuple[Any, int] | None:
+        return self._client.get_weights_if_newer(have_version)
+
+
+def _make_queue(capacity: int):
+    from distributed_reinforcement_learning_tpu.data.native import native_available
+
+    if native_available():
+        from distributed_reinforcement_learning_tpu.data.native import NativeTrajectoryQueue
+
+        return NativeTrajectoryQueue(capacity)
+    from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+
+    return TrajectoryQueue(capacity)
+
+
+def run_role(
+    algo: str,
+    config_path: str,
+    section: str,
+    mode: str,
+    task: int,
+    num_updates: int = 1000,
+    run_dir: str | None = None,
+    seed: int = 0,
+) -> None:
+    """One process of the reference topology: `--mode learner` or
+    `--mode actor --task k` (reference role flags, `train_impala.py:16-20`)."""
+    import jax
+
+    from distributed_reinforcement_learning_tpu.runtime import launch
+    from distributed_reinforcement_learning_tpu.utils.config import load_config
+    from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
+
+    agent_cfg, rt = load_config(config_path, section)
+    logger = MetricsLogger(run_dir)
+
+    if mode == "learner":
+        queue = _make_queue(rt.queue_size)
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        weights = WeightStore()
+        learner = launch.make_learner(
+            algo, agent_cfg, rt, queue, weights, logger=logger,
+            rng=jax.random.PRNGKey(seed),
+        )
+        server = TransportServer(queue, weights, host="0.0.0.0", port=rt.server_port).start()
+        print(f"[learner] serving on :{rt.server_port}; training {num_updates} updates")
+        try:
+            _learner_loop(algo, learner, num_updates)
+        finally:
+            queue.close()
+            server.stop()
+        print(f"[learner] done: {learner.train_steps} updates")
+    elif mode == "actor":
+        if task < 0:
+            raise ValueError("actor mode needs --task k")
+        client = TransportClient(rt.server_ip, rt.server_port)
+        actor = launch.make_actor(
+            algo, agent_cfg, rt, task, RemoteQueue(client), RemoteWeights(client),
+            seed=seed + 1 + task,
+        )
+        print(f"[actor {task}] connected to {rt.server_ip}:{rt.server_port}")
+        frames = 0
+        try:
+            while True:
+                frames += _actor_round(algo, actor)
+        except (TransportError, ConnectionError):
+            print(f"[actor {task}] learner gone after {frames} frames; exiting")
+        finally:
+            client.close()
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+
+def _learner_loop(algo: str, learner, num_updates: int) -> None:
+    if algo == "impala":
+        while learner.train_steps < num_updates:
+            learner.step(timeout=5.0)
+    elif algo == "apex":
+        while learner.train_steps < num_updates:
+            drained = False
+            while learner.ingest(timeout=0.05):
+                drained = True
+            if learner.train() is None and not drained:
+                time.sleep(0.05)
+    elif algo == "r2d2":
+        while learner.train_steps < num_updates:
+            got = learner.ingest_batch(timeout=0.05)
+            if learner.train() is None and not got:
+                time.sleep(0.05)
+    else:
+        raise ValueError(f"unknown algorithm {algo!r}")
+
+
+def _actor_round(algo: str, actor) -> int:
+    if algo == "apex":
+        return actor.run_steps(64)
+    return actor.run_unroll()

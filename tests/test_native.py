@@ -196,9 +196,8 @@ class TestNativeReplayParity:
         items, idxs, w = nt.sample(32, rng)
         assert len(items) == 32 and all(it is not None for it in items)
         assert w.max() == pytest.approx(1.0)
-        assert nt.beta == pytest.approx(py.beta + 0.001) or py.sample(
-            32, np.random.RandomState(7)
-        )  # both anneal by the same increment
+        py.sample(32, np.random.RandomState(7))
+        assert nt.beta == pytest.approx(py.beta)  # both anneal by the same increment
 
     def test_high_priority_sampled_more(self):
         nt = NativePrioritizedReplay(64)
