@@ -151,6 +151,28 @@ class ApexLearner:
         self.train_steps = 0
         weights.publish(self.state.params, 0)
 
+    def save_checkpoint(self, ckpt) -> None:
+        """Persist TrainState (main+target nets, Adam moments) + host
+        counters. Replay contents are rebuilt from live actor traffic after
+        a restart rather than snapshotted (they would dominate checkpoint
+        size at `replay_capacity`=1e5 Atari transitions)."""
+        ckpt.save(self.train_steps, self.state, {
+            "train_steps": self.train_steps,
+            "replay_beta": float(self.replay.beta),
+        })
+
+    def restore_checkpoint(self, ckpt) -> bool:
+        got = ckpt.restore(self.state)
+        if got is None:
+            return False
+        self.state, extra, _ = got
+        self.train_steps = int(extra.get("train_steps", 0))
+        # The replay warm-up gate restarts: the buffer is empty again.
+        self.ingested_unrolls = 0
+        self.replay.beta = float(extra.get("replay_beta", self.replay.beta))
+        self.weights.publish(self.state.params, self.train_steps)
+        return True
+
     def ingest(self, timeout: float | None = 0.0) -> bool:
         """Drain one unroll, score TD per transition, insert into replay
         (`train_apex.py:98-122`)."""

@@ -138,6 +138,23 @@ class ImpalaLearner:
         self.frames_learned = 0
         weights.publish(self.state.params, 0)
 
+    def save_checkpoint(self, ckpt) -> None:
+        """Persist TrainState + host counters (the checkpoint the reference
+        built a Saver for but never invoked, `agent/impala.py:103`)."""
+        ckpt.save(self.train_steps, self.state,
+                  {"train_steps": self.train_steps, "frames_learned": self.frames_learned})
+
+    def restore_checkpoint(self, ckpt) -> bool:
+        """Resume from the latest checkpoint; republishes restored weights."""
+        got = ckpt.restore(self.state)
+        if got is None:
+            return False
+        self.state, extra, _ = got
+        self.train_steps = int(extra.get("train_steps", 0))
+        self.frames_learned = int(extra.get("frames_learned", 0))
+        self.weights.publish(self.state.params, self.train_steps)
+        return True
+
     def step(self, timeout: float | None = None) -> dict | None:
         """One train step: drain a batch, learn, publish weights."""
         batch = self.queue.get_batch(self.batch_size, timeout=timeout)

@@ -132,6 +132,25 @@ class R2D2Learner:
         self.train_steps = 0
         weights.publish(self.state.params, 0)
 
+    def save_checkpoint(self, ckpt) -> None:
+        """Persist TrainState + host counters (the reference's R2D2 agent
+        had no Saver at all — SURVEY §5.4)."""
+        ckpt.save(self.train_steps, self.state, {
+            "train_steps": self.train_steps,
+            "replay_beta": float(self.replay.beta),
+        })
+
+    def restore_checkpoint(self, ckpt) -> bool:
+        got = ckpt.restore(self.state)
+        if got is None:
+            return False
+        self.state, extra, _ = got
+        self.train_steps = int(extra.get("train_steps", 0))
+        self.ingested_sequences = 0  # replay refills from live traffic
+        self.replay.beta = float(extra.get("replay_beta", self.replay.beta))
+        self.weights.publish(self.state.params, self.train_steps)
+        return True
+
     def ingest_batch(self, timeout: float | None = 0.0) -> int:
         """Drain up to batch_size sequences; priority-score them in ONE
         batched td_error call (vs per-sequence `sess.run`s at

@@ -40,6 +40,7 @@ OP_PING = 4
 ST_OK = 0
 ST_ERROR = 1
 ST_CLOSED = 2
+ST_BUSY = 3  # bounded-queue timeout: retryable, not a dead learner
 
 _HDR = struct.Struct("<BI")  # (op|status, payload_len)
 _I64 = struct.Struct("<q")
@@ -61,10 +62,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _send_msg(sock: socket.socket, tag: int, payload: bytes | bytearray = b"") -> None:
-    sock.sendall(_HDR.pack(tag, len(payload)))
-    if payload:  # separate send: no header+payload concat copy of bulk blobs
-        sock.sendall(payload)
+def _send_msg(sock: socket.socket, tag: int, *parts: bytes | bytearray) -> None:
+    """One framed message; multi-part payloads are sent without concatenating
+    (no copy of multi-MB weight blobs just to prefix an 8-byte version)."""
+    total = sum(len(p) for p in parts)
+    sock.sendall(_HDR.pack(tag, total))
+    for p in parts:
+        if p:
+            sock.sendall(p)
 
 
 def _recv_msg(sock: socket.socket) -> tuple[int, bytes]:
@@ -116,12 +121,18 @@ class TransportServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
+            # Prune finished connection threads so reconnect churn over a
+            # long-running learner doesn't accumulate dead Thread objects.
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _weights_blob(self) -> tuple[int, bytes]:
-        params, version = self.weights.get()
+        # Read-then-cache entirely under the lock, and only move the cache
+        # forward: a preempted thread holding an older (params, version) pair
+        # must not regress the cache and hand stale weights to actors.
         with self._enc_lock:
-            if self._enc_cache[0] != version and params is not None:
+            params, version = self.weights.get()
+            if version > self._enc_cache[0] and params is not None:
                 self._enc_cache = (version, codec.encode(params))
             return self._enc_cache
 
@@ -137,20 +148,21 @@ class TransportServer:
                         # Blocking enqueue: replying only after acceptance is
                         # the actors' backpressure (reference: blocking
                         # enqueue op, buffer_queue.py:398-414). Bounded wait
-                        # so a wedged learner surfaces as ST_ERROR, not a
-                        # silent hang of every actor connection.
+                        # so a stalled learner (e.g. a minutes-long first jit
+                        # compile with a full queue) surfaces as retryable
+                        # ST_BUSY instead of hanging — or killing — actors.
                         if hasattr(self.queue, "put_bytes"):
-                            ok = self.queue.put_bytes(payload, timeout=120.0)
+                            ok = self.queue.put_bytes(payload, timeout=30.0)
                         else:
-                            ok = self.queue.put(codec.decode(payload, copy=True), timeout=120.0)
-                        _send_msg(conn, ST_OK if ok else ST_ERROR)
+                            ok = self.queue.put(codec.decode(payload, copy=True), timeout=30.0)
+                        _send_msg(conn, ST_OK if ok else ST_BUSY)
                     elif op == OP_GET_WEIGHTS:
                         have = _I64.unpack(payload)[0]
                         version, blob = self._weights_blob()
                         if version <= have:
                             _send_msg(conn, ST_OK, _I64.pack(have))
                         else:
-                            _send_msg(conn, ST_OK, _I64.pack(version) + blob)
+                            _send_msg(conn, ST_OK, _I64.pack(version), blob)
                     elif op == OP_QUEUE_SIZE:
                         _send_msg(conn, ST_OK, _I64.pack(self.queue.size()))
                     elif op == OP_PING:
@@ -197,28 +209,56 @@ class TransportClient:
                 time.sleep(self.retry_interval)
         raise TransportError(f"cannot reach learner at {self.host}:{self.port}: {last}")
 
-    def _call(self, op: int, payload: bytes = b"", retry: bool = True) -> bytes:
+    def _exchange(self, op: int, payload: bytes, retry: bool, resend: bool) -> tuple[int, bytes]:
+        """One request/response; on a dropped connection, reconnect and (for
+        idempotent ops) resend. Non-idempotent ops set `resend=False`: the
+        server may or may not have acted on the lost request, so resending
+        would give at-least-once delivery (duplicated trajectories)."""
         with self._lock:
             try:
                 assert self._sock is not None
                 _send_msg(self._sock, op, payload)
-                status, resp = _recv_msg(self._sock)
+                return _recv_msg(self._sock)
             except (TransportError, OSError):
                 if not retry:
                     raise
                 self.close()
-                self._connect()  # one reconnect cycle, then retry the op once
+                self._connect()
+                if not resend:
+                    raise TransportError("connection lost mid-request") from None
                 assert self._sock is not None
                 _send_msg(self._sock, op, payload)
-                status, resp = _recv_msg(self._sock)
+                return _recv_msg(self._sock)
+
+    def _call(self, op: int, payload: bytes = b"", retry: bool = True) -> bytes:
+        status, resp = self._exchange(op, payload, retry, resend=True)
         if status == ST_CLOSED:
             raise TransportError("learner closed the data plane")
         if status != ST_OK:
             raise TransportError(f"op {op} failed on the learner side")
         return resp
 
-    def put_trajectory(self, tree: Any) -> None:
-        self._call(OP_PUT_TRAJ, codec.encode(tree))
+    def put_trajectory(self, tree: Any) -> bool:
+        """Ship one trajectory; blocks (via ST_BUSY retries) while the
+        learner's bounded queue is full — the reference's blocking-enqueue
+        backpressure. At-most-once: if the connection drops mid-request the
+        unroll is dropped, not resent (returns False); losing one off-policy
+        unroll is harmless, training on a duplicate is not."""
+        blob = codec.encode(tree)
+        while True:
+            try:
+                status, _ = self._exchange(OP_PUT_TRAJ, blob, retry=True, resend=False)
+            except TransportError:
+                if self._sock is None:  # reconnect failed: learner is gone
+                    raise
+                return False
+            if status == ST_OK:
+                return True
+            if status == ST_BUSY:  # learner alive but queue full: keep pushing
+                continue
+            if status == ST_CLOSED:
+                raise TransportError("learner closed the data plane")
+            raise TransportError("put_trajectory failed on the learner side")
 
     def get_weights_if_newer(self, have_version: int) -> tuple[Any, int] | None:
         resp = self._call(OP_GET_WEIGHTS, _I64.pack(have_version))
@@ -290,6 +330,8 @@ def run_role(
     num_updates: int = 1000,
     run_dir: str | None = None,
     seed: int = 0,
+    checkpoint_dir: str | None = None,
+    checkpoint_interval: int = 500,
 ) -> None:
     """One process of the reference topology: `--mode learner` or
     `--mode actor --task k` (reference role flags, `train_impala.py:16-20`)."""
@@ -300,9 +342,9 @@ def run_role(
     from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
 
     agent_cfg, rt = load_config(config_path, section)
-    logger = MetricsLogger(run_dir)
 
     if mode == "learner":
+        logger = MetricsLogger(run_dir)  # actors log nothing: no writer for them
         queue = _make_queue(rt.queue_size)
         from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 
@@ -311,11 +353,20 @@ def run_role(
             algo, agent_cfg, rt, queue, weights, logger=logger,
             rng=jax.random.PRNGKey(seed),
         )
+        ckpt = None
+        if checkpoint_dir is not None:
+            from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+            ckpt = Checkpointer(checkpoint_dir)
+            if learner.restore_checkpoint(ckpt):
+                print(f"[learner] resumed from step {learner.train_steps}")
         server = TransportServer(queue, weights, host="0.0.0.0", port=rt.server_port).start()
         print(f"[learner] serving on :{rt.server_port}; training {num_updates} updates")
         try:
-            _learner_loop(algo, learner, num_updates)
+            _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
         finally:
+            if ckpt is not None and learner.train_steps > 0:
+                learner.save_checkpoint(ckpt)
             queue.close()
             server.stop()
         print(f"[learner] done: {learner.train_steps} updates")
@@ -340,10 +391,25 @@ def run_role(
         raise ValueError(f"unknown mode {mode!r}")
 
 
-def _learner_loop(algo: str, learner, num_updates: int) -> None:
+def _learner_loop(
+    algo: str,
+    learner,
+    num_updates: int,
+    ckpt=None,
+    checkpoint_interval: int = 500,
+) -> None:
+    last_saved = learner.train_steps
+
+    def maybe_checkpoint() -> None:
+        nonlocal last_saved
+        if ckpt is not None and learner.train_steps - last_saved >= checkpoint_interval:
+            learner.save_checkpoint(ckpt)
+            last_saved = learner.train_steps
+
     if algo == "impala":
         while learner.train_steps < num_updates:
             learner.step(timeout=5.0)
+            maybe_checkpoint()
     elif algo == "apex":
         while learner.train_steps < num_updates:
             drained = False
@@ -351,11 +417,13 @@ def _learner_loop(algo: str, learner, num_updates: int) -> None:
                 drained = True
             if learner.train() is None and not drained:
                 time.sleep(0.05)
+            maybe_checkpoint()
     elif algo == "r2d2":
         while learner.train_steps < num_updates:
             got = learner.ingest_batch(timeout=0.05)
             if learner.train() is None and not got:
                 time.sleep(0.05)
+            maybe_checkpoint()
     else:
         raise ValueError(f"unknown algorithm {algo!r}")
 

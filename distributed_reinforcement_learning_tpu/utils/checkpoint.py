@@ -1,0 +1,109 @@
+"""Checkpoint/resume: params + optimizer state + step, actually wired in.
+
+The reference constructs `tf.train.Saver`s but never calls them from any
+training loop (`agent/impala.py:103,105-109`, `agent/apex.py:80`; R2D2 has
+none — SURVEY §5.4), so a crashed learner loses everything. Here
+checkpointing is a first-class subsystem:
+
+- the serialized unit is the learner's whole `TrainState` pytree (params,
+  optimizer moments, device step counter) via flax msgpack serialization,
+  plus a JSON sidecar of host-side counters (train steps, replay beta, ...),
+- writes are atomic (tmp file + `os.replace`), the payload file is the
+  commit marker, and the newest `retain` checkpoints are kept,
+- learners expose `save_checkpoint`/`restore_checkpoint`; the multi-process
+  entrypoint (`runtime/transport.run_role`) saves on an interval and
+  restores on startup, which is the learner half of crash recovery
+  (actors already reconnect through the transport layer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from pathlib import Path
+from typing import Any
+
+from flax import serialization
+
+_CKPT_RE = re.compile(r"^ckpt_(\d{10})\.msgpack$")
+
+
+def _atomic_write(path: Path, data: bytes) -> None:
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+class Checkpointer:
+    """Step-numbered, atomic, retain-N checkpoint store on a directory.
+
+    Layout: `ckpt_{step:010d}.msgpack` (the TrainState, written last =
+    commit marker) and `ckpt_{step:010d}.extra.json` (host counters,
+    written first). A checkpoint is visible only once its msgpack exists.
+    """
+
+    def __init__(self, directory: str | Path, retain: int = 3):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.retain = retain
+
+    def _payload_path(self, step: int) -> Path:
+        return self.directory / f"ckpt_{step:010d}.msgpack"
+
+    def _extra_path(self, step: int) -> Path:
+        return self.directory / f"ckpt_{step:010d}.extra.json"
+
+    def steps(self) -> list[int]:
+        """Committed checkpoint steps, ascending."""
+        out = []
+        for p in self.directory.iterdir():
+            m = _CKPT_RE.match(p.name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: Any, extra: dict | None = None) -> Path:
+        """Persist `state` (+ host `extra`) as checkpoint `step`."""
+        _atomic_write(self._extra_path(step), json.dumps(extra or {}).encode())
+        path = self._payload_path(step)
+        _atomic_write(path, serialization.to_bytes(state))
+        self._prune()
+        return path
+
+    def restore(self, template: Any, step: int | None = None) -> tuple[Any, dict, int] | None:
+        """-> (state, extra, step) for `step` (default latest), or None.
+
+        `template` must be a pytree with the same structure as the saved
+        state (a freshly-initialized TrainState); flax deserializes into it.
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        payload = self._payload_path(step)
+        if not payload.exists():
+            return None
+        state = serialization.from_bytes(template, payload.read_bytes())
+        extra_path = self._extra_path(step)
+        extra = json.loads(extra_path.read_text()) if extra_path.exists() else {}
+        return state, extra, step
+
+    def _prune(self) -> None:
+        for step in self.steps()[: -self.retain]:
+            for p in (self._payload_path(step), self._extra_path(step)):
+                try:
+                    p.unlink()
+                except FileNotFoundError:
+                    pass

@@ -29,6 +29,9 @@ def main() -> None:
     p.add_argument("--updates", type=int, default=1000)
     p.add_argument("--run_dir", default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint_dir", default=None,
+                   help="learner mode: save/resume TrainState checkpoints here")
+    p.add_argument("--checkpoint_interval", type=int, default=500)
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. 'cpu'); actors default to cpu "
                         "so they never grab the TPU chip")
@@ -49,7 +52,9 @@ def main() -> None:
         from distributed_reinforcement_learning_tpu.runtime.transport import run_role
 
         run_role("impala", args.config, args.section, args.mode, args.task,
-                 num_updates=args.updates, run_dir=args.run_dir, seed=args.seed)
+                 num_updates=args.updates, run_dir=args.run_dir, seed=args.seed,
+                 checkpoint_dir=args.checkpoint_dir,
+                 checkpoint_interval=args.checkpoint_interval)
 
 
 if __name__ == "__main__":
