@@ -31,12 +31,13 @@ class R2D2Net(nn.Module):
     num_actions: int
     lstm_size: int = 512
     dtype: jnp.dtype = jnp.float32
+    cell_backend: str = "auto"  # LSTM recursion backend (pallas on TPU)
 
     def setup(self):
         self.state_fc1 = nn.Dense(256, kernel_init=_glorot, dtype=self.dtype)
         self.state_fc2 = nn.Dense(256, kernel_init=_glorot, dtype=self.dtype)
         self.action_embed = ActionEmbedding(self.num_actions, dtype=self.dtype)
-        self.cell = LSTMCell(self.lstm_size, dtype=self.dtype)
+        self.cell = LSTMCell(self.lstm_size, dtype=self.dtype, backend=self.cell_backend)
         self.head_fc = nn.Dense(128, kernel_init=_glorot, dtype=self.dtype)
         self.value = nn.Dense(self.num_actions, kernel_init=_glorot, dtype=self.dtype)
         self.mean = nn.Dense(1, kernel_init=_glorot, dtype=self.dtype)
@@ -60,21 +61,19 @@ class R2D2Net(nn.Module):
 
         done-masked like `model/r2d2_lstm.py:78-80`: (h, c) are zeroed
         *after* the step at which done[t] is True. Returns `[B, T, A]`.
+
+        Only the LSTM recursion is sequential: the MLP torso, action
+        embedding, and dueling head are h-independent, so they run
+        time-parallel over the whole `[B, T]` batch (one MXU matmul each)
+        around the fused `cell.unroll` — vs the reference's per-timestep
+        whole-network replicas (`model/r2d2_lstm.py:65-112`).
         """
-
-        def body(mdl, carry, xs):
-            h, c = carry
-            obs_t, pa_t, done_t = xs
-            q, new_h, new_c = mdl.step(obs_t, pa_t, h, c)
-            keep = (~done_t).astype(new_h.dtype)[..., None]
-            return (new_h * keep, new_c * keep), q
-
-        scan = nn.scan(
-            body,
-            variable_broadcast="params",
-            split_rngs={"params": False},
-            in_axes=1,
-            out_axes=1,
-        )
-        _, q_seq = scan(self, (h0, c0), (obs_seq, prev_action_seq, done_seq))
-        return q_seq
+        x = obs_seq.astype(self.dtype)
+        x = nn.relu(self.state_fc1(x))
+        x = nn.relu(self.state_fc2(x))
+        a = self.action_embed(prev_action_seq)
+        z = jnp.concatenate([x, a], axis=-1)
+        h_all, _ = self.cell.unroll(z, done_seq, h0, c0)
+        q = nn.relu(self.head_fc(h_all))
+        q = self.value(q) - self.mean(q)
+        return q.astype(jnp.float32)

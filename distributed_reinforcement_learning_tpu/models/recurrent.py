@@ -5,14 +5,16 @@ The reference uses TF1's `LSTMCell` + `dynamic_rnn` one step at a time
 unrolls sequences with Python loops that replicate the whole network per
 timestep. Here:
 
-- `LSTMCell` is a single fused `[x; h] @ W + b` matmul split into the four
-  gates (one MXU-friendly matmul per step), with TF-style forget bias 1.0.
+- `LSTMCell` holds one fused `[x; h] @ W + b` gate projection (TF-style
+  forget bias 1.0) and exposes `unroll` over a whole `[B, T]` sequence:
+  the time-parallel input projection runs as one big MXU matmul, and the
+  sequential recursion goes through `ops.lstm.lstm_scan` — a `lax.scan`
+  on CPU, a fused Pallas VMEM kernel on TPU (`ops/pallas/lstm.py`).
 - Stored-state training (IMPALA) needs **no unroll at all**: each timestep
   is seeded from the actor-recorded (h, c), so the learner applies the cell
   to a flattened `[B*T]` batch in one shot (see `agents/impala.py`).
-- Sequential unrolls (R2D2) use `jax.lax.scan` via `flax.linen.scan` with
-  done-masked state resets, replacing the reference's Python loop
-  (`model/r2d2_lstm.py:67-112`).
+- Sequential unrolls (R2D2) call `unroll` with done-masked state resets,
+  replacing the reference's Python loop (`model/r2d2_lstm.py:67-112`).
 """
 
 from __future__ import annotations
@@ -21,27 +23,61 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distributed_reinforcement_learning_tpu.ops.lstm import lstm_scan
+
 
 class LSTMCell(nn.Module):
-    """Fused-matmul LSTM cell with forget-gate bias 1.0 (TF1 parity).
+    """LSTM with the reference's fused gate projection and forget bias 1.0.
 
-    State layout: (h, c) pairs of `[N, hidden]`. The fused kernel computes
-    all four gates from one `[x; h] @ W` product so XLA maps a step onto a
-    single MXU matmul.
+    The single parameter pair mirrors TF1's `LSTMCell`: one
+    `[input+hidden, 4*hidden]` kernel over `[x; h]` plus a `[4*hidden]`
+    bias. `unroll` splits the kernel into its input and recurrent halves
+    so the input half runs time-parallel and only the recurrent half sits
+    inside the sequential scan.
     """
 
     hidden_size: int
     dtype: jnp.dtype = jnp.float32
+    backend: str = "auto"  # ops.pallas.resolve_backend: auto/pallas/reference
 
     @nn.compact
+    def unroll(
+        self,
+        z_seq: jax.Array,  # [B, T, F]
+        done_seq: jax.Array,  # [B, T] bool
+        h: jax.Array,  # [B, hidden]
+        c: jax.Array,
+        backend: str | None = None,
+    ):
+        """-> (h_all [B, T, hidden] pre-mask outputs, (hT, cT) masked carry).
+
+        (h, c) are zeroed AFTER any step where done is set
+        (`model/r2d2_lstm.py:78-80` semantics).
+        """
+        feat = z_seq.shape[-1]
+        hid = self.hidden_size
+        kernel = self.param(
+            "gates_kernel", nn.initializers.xavier_uniform(), (feat + hid, 4 * hid)
+        )
+        bias = self.param("gates_bias", nn.initializers.zeros_init(), (4 * hid,))
+        xg = jnp.dot(z_seq.astype(self.dtype), kernel[:feat]) + bias
+        keep = 1.0 - done_seq.astype(xg.dtype)
+        return lstm_scan(
+            xg, kernel[feat:], keep, h, c, backend=backend or self.backend
+        )
+
     def __call__(self, x: jax.Array, h: jax.Array, c: jax.Array):
-        gates = nn.Dense(
-            4 * self.hidden_size,
-            kernel_init=nn.initializers.xavier_uniform(),
-            dtype=self.dtype,
-            name="gates",
-        )(jnp.concatenate([x, h], axis=-1))
-        i, f, g, o = jnp.split(gates, 4, axis=-1)
-        new_c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
-        new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+        """Single step on an `[N, F]` batch (act paths, stored-state IMPALA).
+
+        One fused step is already a single XLA kernel — the Pallas path
+        buys nothing at T=1, so this always takes the reference scan.
+        """
+        h_all, (new_h, new_c) = self.unroll(
+            x[:, None, :],
+            jnp.zeros(x.shape[:1] + (1,), bool),
+            h,
+            c,
+            backend="reference",
+        )
+        del h_all  # == new_h (keep mask is all-ones at T=1)
         return new_h, new_c

@@ -1,0 +1,86 @@
+"""LSTM sequence recursion as a pure op, with a fused Pallas TPU path.
+
+The recurrent models split one LSTM unroll into:
+
+  (a) the input projection `xg = [x] @ Wx + b` for ALL timesteps — one big
+      MXU matmul, embarrassingly parallel, left to XLA;
+  (b) the sequential recursion over T carrying (h, c) with done-masking —
+      this module.
+
+The reference instead replicated the entire network per timestep in
+Python graph-building loops (`/root/reference/model/r2d2_lstm.py:65-112`,
+`model/impala_actor_critic.py:73-114`). Here (b) is a `lax.scan`
+(reference backend, differentiable by autodiff) or a Pallas kernel pair
+(`ops/pallas/lstm.py`) that keeps the whole recursion in VMEM, wired up
+through `jax.custom_vjp` with a hand-derived BPTT backward kernel.
+
+Gate math (TF1 `LSTMCell` parity, forget bias 1.0):
+
+    i, f, g, o = split(gates, 4)
+    c' = sigmoid(f + 1) * c + sigmoid(i) * tanh(g)
+    h' = sigmoid(o) * tanh(c')
+
+Done-masking: the carried (h, c) are zeroed AFTER the step at which
+done[t] is set (`model/r2d2_lstm.py:78-80`); the emitted h_t is pre-mask.
+
+Shapes (batch-major public API, matching the models):
+    xg   [B, T, 4H]   input projection + bias
+    wh   [H, 4H]      recurrent weights
+    keep [B, T]       1.0 - done
+    h0/c0 [B, H]      sequence-start stored state (`agent/r2d2.py:110-111`)
+Returns (h_all [B, T, H], (hT [B, H], cT [B, H])).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.ops.pallas import resolve_backend
+
+
+def lstm_step(gates: jax.Array, c: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """One cell update from pre-activation gates. Shared by every backend."""
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    new_c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+    return new_h, new_c
+
+
+def _scan_reference(xg_tm, wh, keep_tm, h0, c0):
+    """Time-major lax.scan recursion; autodiff provides its gradient."""
+
+    def body(carry, xs):
+        h, c = carry
+        xg_t, keep_t = xs
+        gates = xg_t + jnp.dot(h, wh)
+        new_h, new_c = lstm_step(gates, c)
+        k = keep_t[:, None]
+        return (new_h * k, new_c * k), new_h
+
+    (hT, cT), h_all = jax.lax.scan(body, (h0, c0), (xg_tm, keep_tm))
+    return h_all, (hT, cT)
+
+
+def lstm_scan(
+    xg: jax.Array,
+    wh: jax.Array,
+    keep: jax.Array,
+    h0: jax.Array,
+    c0: jax.Array,
+    backend: str = "auto",
+):
+    """Run the recursion; see module docstring for shapes/semantics."""
+    backend = resolve_backend(backend)
+    xg_tm = jnp.swapaxes(xg, 0, 1)  # [T, B, 4H]
+    keep_tm = jnp.swapaxes(keep, 0, 1).astype(xg.dtype)  # [T, B]
+    if backend == "reference":
+        h_all_tm, (hT, cT) = _scan_reference(xg_tm, wh, keep_tm, h0, c0)
+    else:
+        from distributed_reinforcement_learning_tpu.ops.pallas.lstm import lstm_pallas
+
+        h_all_tm, hT, cT = lstm_pallas(
+            xg_tm, wh, keep_tm[..., None], h0, c0,
+            interpret=(backend == "pallas_interpret"),
+        )
+    return jnp.swapaxes(h_all_tm, 0, 1), (hT, cT)

@@ -1,0 +1,43 @@
+"""Pallas TPU kernels for the sequential hot ops.
+
+The two ops XLA cannot fuse well on its own are the framework's only
+truly sequential recursions (SURVEY §7 "hard parts" b):
+
+- the V-trace backward recursion (`pallas/vtrace.py`) — the reference
+  serialized a `tf.scan(parallel_iterations=1)` over it
+  (`/root/reference/optimizer/vtrace.py:86-100`),
+- the LSTM sequence unroll with done-masking (`pallas/lstm.py`) — the
+  reference replicated the whole network per timestep in Python
+  (`/root/reference/model/r2d2_lstm.py:65-112`).
+
+Both kernels keep the entire time loop in VMEM (one kernel launch per
+batch instead of T dependent HLO while-loop iterations bouncing carries
+through HBM) and are numerically validated against the `lax.scan`
+reference implementations in interpret mode on CPU.
+
+Backend selection: `resolve_backend("auto")` picks pallas on TPU and the
+lax.scan reference elsewhere; `DRL_TPU_PALLAS=0` force-disables.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def pick_block(b: int, block: int) -> int:
+    """Batch-tile size for a 1-D grid over B: tile by `block` when it
+    divides B, otherwise one program owns the whole (padded) batch."""
+    return b if b < block or b % block != 0 else block
+
+
+def resolve_backend(backend: str = "auto") -> str:
+    """-> 'pallas' | 'pallas_interpret' | 'reference'."""
+    if backend == "auto":
+        if os.environ.get("DRL_TPU_PALLAS", "1") == "0":
+            return "reference"
+        return "pallas" if jax.default_backend() == "tpu" else "reference"
+    if backend not in ("pallas", "pallas_interpret", "reference"):
+        raise ValueError(f"unknown backend {backend!r}")
+    return backend

@@ -292,8 +292,7 @@ class RemoteQueue:
         self._client = client
 
     def put(self, item: Any, timeout: float | None = None) -> bool:
-        self._client.put_trajectory(item)
-        return True
+        return self._client.put_trajectory(item)  # False = dropped (at-most-once)
 
     def size(self) -> int:
         return self._client.queue_size()

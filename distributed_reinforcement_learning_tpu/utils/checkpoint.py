@@ -54,6 +54,15 @@ class Checkpointer:
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.retain = retain
+        # Sweep tmp files orphaned by a hard kill (SIGKILL/OOM between
+        # mkstemp and os.replace) — nothing else ever deletes them, and a
+        # crash-looping learner would otherwise accumulate one
+        # TrainState-sized blob per crash.
+        for stale in self.directory.glob("*.tmp"):
+            try:
+                stale.unlink()
+            except OSError:
+                pass
 
     def _payload_path(self, step: int) -> Path:
         return self.directory / f"ckpt_{step:010d}.msgpack"

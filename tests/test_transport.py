@@ -106,7 +106,12 @@ class TestProtocol:
         assert client.ping()
         server.stop()
         server2 = TransportServer(queue, weights, host="127.0.0.1", port=port).start()
-        client.put_trajectory({"x": np.ones(1)})  # triggers reconnect internally
+        # At-most-once contract: the first put may be dropped (returns False)
+        # if the client only notices the dead connection mid-request; it must
+        # NOT be duplicated. Retry until one delivery is confirmed.
+        for _ in range(5):
+            if client.put_trajectory({"x": np.ones(1)}):
+                break
         assert queue.size() == 1
         server2.stop()
         client.close()
