@@ -25,6 +25,7 @@ from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, st
 from distributed_reinforcement_learning_tpu.data.replay import UniformBuffer, make_replay
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
+from distributed_reinforcement_learning_tpu.utils.profiling import ProfilerSession, StageTimer
 
 
 class ApexActor:
@@ -149,6 +150,8 @@ class ApexLearner:
         self._np_rng = np.random.RandomState(seed)
         self.ingested_unrolls = 0
         self.train_steps = 0
+        self.timer = StageTimer(self.logger)
+        self._profiler = ProfilerSession.from_env()
         weights.publish(self.state.params, 0)
 
     def save_checkpoint(self, ckpt) -> None:
@@ -176,13 +179,16 @@ class ApexLearner:
     def ingest(self, timeout: float | None = 0.0) -> bool:
         """Drain one unroll, score TD per transition, insert into replay
         (`train_apex.py:98-122`)."""
-        unroll = self.queue.get(timeout=timeout)
+        with self.timer.stage("ingest_dequeue"):
+            unroll = self.queue.get(timeout=timeout)
         if unroll is None:
             return False
-        td = np.asarray(self.agent.td_error(self.state, unroll))
-        self.replay.add_batch(
-            td, [jax.tree.map(lambda x: x[i], unroll) for i in range(len(td))]
-        )
+        with self.timer.stage("ingest_td"):
+            td = np.asarray(self.agent.td_error(self.state, unroll))
+        with self.timer.stage("ingest_replay_add"):
+            self.replay.add_batch(
+                td, [jax.tree.map(lambda x: x[i], unroll) for i in range(len(td))]
+            )
         self.ingested_unrolls += 1
         return True
 
@@ -190,15 +196,21 @@ class ApexLearner:
         """One prioritized train step (`train_apex.py:124-155`)."""
         if self.ingested_unrolls < self.train_start_unrolls:
             return None
-        items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
-        batch = stack_pytrees(items)
-        self.state, td, metrics = self.agent.learn(self.state, batch, is_weight)
-        self.replay.update_batch(idxs, np.asarray(td))
+        with self.timer.stage("replay_sample"):
+            items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
+            batch = stack_pytrees(items)
+        with self.timer.stage("learn"):
+            self.state, td, metrics = self.agent.learn(self.state, batch, is_weight)
+        with self.timer.stage("replay_update"):
+            self.replay.update_batch(idxs, np.asarray(td))
         self.train_steps += 1
-        self.weights.publish(self.state.params, self.train_steps)
+        with self.timer.stage("publish"):
+            self.weights.publish(self.state.params, self.train_steps)
         if self.train_steps % self.target_sync_interval == 0:
             self.state = self.agent.sync_target(self.state)
         metrics = {k: float(v) for k, v in metrics.items()}
+        self.timer.step_done(self.train_steps)
+        self._profiler.on_step(self.train_steps)
         self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
         return metrics
 

@@ -27,6 +27,7 @@ from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
 from distributed_reinforcement_learning_tpu.data.structures import ImpalaTrajectoryAccumulator
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
+from distributed_reinforcement_learning_tpu.utils.profiling import ProfilerSession, StageTimer
 
 
 class ImpalaActor:
@@ -136,6 +137,8 @@ class ImpalaLearner:
         self.state = agent.init_state(rng if rng is not None else jax.random.PRNGKey(0))
         self.train_steps = 0
         self.frames_learned = 0
+        self.timer = StageTimer(self.logger)
+        self._profiler = ProfilerSession.from_env()
         weights.publish(self.state.params, 0)
 
     def save_checkpoint(self, ckpt) -> None:
@@ -157,14 +160,21 @@ class ImpalaLearner:
 
     def step(self, timeout: float | None = None) -> dict | None:
         """One train step: drain a batch, learn, publish weights."""
-        batch = self.queue.get_batch(self.batch_size, timeout=timeout)
+        with self.timer.stage("dequeue"):
+            batch = self.queue.get_batch(self.batch_size, timeout=timeout)
         if batch is None:
             return None
-        self.state, metrics = self.agent.learn(self.state, batch)
+        with self.timer.stage("learn"):
+            self.state, metrics = self.agent.learn(self.state, batch)
         self.train_steps += 1
         self.frames_learned += self.batch_size * self.agent.cfg.trajectory
-        self.weights.publish(self.state.params, self.train_steps)
+        # publish's host snapshot (np.asarray) is the step's device sync,
+        # so "learn" above measures dispatch and "publish" compute+D2H.
+        with self.timer.stage("publish"):
+            self.weights.publish(self.state.params, self.train_steps)
         metrics = {k: float(v) for k, v in metrics.items()}
+        self.timer.step_done(self.train_steps)
+        self._profiler.on_step(self.train_steps)
         self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
         return metrics
 

@@ -24,6 +24,7 @@ from distributed_reinforcement_learning_tpu.data.replay import make_replay
 from distributed_reinforcement_learning_tpu.data.structures import R2D2SequenceAccumulator
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
+from distributed_reinforcement_learning_tpu.utils.profiling import ProfilerSession, StageTimer
 
 
 class R2D2Actor:
@@ -130,6 +131,8 @@ class R2D2Learner:
         self._np_rng = np.random.RandomState(seed)
         self.ingested_sequences = 0
         self.train_steps = 0
+        self.timer = StageTimer(self.logger)
+        self._profiler = ProfilerSession.from_env()
         weights.publish(self.state.params, 0)
 
     def save_checkpoint(self, ckpt) -> None:
@@ -155,17 +158,20 @@ class R2D2Learner:
         """Drain up to batch_size sequences; priority-score them in ONE
         batched td_error call (vs per-sequence `sess.run`s at
         `train_r2d2.py:104-119`)."""
-        seqs = []
-        for _ in range(self.batch_size):
-            seq = self.queue.get(timeout=timeout)
-            if seq is None:
-                break
-            seqs.append(seq)
+        with self.timer.stage("ingest_dequeue"):
+            seqs = []
+            for _ in range(self.batch_size):
+                seq = self.queue.get(timeout=timeout)
+                if seq is None:
+                    break
+                seqs.append(seq)
         if not seqs:
             return 0
-        batch = stack_pytrees(seqs)
-        td = np.asarray(self.agent.td_error(self.state, batch))
-        self.replay.add_batch(td, seqs)
+        with self.timer.stage("ingest_td"):
+            batch = stack_pytrees(seqs)
+            td = np.asarray(self.agent.td_error(self.state, batch))
+        with self.timer.stage("ingest_replay_add"):
+            self.replay.add_batch(td, seqs)
         self.ingested_sequences += len(seqs)
         return len(seqs)
 
@@ -173,15 +179,21 @@ class R2D2Learner:
         """One prioritized train step over sequences (`train_r2d2.py:121-164`)."""
         if self.ingested_sequences < 2 * self.batch_size:  # `train_r2d2.py:121`
             return None
-        items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
-        batch = stack_pytrees(items)
-        self.state, priorities, metrics = self.agent.learn(self.state, batch, is_weight)
-        self.replay.update_batch(idxs, np.asarray(priorities))
+        with self.timer.stage("replay_sample"):
+            items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
+            batch = stack_pytrees(items)
+        with self.timer.stage("learn"):
+            self.state, priorities, metrics = self.agent.learn(self.state, batch, is_weight)
+        with self.timer.stage("replay_update"):
+            self.replay.update_batch(idxs, np.asarray(priorities))
         self.train_steps += 1
-        self.weights.publish(self.state.params, self.train_steps)
+        with self.timer.stage("publish"):
+            self.weights.publish(self.state.params, self.train_steps)
         if self.train_steps % self.target_sync_interval == 0:
             self.state = self.agent.sync_target(self.state)
         metrics = {k: float(v) for k, v in metrics.items()}
+        self.timer.step_done(self.train_steps)
+        self._profiler.on_step(self.train_steps)
         self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
         return metrics
 

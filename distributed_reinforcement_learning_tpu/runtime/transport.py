@@ -215,8 +215,9 @@ class TransportClient:
         server may or may not have acted on the lost request, so resending
         would give at-least-once delivery (duplicated trajectories)."""
         with self._lock:
+            if self._sock is None:  # a prior failed reconnect left us down
+                self._connect()
             try:
-                assert self._sock is not None
                 _send_msg(self._sock, op, payload)
                 return _recv_msg(self._sock)
             except (TransportError, OSError):
@@ -226,7 +227,6 @@ class TransportClient:
                 self._connect()
                 if not resend:
                     raise TransportError("connection lost mid-request") from None
-                assert self._sock is not None
                 _send_msg(self._sock, op, payload)
                 return _recv_msg(self._sock)
 
@@ -331,6 +331,7 @@ def run_role(
     seed: int = 0,
     checkpoint_dir: str | None = None,
     checkpoint_interval: int = 500,
+    actor_grace: float = 120.0,
 ) -> None:
     """One process of the reference topology: `--mode learner` or
     `--mode actor --task k` (reference role flags, `train_impala.py:16-20`)."""
@@ -366,6 +367,7 @@ def run_role(
         finally:
             if ckpt is not None and learner.train_steps > 0:
                 learner.save_checkpoint(ckpt)
+            learner._profiler.close()  # flush a still-open device trace
             queue.close()
             server.stop()
         print(f"[learner] done: {learner.train_steps} updates")
@@ -378,12 +380,29 @@ def run_role(
             seed=seed + 1 + task,
         )
         print(f"[actor {task}] connected to {rt.server_ip}:{rt.server_port}")
+        # Elastic recovery (SURVEY §5.3 — the reference had none: a dead
+        # learner left actors blocked forever): on transport failure the
+        # actor keeps retrying for `actor_grace` seconds, riding out a
+        # learner restart (checkpoint resume), and only then exits. The
+        # initial connect above kept the client's generous 60-retry budget
+        # (learner may start after the actors); from here each reconnect
+        # attempt is kept short so THIS loop owns the grace deadline.
+        client.connect_retries = 3
         frames = 0
+        down_since: float | None = None
         try:
             while True:
-                frames += _actor_round(algo, actor)
-        except (TransportError, ConnectionError):
-            print(f"[actor {task}] learner gone after {frames} frames; exiting")
+                try:
+                    frames += _actor_round(algo, actor)
+                    down_since = None
+                except (TransportError, OSError):  # incl. socket timeouts
+                    now = time.time()
+                    down_since = down_since or now
+                    if now - down_since > actor_grace:
+                        print(f"[actor {task}] learner gone >{actor_grace:.0f}s "
+                              f"after {frames} frames; exiting")
+                        return
+                    time.sleep(1.0)
         finally:
             client.close()
     else:

@@ -1,0 +1,132 @@
+"""Profiling: per-stage learner timing + JAX device-trace capture.
+
+The reference's only performance signal is one wall-clock delta per train
+step logged as `data/time` (`train_impala.py:99,113` — SURVEY §5.1). Here
+profiling is first-class:
+
+- `StageTimer`: named host-side stages (dequeue / learn / publish / ...)
+  accumulated per train step and emitted through the MetricsLogger as
+  `profile/<stage>_ms` means every `log_every` steps. This splits "the
+  step took 40ms" into queue-wait vs device-compute vs weight-publication
+  — the split that tells you whether the data plane or the chip is the
+  bottleneck (SURVEY §7 hard part (a)).
+- `ProfilerSession`: captures a real `jax.profiler` device trace (XLA op
+  timeline, viewable in TensorBoard/Perfetto) for a configured window of
+  train steps. Enabled via env vars so any launcher/run picks it up:
+      DRL_PROFILE_DIR=/tmp/trace DRL_PROFILE_START=50 DRL_PROFILE_STEPS=5
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator
+
+from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
+
+
+class StageTimer:
+    """Accumulates wall-clock per named stage; logs means periodically.
+
+    Usage in a learner loop:
+        with timer.stage("dequeue"): batch = queue.get_batch(...)
+        with timer.stage("learn"):   state, m = agent.learn(...)
+        timer.step_done(train_steps)
+    """
+
+    def __init__(
+        self,
+        logger: MetricsLogger | None = None,
+        prefix: str = "profile/",
+        log_every: int = 100,
+    ):
+        self.logger = logger
+        self.prefix = prefix
+        self.log_every = log_every
+        self._sums: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+        self._steps = 0
+        self.last_means_ms: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._sums[name] = self._sums.get(name, 0.0) + (time.perf_counter() - t0)
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def step_done(self, step: int) -> None:
+        """Mark one train step; every `log_every` steps emit + reset means.
+
+        Means are per stage INVOCATION, not per train step: replay-path
+        learners run many ingest stages before their first train step
+        (warm-up gate), and a per-step divisor would smear that warm-up
+        into a wildly inflated first flush.
+        """
+        self._steps += 1
+        if self._steps < self.log_every:
+            return
+        self.last_means_ms = {
+            name: 1e3 * total / self._counts[name] for name, total in self._sums.items()
+        }
+        if self.logger is not None:
+            self.logger.add_scalars(
+                {f"{self.prefix}{n}_ms": ms for n, ms in self.last_means_ms.items()},
+                step,
+            )
+        self._sums.clear()
+        self._counts.clear()
+        self._steps = 0
+
+
+class ProfilerSession:
+    """Window-triggered `jax.profiler` trace around train steps.
+
+    `on_step(step)` is called once per train step; the trace starts when
+    `step` reaches `start_step` and stops `num_steps` later (or at
+    `close()`, whichever comes first). Inactive (no-op) unless `out_dir`
+    is set, so learners can call it unconditionally.
+    """
+
+    def __init__(self, out_dir: str | None, start_step: int = 10, num_steps: int = 5):
+        self.out_dir = out_dir
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self._active = False
+        self._done = out_dir is None
+
+    @classmethod
+    def from_env(cls) -> "ProfilerSession":
+        """DRL_PROFILE_DIR / DRL_PROFILE_START / DRL_PROFILE_STEPS."""
+        return cls(
+            os.environ.get("DRL_PROFILE_DIR") or None,
+            start_step=int(os.environ.get("DRL_PROFILE_START", "10")),
+            num_steps=int(os.environ.get("DRL_PROFILE_STEPS", "5")),
+        )
+
+    def on_step(self, step: int) -> None:
+        if self._done:
+            return
+        if not self._active and step >= self.start_step:
+            import jax
+
+            jax.profiler.start_trace(self.out_dir)
+            self._active = True
+            self._stop_at = step + self.num_steps
+        elif self._active and step >= self._stop_at:
+            self._stop()
+
+    def _stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        print(f"[profiler] device trace written to {self.out_dir}", flush=True)
+
+    def close(self) -> None:
+        if self._active:
+            self._stop()
