@@ -63,6 +63,11 @@ class TrajectoryQueue:
             self._not_full.notify_all()
             self._not_empty.notify_all()
 
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
     def put(self, item: Any, timeout: float | None = None) -> bool:
         with self._not_full:
             if not self._not_full.wait_for(

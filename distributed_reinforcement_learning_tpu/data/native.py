@@ -140,6 +140,7 @@ class NativeByteQueue:
         if not self._h:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
+        self._closed = False
 
     def __len__(self) -> int:
         return int(self._lib.rq_size(self._h))
@@ -148,7 +149,12 @@ class NativeByteQueue:
         return len(self)
 
     def close(self) -> None:
+        self._closed = True
         self._lib.rq_close(self._h)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
     def put(self, blob: bytes, timeout: float | None = None) -> bool:
         rc = self._lib.rq_put(
@@ -240,6 +246,10 @@ class NativeTrajectoryQueue:
     def close(self) -> None:
         self._q.close()
 
+    @property
+    def closed(self) -> bool:
+        return self._q.closed
+
     def put(self, item: Any, timeout: float | None = None) -> bool:
         return self.put_bytes(codec.encode(item), timeout)
 
@@ -283,6 +293,7 @@ class NativeSumTree:
         if not self._h:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
+        self._closed = False
 
     def __len__(self) -> int:
         return int(self._lib.st_size(self._h))

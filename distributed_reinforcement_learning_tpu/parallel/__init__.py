@@ -10,10 +10,12 @@ from distributed_reinforcement_learning_tpu.parallel.learner import (
     ShardedLearner,
     train_state_sharding,
 )
+from distributed_reinforcement_learning_tpu.parallel import distributed
 
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "distributed",
     "ShardedLearner",
     "data_sharding",
     "make_mesh",

@@ -1,0 +1,79 @@
+"""Multi-host runtime initialization over DCN.
+
+The reference's notion of a cluster is TF1 `ClusterSpec` static membership
+on one machine (`train_impala.py:31-35`). The TPU-native equivalent splits
+two planes (SURVEY §5.8):
+
+- **data plane** (actor<->learner trajectories/weights): the socket
+  transport in `runtime/transport.py`, host-level, works across any
+  machines — nothing here changes for multi-host.
+- **compute plane** (learner gradient collectives): on a multi-host TPU
+  pod slice, every learner process must join one JAX distributed runtime
+  so `jax.devices()` spans all hosts and the `(data, model)` mesh from
+  `parallel.mesh.make_mesh` lays collectives over ICI (intra-slice) and
+  DCN (inter-slice) automatically. This module is that join.
+
+This is the compute-plane join PRIMITIVE, not a turnkey multi-host
+learner: a multi-host learn step additionally needs each process to feed
+its local shard of the global batch (e.g. via
+`jax.make_array_from_process_local_data`), which the runtime loop does
+not do yet — `runtime/transport.run_role` therefore uses a LOCAL-device
+mesh only. Usage, one call before any other jax use in each process:
+
+    from distributed_reinforcement_learning_tpu.parallel import distributed
+    distributed.initialize()          # env-driven, no-op single-host
+
+Env contract (mirrors `jax.distributed.initialize`'s own variables, with
+a DRL_ prefix so launch scripts can't collide with other JAX users):
+    DRL_COORDINATOR=host0:9900  DRL_NUM_PROCESSES=4  DRL_PROCESS_ID=0
+On GKE/Cloud-TPU the three can be omitted entirely: jax auto-detects from
+the TPU metadata and this reduces to `jax.distributed.initialize()`.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-host JAX runtime; returns True if a join happened.
+
+    Explicit args win over DRL_* env vars. With neither present this is a
+    single-host no-op, so launchers may call it unconditionally. Safe to
+    call twice (second call is a no-op).
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("DRL_COORDINATOR")
+    if num_processes is None and "DRL_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["DRL_NUM_PROCESSES"])
+    if process_id is None and "DRL_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DRL_PROCESS_ID"])
+
+    if coordinator_address is None and num_processes is None:
+        return False  # single-host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count) — (0, 1) when single-host."""
+    return jax.process_index(), jax.process_count()

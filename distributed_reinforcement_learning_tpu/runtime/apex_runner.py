@@ -136,6 +136,7 @@ class ApexLearner:
         logger: MetricsLogger | None = None,
         rng: jax.Array | None = None,
         seed: int = 0,
+        mesh=None,
     ):
         self.agent = agent
         self.queue = queue
@@ -145,7 +146,21 @@ class ApexLearner:
         self.target_sync_interval = target_sync_interval
         self.train_start_unrolls = train_start_unrolls
         self.logger = logger or MetricsLogger(None)
-        self.state = agent.init_state(rng if rng is not None else jax.random.PRNGKey(0))
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # Multi-chip learn step: batch + IS weights sharded over the data
+        # axis; state replicated/model-sharded (parallel/learner.py).
+        self._batch_sharding = None
+        if mesh is not None:
+            from distributed_reinforcement_learning_tpu.parallel import ShardedLearner, data_sharding
+
+            self._sharded = ShardedLearner(agent, mesh, num_data_args=2, num_aux_outputs=2)
+            self._learn = self._sharded.learn
+            self._batch_sharding = data_sharding(mesh)
+            self.state = self._sharded.init_state(rng)
+        else:
+            self._sharded = None
+            self._learn = agent.learn
+            self.state = agent.init_state(rng)
         self.state = agent.sync_target(self.state)
         self._np_rng = np.random.RandomState(seed)
         self.ingested_unrolls = 0
@@ -200,7 +215,10 @@ class ApexLearner:
             items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
             batch = stack_pytrees(items)
         with self.timer.stage("learn"):
-            self.state, td, metrics = self.agent.learn(self.state, batch, is_weight)
+            if self._batch_sharding is not None:
+                batch = jax.device_put(batch, self._batch_sharding)
+                is_weight = jax.device_put(is_weight, self._batch_sharding)
+            self.state, td, metrics = self._learn(self.state, batch, is_weight)
         with self.timer.stage("replay_update"):
             self.replay.update_batch(idxs, np.asarray(td))
         self.train_steps += 1
@@ -214,18 +232,24 @@ class ApexLearner:
         self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
         return metrics
 
+    def close(self) -> None:
+        self._profiler.close()
+
 
 def run_sync(learner: ApexLearner, actors: list[ApexActor], num_updates: int,
              actor_steps_per_round: int = 8) -> dict:
     """Interleaved stepping for tests/single-host training."""
     metrics: dict = {}
-    while learner.train_steps < num_updates:
-        for actor in actors:
-            actor.run_steps(actor_steps_per_round)
-        while learner.ingest(timeout=0.0):
-            pass
-        m = learner.train()
-        if m is not None:
-            metrics = m
+    try:
+        while learner.train_steps < num_updates:
+            for actor in actors:
+                actor.run_steps(actor_steps_per_round)
+            while learner.ingest(timeout=0.0):
+                pass
+            m = learner.train()
+            if m is not None:
+                metrics = m
+    finally:
+        learner.close()
     returns = [r for a in actors for r in a.episode_returns]
     return {"last_metrics": metrics, "episode_returns": returns}

@@ -128,13 +128,43 @@ class ImpalaLearner:
         batch_size: int,
         logger: MetricsLogger | None = None,
         rng: jax.Array | None = None,
+        prefetch: bool = False,
+        mesh=None,
     ):
         self.agent = agent
         self.queue = queue
         self.weights = weights
         self.batch_size = batch_size
         self.logger = logger or MetricsLogger(None)
-        self.state = agent.init_state(rng if rng is not None else jax.random.PRNGKey(0))
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # Multi-chip learner: pjit the learn step over the mesh, batch
+        # sharded on the data axis, params/moments replicated or
+        # model-sharded (parallel/learner.py). The reference has no
+        # equivalent — its learner is one process's TF variables.
+        self._batch_sharding = None
+        if mesh is not None:
+            from distributed_reinforcement_learning_tpu.parallel import ShardedLearner, data_sharding
+
+            self._sharded = ShardedLearner(agent, mesh)
+            self._learn = self._sharded.learn
+            self._batch_sharding = data_sharding(mesh)
+        else:
+            self._sharded = None
+            self._learn = agent.learn
+        # Double-buffered host->device pipeline (SURVEY §7 hard part (a)):
+        # batch k+1 is dequeued/stacked/device_put while batch k trains.
+        # Off in sync/test mode (a background consumer would race the
+        # deterministic interleave).
+        self._prefetcher = None
+        if prefetch:
+            from distributed_reinforcement_learning_tpu.data.prefetch import DevicePrefetcher
+
+            self._prefetcher = DevicePrefetcher(
+                queue, batch_size, sharding=self._batch_sharding)
+        self.state = (
+            self._sharded.init_state(rng) if self._sharded is not None
+            else agent.init_state(rng)
+        )
         self.train_steps = 0
         self.frames_learned = 0
         self.timer = StageTimer(self.logger)
@@ -161,11 +191,16 @@ class ImpalaLearner:
     def step(self, timeout: float | None = None) -> dict | None:
         """One train step: drain a batch, learn, publish weights."""
         with self.timer.stage("dequeue"):
-            batch = self.queue.get_batch(self.batch_size, timeout=timeout)
+            if self._prefetcher is not None:
+                batch = self._prefetcher.get_batch(timeout=timeout)
+            else:
+                batch = self.queue.get_batch(self.batch_size, timeout=timeout)
         if batch is None:
             return None
         with self.timer.stage("learn"):
-            self.state, metrics = self.agent.learn(self.state, batch)
+            if self._batch_sharding is not None and self._prefetcher is None:
+                batch = jax.device_put(batch, self._batch_sharding)
+            self.state, metrics = self._learn(self.state, batch)
         self.train_steps += 1
         self.frames_learned += self.batch_size * self.agent.cfg.trajectory
         # publish's host snapshot (np.asarray) is the step's device sync,
@@ -177,6 +212,14 @@ class ImpalaLearner:
         self._profiler.on_step(self.train_steps)
         self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
         return metrics
+
+    def close(self) -> None:
+        """Stop the prefetch thread and flush any open profiler trace.
+
+        Called by every run path (run_sync/run_async/run_role) on exit."""
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+        self._profiler.close()
 
 
 def run_sync(
@@ -199,13 +242,16 @@ def run_sync(
         )
     frames = 0
     metrics: dict = {}
-    while learner.train_steps < num_updates:
-        while learner.queue.size() < learner.batch_size:
-            for actor in actors:
-                frames += actor.run_unroll()
-        m = learner.step(timeout=10.0)
-        if m is not None:
-            metrics = m
+    try:
+        while learner.train_steps < num_updates:
+            while learner.queue.size() < learner.batch_size:
+                for actor in actors:
+                    frames += actor.run_unroll()
+            m = learner.step(timeout=10.0)
+            if m is not None:
+                metrics = m
+    finally:
+        learner.close()
     returns = [r for a in actors for r in a.episode_returns]
     return {"frames": frames, "last_metrics": metrics, "episode_returns": returns}
 
@@ -235,6 +281,7 @@ def run_async(
             learner.step(timeout=30.0)
     finally:
         stop.set()
+        learner.close()
         queue.close()
         for t in threads:
             t.join(timeout=5.0)

@@ -55,21 +55,28 @@ _AGENT_CLS = {"impala": ImpalaAgent, "apex": ApexAgent, "r2d2": R2D2Agent}
 
 
 def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
-                 logger: MetricsLogger | None = None, rng: Any = None, agent=None):
-    """Learner runner over any queue/weight-store (in-process or served)."""
+                 logger: MetricsLogger | None = None, rng: Any = None, agent=None,
+                 prefetch: bool = False, mesh=None):
+    """Learner runner over any queue/weight-store (in-process or served).
+
+    `mesh`: optional `jax.sharding.Mesh` — the learn step is pjit-sharded
+    over it (batch on the data axis) instead of running single-device."""
     agent = agent or _AGENT_CLS[algo](agent_cfg)
     if algo == "impala":
         return impala_runner.ImpalaLearner(
-            agent, queue, weights, rt.batch_size, logger=logger, rng=rng)
+            agent, queue, weights, rt.batch_size, logger=logger, rng=rng,
+            prefetch=prefetch, mesh=mesh)
     if algo == "apex":
         return apex_runner.ApexLearner(
             agent, queue, weights, rt.batch_size,
             replay_capacity=rt.replay_capacity,
-            target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng)
+            target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng,
+            mesh=mesh)
     return r2d2_runner.R2D2Learner(
         agent, queue, weights, rt.batch_size,
         replay_capacity=rt.replay_capacity,
-        target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng)
+        target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng,
+        mesh=mesh)
 
 
 def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, weights,

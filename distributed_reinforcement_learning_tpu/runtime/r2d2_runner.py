@@ -118,6 +118,7 @@ class R2D2Learner:
         logger: MetricsLogger | None = None,
         rng: jax.Array | None = None,
         seed: int = 0,
+        mesh=None,
     ):
         self.agent = agent
         self.queue = queue
@@ -126,7 +127,19 @@ class R2D2Learner:
         self.replay = make_replay(replay_capacity)
         self.target_sync_interval = target_sync_interval
         self.logger = logger or MetricsLogger(None)
-        self.state = agent.init_state(rng if rng is not None else jax.random.PRNGKey(0))
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._batch_sharding = None
+        if mesh is not None:
+            from distributed_reinforcement_learning_tpu.parallel import ShardedLearner, data_sharding
+
+            self._sharded = ShardedLearner(agent, mesh, num_data_args=2, num_aux_outputs=2)
+            self._learn = self._sharded.learn
+            self._batch_sharding = data_sharding(mesh)
+            self.state = self._sharded.init_state(rng)
+        else:
+            self._sharded = None
+            self._learn = agent.learn
+            self.state = agent.init_state(rng)
         self.state = agent.sync_target(self.state)
         self._np_rng = np.random.RandomState(seed)
         self.ingested_sequences = 0
@@ -183,7 +196,10 @@ class R2D2Learner:
             items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
             batch = stack_pytrees(items)
         with self.timer.stage("learn"):
-            self.state, priorities, metrics = self.agent.learn(self.state, batch, is_weight)
+            if self._batch_sharding is not None:
+                batch = jax.device_put(batch, self._batch_sharding)
+                is_weight = jax.device_put(is_weight, self._batch_sharding)
+            self.state, priorities, metrics = self._learn(self.state, batch, is_weight)
         with self.timer.stage("replay_update"):
             self.replay.update_batch(idxs, np.asarray(priorities))
         self.train_steps += 1
@@ -197,15 +213,21 @@ class R2D2Learner:
         self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
         return metrics
 
+    def close(self) -> None:
+        self._profiler.close()
+
 
 def run_sync(learner: R2D2Learner, actors: list[R2D2Actor], num_updates: int) -> dict:
     metrics: dict = {}
-    while learner.train_steps < num_updates:
-        for actor in actors:
-            actor.run_unroll()
-        learner.ingest_batch(timeout=0.0)
-        m = learner.train()
-        if m is not None:
-            metrics = m
+    try:
+        while learner.train_steps < num_updates:
+            for actor in actors:
+                actor.run_unroll()
+            learner.ingest_batch(timeout=0.0)
+            m = learner.train()
+            if m is not None:
+                metrics = m
+    finally:
+        learner.close()
     returns = [r for a in actors for r in a.episode_returns]
     return {"last_metrics": metrics, "episode_returns": returns}

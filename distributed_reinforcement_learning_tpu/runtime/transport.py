@@ -157,9 +157,14 @@ class TransportServer:
                             ok = self.queue.put(codec.decode(payload, copy=True), timeout=30.0)
                         _send_msg(conn, ST_OK if ok else ST_BUSY)
                     elif op == OP_GET_WEIGHTS:
+                        # Versions are snapshot IDENTITIES across the wire,
+                        # not an ordering: a restarted learner republishes
+                        # from version 0, and a surviving actor holding the
+                        # old incarnation's higher version must still be
+                        # updated — so send whenever version != have.
                         have = _I64.unpack(payload)[0]
                         version, blob = self._weights_blob()
-                        if version <= have:
+                        if version == have or version < 0:
                             _send_msg(conn, ST_OK, _I64.pack(have))
                         else:
                             _send_msg(conn, ST_OK, _I64.pack(version), blob)
@@ -263,7 +268,7 @@ class TransportClient:
     def get_weights_if_newer(self, have_version: int) -> tuple[Any, int] | None:
         resp = self._call(OP_GET_WEIGHTS, _I64.pack(have_version))
         version = _I64.unpack(resp[: _I64.size])[0]
-        if version <= have_version:
+        if version == have_version:  # identity match (see server comment)
             return None
         return codec.decode(resp[_I64.size :], copy=True), version
 
@@ -344,6 +349,17 @@ def run_role(
     agent_cfg, rt = load_config(config_path, section)
 
     if mode == "learner":
+        # Multi-chip learner: when this process sees >1 device (a TPU
+        # slice, or the CPU simulation), pjit the learn step over a
+        # data-axis mesh of the LOCAL devices. (Multi-host meshes need a
+        # per-host batch feed on top of parallel.distributed.initialize();
+        # the socket data plane itself already spans hosts.)
+        mesh = None
+        if len(jax.local_devices()) > 1 and rt.batch_size % len(jax.local_devices()) == 0:
+            from distributed_reinforcement_learning_tpu.parallel import make_mesh
+
+            mesh = make_mesh(devices=jax.local_devices())
+            print(f"[learner] multi-chip mesh: {dict(mesh.shape)}")
         logger = MetricsLogger(run_dir)  # actors log nothing: no writer for them
         queue = _make_queue(rt.queue_size)
         from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
@@ -352,6 +368,9 @@ def run_role(
         learner = launch.make_learner(
             algo, agent_cfg, rt, queue, weights, logger=logger,
             rng=jax.random.PRNGKey(seed),
+            # Free-running learner: overlap H2D of batch k+1 with step k.
+            prefetch=(algo == "impala"),
+            mesh=mesh,
         )
         ckpt = None
         if checkpoint_dir is not None:
@@ -367,7 +386,7 @@ def run_role(
         finally:
             if ckpt is not None and learner.train_steps > 0:
                 learner.save_checkpoint(ckpt)
-            learner._profiler.close()  # flush a still-open device trace
+            learner.close()  # stop prefetch thread, flush open profiler trace
             queue.close()
             server.stop()
         print(f"[learner] done: {learner.train_steps} updates")

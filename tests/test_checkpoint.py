@@ -151,7 +151,7 @@ def test_run_role_learner_resumes(tmp_path):
     def run_actor():
         try:
             transport.run_role("impala", str(cfg_path), "impala_cartpole",
-                               "actor", 0, seed=1, actor_grace=30.0)
+                               "actor", 0, seed=1, actor_grace=15.0)
         except Exception:
             pass
 
@@ -167,3 +167,7 @@ def test_run_role_learner_resumes(tmp_path):
     # Second learner resumes at 3 and trains to 5 fed by the SAME actor.
     run_learner(5)
     assert Checkpointer(ckpt_dir).latest_step() == 5
+    # Don't leak the actor into later tests: it exits once its 15s grace
+    # window on the now-dead learner expires.
+    actor_t.join(timeout=25)
+    assert not actor_t.is_alive()

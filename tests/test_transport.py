@@ -163,3 +163,41 @@ class TestDistributedImpala:
             server.stop()
             t.join(timeout=5.0)
             client.close()
+
+
+def test_weight_versions_are_identities_across_restart():
+    """A surviving actor holding the old incarnation's high version must
+    receive the restarted learner's (lower-numbered) weights — versions
+    are snapshot identities over the wire, not an ordering."""
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        RemoteWeights, TransportClient, TransportServer)
+    from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+    queue, weights = TrajectoryQueue(8), WeightStore()
+    port = _free_port()
+    server = TransportServer(queue, weights, host="127.0.0.1", port=port).start()
+    client = TransportClient("127.0.0.1", port)
+    try:
+        rw = RemoteWeights(client)
+        weights.publish({"w": np.full(3, 7.0, np.float32)}, version=50)
+        params, v = rw.get_if_newer(-1)
+        assert v == 50
+
+        # "Restart": fresh store republishing from version 0.
+        weights2 = WeightStore()
+        weights2.publish({"w": np.full(3, 9.0, np.float32)}, version=0)
+        server.stop()
+        server = TransportServer(queue, weights2, host="127.0.0.1", port=port).start()
+        got = None
+        for _ in range(5):  # at-most-once reconnect may need one retry
+            try:
+                got = rw.get_if_newer(v)
+                break
+            except Exception:
+                continue
+        assert got is not None, "stale actor never got restarted learner's weights"
+        params2, v2 = got
+        assert v2 == 0 and float(params2["w"][0]) == 9.0
+    finally:
+        server.stop()
+        client.close()

@@ -21,6 +21,8 @@ def main() -> None:
     p.add_argument("--checkpoint_dir", default=None,
                    help="learner mode: save/resume TrainState checkpoints here")
     p.add_argument("--checkpoint_interval", type=int, default=500)
+    p.add_argument("--actor_grace", type=float, default=120.0,
+                   help="actor mode: seconds to ride out a learner outage before exiting")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. 'cpu'); actors default to cpu "
                         "so they never grab the TPU chip")
@@ -43,7 +45,8 @@ def main() -> None:
         run_role("r2d2", args.config, args.section, args.mode, args.task,
                  num_updates=args.updates, run_dir=args.run_dir, seed=args.seed,
                  checkpoint_dir=args.checkpoint_dir,
-                 checkpoint_interval=args.checkpoint_interval)
+                 checkpoint_interval=args.checkpoint_interval,
+                 actor_grace=args.actor_grace)
 
 
 if __name__ == "__main__":
