@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time
 from typing import Any, Callable
 
 import jax
@@ -50,6 +51,7 @@ class DevicePrefetcher:
         self.sharding = sharding
         self.transform = transform
         self._out: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
+        self._error: BaseException | None = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="device-prefetch"
@@ -57,11 +59,22 @@ class DevicePrefetcher:
         self._thread.start()
 
     def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as e:  # noqa: BLE001 — surfaced via get_batch
+            # A dead prefetch pipeline must be distinguishable from slow
+            # actors: record the failure so get_batch re-raises it instead
+            # of the learner polling timeouts forever.
+            self._error = e
+
+    def _loop_inner(self) -> None:
         while not self._stop.is_set():
             try:
                 batch = self.source.get_batch(self.batch_size, timeout=0.2)
-            except RuntimeError:  # defensive: some sources raise when closed
-                return
+            except RuntimeError:
+                if getattr(self.source, "closed", False):
+                    return  # orderly shutdown
+                raise  # genuine failure: record via _loop, don't die silently
             if batch is None:
                 # A closed+drained source returns None instantly — exit
                 # rather than hot-spin on it (closed is sticky).
@@ -84,11 +97,21 @@ class DevicePrefetcher:
                     continue
 
     def get_batch(self, timeout: float | None = None) -> Any | None:
-        """Next device-resident batch; None on timeout (learner idles)."""
-        try:
-            return self._out.get(timeout=timeout)
-        except _queue.Empty:
-            return None
+        """Next device-resident batch; None on timeout (learner idles).
+
+        Raises the prefetch thread's failure (if it died) rather than
+        returning None forever. timeout=None blocks — but in slices, so a
+        thread death still surfaces instead of hanging the blocking get."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self._out.get(timeout=0.2 if deadline is None
+                                     else max(0.0, min(0.2, deadline - time.monotonic())))
+            except _queue.Empty:
+                if self._error is not None:
+                    raise RuntimeError("prefetch thread died") from self._error
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
 
     def close(self) -> None:
         self._stop.set()

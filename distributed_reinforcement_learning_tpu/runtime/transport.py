@@ -88,6 +88,8 @@ class TransportServer:
         self._sock: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         self._enc_lock = threading.Lock()
         self._enc_cache: tuple[int, bytes] = (-1, b"")
 
@@ -106,6 +108,22 @@ class TransportServer:
         self._stop.set()
         if self._sock is not None:
             self._sock.close()
+        # Closing the listener alone is not enough: _serve threads sit
+        # blocked in _recv_msg on their accepted sockets and would outlive
+        # this incarnation, still answering a surviving actor from the OLD
+        # WeightStore after a learner restart. Close every accepted conn so
+        # the handlers unblock (OSError) and exit now.
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         for t in self._threads:
             t.join(timeout=2.0)
 
@@ -119,6 +137,11 @@ class TransportServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                if self._stop.is_set():  # raced with stop(): don't serve
+                    conn.close()
+                    return
+                self._conns.add(conn)
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
             # Prune finished connection threads so reconnect churn over a
@@ -137,51 +160,74 @@ class TransportServer:
             return self._enc_cache
 
     def _serve(self, conn: socket.socket) -> None:
-        with conn:
-            while not self._stop.is_set():
-                try:
-                    op, payload = _recv_msg(conn)
-                except (TransportError, OSError):
-                    return
-                try:
-                    if op == OP_PUT_TRAJ:
-                        # Blocking enqueue: replying only after acceptance is
-                        # the actors' backpressure (reference: blocking
-                        # enqueue op, buffer_queue.py:398-414). Bounded wait
-                        # so a stalled learner (e.g. a minutes-long first jit
-                        # compile with a full queue) surfaces as retryable
-                        # ST_BUSY instead of hanging — or killing — actors.
-                        if hasattr(self.queue, "put_bytes"):
-                            ok = self.queue.put_bytes(payload, timeout=30.0)
-                        else:
-                            ok = self.queue.put(codec.decode(payload, copy=True), timeout=30.0)
-                        _send_msg(conn, ST_OK if ok else ST_BUSY)
-                    elif op == OP_GET_WEIGHTS:
-                        # Versions are snapshot IDENTITIES across the wire,
-                        # not an ordering: a restarted learner republishes
-                        # from version 0, and a surviving actor holding the
-                        # old incarnation's higher version must still be
-                        # updated — so send whenever version != have.
-                        have = _I64.unpack(payload)[0]
-                        version, blob = self._weights_blob()
-                        if version == have or version < 0:
-                            _send_msg(conn, ST_OK, _I64.pack(have))
-                        else:
-                            _send_msg(conn, ST_OK, _I64.pack(version), blob)
-                    elif op == OP_QUEUE_SIZE:
-                        _send_msg(conn, ST_OK, _I64.pack(self.queue.size()))
-                    elif op == OP_PING:
-                        _send_msg(conn, ST_OK)
+        try:
+            self._serve_inner(conn)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _enqueue(self, payload: bytes, total_wait: float = 30.0) -> bool:
+        """Blocking enqueue in _stop-aware slices. The bounded total wait
+        keeps a stalled learner (e.g. a minutes-long first jit compile with
+        a full queue) surfacing as retryable ST_BUSY; the slicing keeps
+        stop() from being ignored by a handler parked in queue.put (the
+        socket close only interrupts recv, not a queue wait)."""
+        deadline = time.monotonic() + total_wait
+        raw = hasattr(self.queue, "put_bytes")
+        item = payload if raw else codec.decode(payload, copy=True)
+        while not self._stop.is_set():
+            slice_t = min(0.5, deadline - time.monotonic())
+            if slice_t <= 0:
+                return False
+            ok = self.queue.put_bytes(item, timeout=slice_t) if raw else \
+                self.queue.put(item, timeout=slice_t)
+            if ok:
+                return True
+        return False
+
+    def _serve_inner(self, conn: socket.socket) -> None:
+        while not self._stop.is_set():
+            try:
+                op, payload = _recv_msg(conn)
+            except (TransportError, OSError):
+                return
+            try:
+                if op == OP_PUT_TRAJ:
+                    # Replying only after acceptance is the actors'
+                    # backpressure (reference: blocking enqueue op,
+                    # buffer_queue.py:398-414).
+                    ok = self._enqueue(payload)
+                    _send_msg(conn, ST_OK if ok else ST_BUSY)
+                elif op == OP_GET_WEIGHTS:
+                    # Versions are snapshot IDENTITIES across the wire,
+                    # not an ordering: a restarted learner republishes
+                    # from version 0, and a surviving actor holding the
+                    # old incarnation's higher version must still be
+                    # updated — so send whenever version != have.
+                    have = _I64.unpack(payload)[0]
+                    version, blob = self._weights_blob()
+                    if version == have or version < 0:
+                        _send_msg(conn, ST_OK, _I64.pack(have))
                     else:
-                        _send_msg(conn, ST_ERROR)
-                except RuntimeError:  # queue closed -> learner shutting down
-                    try:
-                        _send_msg(conn, ST_CLOSED)
-                    except OSError:
-                        pass
-                    return
-                except (TransportError, OSError):
-                    return
+                        _send_msg(conn, ST_OK, _I64.pack(version), blob)
+                elif op == OP_QUEUE_SIZE:
+                    _send_msg(conn, ST_OK, _I64.pack(self.queue.size()))
+                elif op == OP_PING:
+                    _send_msg(conn, ST_OK)
+                else:
+                    _send_msg(conn, ST_ERROR)
+            except RuntimeError:  # queue closed -> learner shutting down
+                try:
+                    _send_msg(conn, ST_CLOSED)
+                except OSError:
+                    pass
+                return
+            except (TransportError, OSError):
+                return
 
 
 class TransportClient:
@@ -193,10 +239,12 @@ class TransportClient:
         port: int,
         connect_retries: int = 60,
         retry_interval: float = 1.0,
+        busy_timeout: float = 90.0,
     ):
         self.host, self.port = host, port
         self.connect_retries = connect_retries
         self.retry_interval = retry_interval
+        self.busy_timeout = busy_timeout
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
         self._connect()
@@ -248,8 +296,14 @@ class TransportClient:
         learner's bounded queue is full — the reference's blocking-enqueue
         backpressure. At-most-once: if the connection drops mid-request the
         unroll is dropped, not resent (returns False); losing one off-policy
-        unroll is harmless, training on a duplicate is not."""
+        unroll is harmless, training on a duplicate is not.
+
+        ST_BUSY retries are bounded by `busy_timeout`: a wedged-but-alive
+        learner (queue permanently full) must surface as TransportError so
+        the actor-side elastic-recovery grace deadline owns the failure,
+        instead of this loop blocking the actor forever."""
         blob = codec.encode(tree)
+        busy_since: float | None = None
         while True:
             try:
                 status, _ = self._exchange(OP_PUT_TRAJ, blob, retry=True, resend=False)
@@ -260,6 +314,12 @@ class TransportClient:
             if status == ST_OK:
                 return True
             if status == ST_BUSY:  # learner alive but queue full: keep pushing
+                now = time.monotonic()
+                busy_since = busy_since or now
+                if now - busy_since > self.busy_timeout:
+                    raise TransportError(
+                        f"learner queue busy for >{self.busy_timeout:.0f}s"
+                    )
                 continue
             if status == ST_CLOSED:
                 raise TransportError("learner closed the data plane")

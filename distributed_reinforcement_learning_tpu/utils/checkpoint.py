@@ -35,7 +35,17 @@ def _atomic_write(path: Path, data: bytes) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
+            # fsync before replace: os.replace is atomic in the namespace
+            # but not on disk — without the flush a power loss can commit
+            # a truncated payload under the final name.
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        dirfd = os.open(path.parent, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -63,6 +73,17 @@ class Checkpointer:
                 stale.unlink()
             except OSError:
                 pass
+        # Sweep sidecars without a committed payload: save() writes the
+        # extra.json first (the msgpack is the commit marker), so a crash
+        # between the two leaves an orphan that _prune — which iterates
+        # committed steps only — would never delete.
+        for extra in self.directory.glob("ckpt_*.extra.json"):
+            payload = extra.with_name(extra.name.replace(".extra.json", ".msgpack"))
+            if not payload.exists():
+                try:
+                    extra.unlink()
+                except OSError:
+                    pass
 
     def _payload_path(self, step: int) -> Path:
         return self.directory / f"ckpt_{step:010d}.msgpack"

@@ -49,6 +49,12 @@ class StageTimer:
         self._steps = 0
         self.last_means_ms: dict[str, float] = {}
 
+    def reset(self) -> None:
+        """Drop accumulated sums (e.g. to exclude a warm-up/compile step)."""
+        self._sums.clear()
+        self._counts.clear()
+        self._steps = 0
+
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()

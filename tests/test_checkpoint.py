@@ -171,3 +171,19 @@ def test_run_role_learner_resumes(tmp_path):
     # window on the now-dead learner expires.
     actor_t.join(timeout=25)
     assert not actor_t.is_alive()
+
+
+def test_orphan_sidecar_swept_on_startup(tmp_path):
+    """A crash between the extra.json write and the msgpack commit leaves a
+    sidecar with no payload; the startup sweep must delete it (retention
+    pruning only iterates committed steps and would never see it)."""
+    from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(tmp_path)
+    orphan = tmp_path / "ckpt_0000000007.extra.json"
+    orphan.write_text("{}")
+    ckpt.save(1, {"w": np.ones(2, np.float32)}, extra={"k": 1})
+    ckpt2 = Checkpointer(tmp_path)
+    assert not orphan.exists()
+    assert ckpt2.steps() == [1]
+    assert (tmp_path / "ckpt_0000000001.extra.json").exists()

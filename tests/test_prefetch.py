@@ -75,3 +75,21 @@ def test_impala_learner_with_prefetch_trains():
         assert learner.train_steps >= 5
     finally:
         learner.close()
+
+
+def test_prefetcher_surfaces_source_failure():
+    """A dead prefetch thread must be distinguishable from slow actors:
+    get_batch re-raises the thread's failure instead of timing out forever."""
+    import pytest
+
+    from distributed_reinforcement_learning_tpu.data.prefetch import DevicePrefetcher
+
+    class ExplodingSource:
+        def get_batch(self, batch_size, timeout=None):
+            raise ValueError("disk on fire")
+
+    pf = DevicePrefetcher(ExplodingSource(), batch_size=4)
+    with pytest.raises(RuntimeError, match="prefetch thread died"):
+        for _ in range(50):  # bounded: the error lands within a few polls
+            pf.get_batch(timeout=0.1)
+    pf.close()

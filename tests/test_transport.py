@@ -201,3 +201,42 @@ def test_weight_versions_are_identities_across_restart():
     finally:
         server.stop()
         client.close()
+
+
+def test_stop_closes_accepted_connections():
+    """stop() must unblock _serve threads sitting in recv on accepted
+    sockets — otherwise a surviving actor is still answered by the old
+    incarnation's handler (and its old WeightStore) after a restart."""
+    queue, weights = TrajectoryQueue(8), WeightStore()
+    port = _free_port()
+    server = TransportServer(queue, weights, host="127.0.0.1", port=port).start()
+    client = TransportClient("127.0.0.1", port)
+    assert client.ping()  # connection accepted, handler now blocked in recv
+    t0 = time.monotonic()
+    server.stop()
+    assert time.monotonic() - t0 < 3.0
+    assert all(not t.is_alive() for t in server._threads)
+    client.close()
+
+
+def test_put_trajectory_busy_timeout():
+    """A wedged-but-alive learner (queue permanently refusing items) must
+    surface as TransportError within busy_timeout so the actor-side grace
+    deadline owns the failure, not an unbounded ST_BUSY loop."""
+
+    class WedgedQueue:
+        def put(self, item, timeout=None):
+            return False  # always busy, instantly
+
+        def size(self):
+            return 0
+
+    port = _free_port()
+    server = TransportServer(WedgedQueue(), WeightStore(), host="127.0.0.1", port=port).start()
+    client = TransportClient("127.0.0.1", port, busy_timeout=0.3)
+    try:
+        with pytest.raises(TransportError, match="busy"):
+            client.put_trajectory({"x": np.ones(1)})
+    finally:
+        server.stop()
+        client.close()
