@@ -84,9 +84,13 @@ class DevicePrefetcher:
             if self.transform is not None:
                 batch = self.transform(batch)
             # Async H2D: device_put returns immediately, the transfer
-            # overlaps with whatever the device is computing.
+            # overlaps with whatever the device is computing. Multi-host
+            # meshes route through make_array_from_process_local_data
+            # (parallel.mesh.place_local_batch).
             if self.sharding is not None:
-                batch = jax.device_put(batch, self.sharding)
+                from distributed_reinforcement_learning_tpu.parallel import place_local_batch
+
+                batch = place_local_batch(batch, self.sharding)
             else:
                 batch = jax.device_put(batch)
             while not self._stop.is_set():

@@ -135,6 +135,30 @@ class PrioritizedReplay:
         for idx, err in zip(idxs, errors):
             self.update(int(idx), float(err))
 
+    def snapshot(self) -> dict:
+        """Serializable state: payloads + already-transformed priorities.
+
+        SURVEY §5.4's optional replay snapshot — without it a restarted
+        Ape-X/R2D2 learner resumes with an empty Memory while actors keep
+        pushing stale-policy re-samples.
+        """
+        n = len(self.tree)
+        cap = self.tree.capacity
+        return {
+            "priorities": self.tree._tree[cap - 1 : cap - 1 + n].copy(),
+            "items": [self.tree._data[i] for i in range(n)],
+            "beta": float(self.beta),
+        }
+
+    def restore(self, snap: dict) -> None:
+        """Rebuild from `snapshot()`. Contents and priorities are exact;
+        the ring write cursor restarts at `count % capacity`, so after a
+        wrapped buffer the future *eviction order* differs from the
+        original — harmless for replay semantics."""
+        for p, item in zip(snap["priorities"], snap["items"]):
+            self.tree.add(float(p), item)  # raw: already |err|^alpha-transformed
+        self.beta = float(snap["beta"])
+
 
 class NativePrioritizedReplay:
     """`PrioritizedReplay` surface over the C++ SumTree (cpp/sumtree.cc).
@@ -217,6 +241,27 @@ class NativePrioritizedReplay:
 
     def update_batch(self, idxs: np.ndarray, errors: np.ndarray) -> None:
         self.tree.update_batch(np.asarray(idxs, np.int64), self._priority(errors))
+
+    def snapshot(self) -> dict:
+        """Same contract as `PrioritizedReplay.snapshot` over the C++ tree."""
+        with self._lock:
+            n = len(self.tree)
+            cap = self.tree.capacity
+            priorities = np.array(
+                [self.tree.leaf_priority(slot + cap - 1) for slot in range(n)], np.float64
+            )
+            return {
+                "priorities": priorities,
+                "items": [self._data[i] for i in range(n)],
+                "beta": float(self.beta),
+            }
+
+    def restore(self, snap: dict) -> None:
+        with self._lock:
+            slots = self.tree.add_batch(np.asarray(snap["priorities"], np.float64))
+            for slot, item in zip(slots, snap["items"]):
+                self._data[slot] = item
+            self.beta = float(snap["beta"])
 
 
 def make_replay(capacity: int, beta: float = 0.4, backend: str = "auto"):

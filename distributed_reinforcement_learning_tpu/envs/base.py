@@ -1,11 +1,13 @@
 """Environment API.
 
 The reference drives OpenAI Gym envs (`wrappers.py`, `train_*.py` loops).
-This image has no gym/ALE, so the framework defines its own minimal env
-protocol with the same step/reset contract, an in-tree CartPole physics
-implementation, and adapters/wrappers mirroring the reference's Atari
-pipeline. Anything needing a real Atari emulator is gated behind the
-`RawFrameEnv` protocol — plug in ALE when available.
+The framework defines its own minimal env protocol with the same
+step/reset contract, an in-tree CartPole physics implementation, a
+gymnasium adapter (`envs/gymnasium_env.py` — gymnasium ships in this
+image; ale-py does not), and wrappers mirroring the reference's Atari
+pipeline. Anything needing a real Atari emulator goes through the
+`RawFrameEnv` protocol, served by ALE when importable and by
+`SyntheticAtari` otherwise.
 """
 
 from __future__ import annotations

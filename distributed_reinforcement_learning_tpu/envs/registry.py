@@ -1,18 +1,25 @@
 """Env registry: name -> constructor, the `gym.make` seam.
 
 The reference resolves env names via `gym.make` (`train_impala.py:117`,
-`wrappers.py:115-138`). This image has no gym/ALE, so:
+`wrappers.py:115-138`). Resolution order here:
 
-- `CartPole-v0` maps to the in-tree physics implementation.
-- Atari names (`*Deterministic-v4`, `*NoFrameskip-v4`) map to the full
-  preprocessing pipeline over `SyntheticAtari` — the real ALE emulator
-  plugs into the same `RawFrameEnv` seam when available (install
-  `ale-py` and register a factory via `register_env`).
+- an explicitly registered factory (`register_env`) always wins;
+- `CartPole-v*` goes through **gymnasium** (installed in this image) so
+  training is validated against an environment the framework didn't
+  write; set `DRL_NO_GYMNASIUM=1` to force the in-tree numpy physics
+  (tests use it for determinism, and it is the automatic fallback);
+- Atari names (`*Deterministic-v4`, `*NoFrameskip-v4`) use gymnasium +
+  `ale-py` when the emulator is importable; otherwise they fall back to
+  the full preprocessing pipeline over `SyntheticAtari` — and say so on
+  stderr, once per name, because training "Breakout" on noise silently
+  is how a benchmark lies (`DRL_SYNTHETIC_ATARI=1` opts into silence).
 """
 
 from __future__ import annotations
 
+import os
 import re
+import sys
 from typing import Callable
 
 from distributed_reinforcement_learning_tpu.envs.atari import AtariPreprocessor, SyntheticAtari
@@ -22,21 +29,43 @@ from distributed_reinforcement_learning_tpu.envs.cartpole import CartPoleEnv
 _REGISTRY: dict[str, Callable[..., Env]] = {}
 
 _ATARI_PATTERN = re.compile(r".*(Deterministic|NoFrameskip)-v\d+$")
+_warned_synthetic: set[str] = set()
 
 
 def register_env(name: str, factory: Callable[..., Env]) -> None:
     _REGISTRY[name] = factory
 
 
+def _use_gymnasium() -> bool:
+    if os.environ.get("DRL_NO_GYMNASIUM", "0") == "1":
+        return False
+    from distributed_reinforcement_learning_tpu.envs.gymnasium_env import gymnasium_available
+
+    return gymnasium_available()
+
+
 def make_env(name: str, seed: int = 0, num_actions: int = 18) -> Env:
     if name in _REGISTRY:
         return _REGISTRY[name](seed=seed)
-    if name == "CartPole-v0":
-        return CartPoleEnv(seed=seed)
-    if name == "CartPole-v1":
-        return CartPoleEnv(seed=seed, max_steps=500)
+    if name in ("CartPole-v0", "CartPole-v1"):
+        if _use_gymnasium():
+            from distributed_reinforcement_learning_tpu.envs.gymnasium_env import GymnasiumEnv
+
+            return GymnasiumEnv(name, seed=seed)
+        return CartPoleEnv(seed=seed, max_steps=200 if name.endswith("v0") else 500)
     if _ATARI_PATTERN.match(name):
-        # No emulator in this environment: synthetic frames through the
-        # real preprocessing pipeline (same shapes/dtypes/life semantics).
+        if _use_gymnasium():
+            from distributed_reinforcement_learning_tpu.envs.gymnasium_env import (
+                GymnasiumRawFrames, ale_available)
+
+            if ale_available():
+                return AtariPreprocessor(GymnasiumRawFrames(name, seed=seed))
+        # No emulator importable: synthetic frames through the real
+        # preprocessing pipeline (same shapes/dtypes/life semantics).
+        if name not in _warned_synthetic and os.environ.get("DRL_SYNTHETIC_ATARI") != "1":
+            _warned_synthetic.add(name)
+            print(f"[envs] WARNING: no ALE emulator available; {name!r} resolves to "
+                  f"SyntheticAtari (random frames through the real preprocessing "
+                  f"pipeline). Install ale-py for the real game.", file=sys.stderr)
         return AtariPreprocessor(SyntheticAtari(num_actions=num_actions, seed=seed))
     raise ValueError(f"unknown env {name!r}; register a factory with register_env")

@@ -4,6 +4,7 @@ from distributed_reinforcement_learning_tpu.parallel.mesh import (
     data_sharding,
     make_mesh,
     model_kernel_sharding,
+    place_local_batch,
     replicated,
 )
 from distributed_reinforcement_learning_tpu.parallel.learner import (
@@ -20,6 +21,7 @@ __all__ = [
     "data_sharding",
     "make_mesh",
     "model_kernel_sharding",
+    "place_local_batch",
     "replicated",
     "train_state_sharding",
 ]

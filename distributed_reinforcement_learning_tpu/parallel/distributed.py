@@ -13,12 +13,13 @@ two planes (SURVEY §5.8):
   `parallel.mesh.make_mesh` lays collectives over ICI (intra-slice) and
   DCN (inter-slice) automatically. This module is that join.
 
-This is the compute-plane join PRIMITIVE, not a turnkey multi-host
-learner: a multi-host learn step additionally needs each process to feed
-its local shard of the global batch (e.g. via
-`jax.make_array_from_process_local_data`), which the runtime loop does
-not do yet — `runtime/transport.run_role` therefore uses a LOCAL-device
-mesh only. Usage, one call before any other jax use in each process:
+`runtime/transport.run_role --mode learner` builds on this join: when it
+returns True the learn step pjits over the GLOBAL mesh, each process
+dequeues `batch_size / process_count` from its own socket data plane,
+and `parallel.mesh.place_local_batch` assembles the global batch via
+`jax.make_array_from_process_local_data` (tested 2 processes x 4 virtual
+CPU devices in tests/test_multihost.py). Usage, one call before any
+other jax use in each process:
 
     from distributed_reinforcement_learning_tpu.parallel import distributed
     distributed.initialize()          # env-driven, no-op single-host

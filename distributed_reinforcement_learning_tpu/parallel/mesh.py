@@ -63,3 +63,24 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
 def model_kernel_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     """Shard a kernel's last (output-feature) dim over the `model` axis."""
     return NamedSharding(mesh, P(*([None] * (ndim - 1)), MODEL_AXIS))
+
+
+def place_local_batch(tree, sharding: NamedSharding | None):
+    """Put this process's host batch onto the mesh as (its shard of) the
+    global batch.
+
+    Single-process: a plain `device_put` into the sharding. Multi-process
+    (a mesh spanning hosts, after `parallel.distributed.initialize`): each
+    process holds only its local rows, so the global array is assembled
+    with `jax.make_array_from_process_local_data` — the per-host batch
+    feed of the multi-host learner. Local batch size must be
+    `global_batch / process_count`.
+    """
+    if sharding is None:
+        return jax.device_put(tree)
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        tree,
+    )

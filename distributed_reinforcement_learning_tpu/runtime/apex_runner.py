@@ -171,22 +171,34 @@ class ApexLearner:
 
     def save_checkpoint(self, ckpt) -> None:
         """Persist TrainState (main+target nets, Adam moments) + host
-        counters. Replay contents are rebuilt from live actor traffic after
-        a restart rather than snapshotted (they would dominate checkpoint
-        size at `replay_capacity`=1e5 Atari transitions)."""
+        counters + a replay snapshot (contents AND priorities — without it
+        a restarted learner resumes with an empty Memory while actors keep
+        pushing stale-policy re-samples). The snapshot is size-capped /
+        disableable via DRL_CKPT_REPLAY* (utils/checkpoint.py)."""
+        from distributed_reinforcement_learning_tpu.utils.checkpoint import encode_replay_snapshot
+
+        blob = encode_replay_snapshot(self.replay)
         ckpt.save(self.train_steps, self.state, {
             "train_steps": self.train_steps,
             "replay_beta": float(self.replay.beta),
-        })
+            "ingested_unrolls": self.ingested_unrolls,
+        }, blobs={"replay": blob} if blob is not None else None)
 
     def restore_checkpoint(self, ckpt) -> bool:
+        from distributed_reinforcement_learning_tpu.utils.checkpoint import decode_replay_snapshot
+
         got = ckpt.restore(self.state)
         if got is None:
             return False
-        self.state, extra, _ = got
+        self.state, extra, step = got
         self.train_steps = int(extra.get("train_steps", 0))
-        # The replay warm-up gate restarts: the buffer is empty again.
-        self.ingested_unrolls = 0
+        blob = ckpt.load_blob(step, "replay")
+        if blob is not None:
+            self.replay.restore(decode_replay_snapshot(blob))
+            self.ingested_unrolls = int(extra.get("ingested_unrolls", 0))
+        else:
+            # No snapshot: the warm-up gate restarts, buffer refills live.
+            self.ingested_unrolls = 0
         self.replay.beta = float(extra.get("replay_beta", self.replay.beta))
         self.weights.publish(self.state.params, self.train_steps)
         return True
@@ -216,8 +228,9 @@ class ApexLearner:
             batch = stack_pytrees(items)
         with self.timer.stage("learn"):
             if self._batch_sharding is not None:
-                batch = jax.device_put(batch, self._batch_sharding)
-                is_weight = jax.device_put(is_weight, self._batch_sharding)
+                from distributed_reinforcement_learning_tpu.parallel import place_local_batch
+
+                batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
             self.state, td, metrics = self._learn(self.state, batch, is_weight)
         with self.timer.stage("replay_update"):
             self.replay.update_batch(idxs, np.asarray(td))

@@ -199,7 +199,9 @@ class ImpalaLearner:
             return None
         with self.timer.stage("learn"):
             if self._batch_sharding is not None and self._prefetcher is None:
-                batch = jax.device_put(batch, self._batch_sharding)
+                from distributed_reinforcement_learning_tpu.parallel import place_local_batch
+
+                batch = place_local_batch(batch, self._batch_sharding)
             self.state, metrics = self._learn(self.state, batch)
         self.train_steps += 1
         self.frames_learned += self.batch_size * self.agent.cfg.trajectory

@@ -149,20 +149,32 @@ class R2D2Learner:
         weights.publish(self.state.params, 0)
 
     def save_checkpoint(self, ckpt) -> None:
-        """Persist TrainState + host counters (the reference's R2D2 agent
-        had no Saver at all — SURVEY §5.4)."""
+        """Persist TrainState + host counters + a replay snapshot of the
+        sequence Memory (the reference's R2D2 agent had no Saver at all —
+        SURVEY §5.4). Snapshot gated by DRL_CKPT_REPLAY* (utils/checkpoint.py)."""
+        from distributed_reinforcement_learning_tpu.utils.checkpoint import encode_replay_snapshot
+
+        blob = encode_replay_snapshot(self.replay)
         ckpt.save(self.train_steps, self.state, {
             "train_steps": self.train_steps,
             "replay_beta": float(self.replay.beta),
-        })
+            "ingested_sequences": self.ingested_sequences,
+        }, blobs={"replay": blob} if blob is not None else None)
 
     def restore_checkpoint(self, ckpt) -> bool:
+        from distributed_reinforcement_learning_tpu.utils.checkpoint import decode_replay_snapshot
+
         got = ckpt.restore(self.state)
         if got is None:
             return False
-        self.state, extra, _ = got
+        self.state, extra, step = got
         self.train_steps = int(extra.get("train_steps", 0))
-        self.ingested_sequences = 0  # replay refills from live traffic
+        blob = ckpt.load_blob(step, "replay")
+        if blob is not None:
+            self.replay.restore(decode_replay_snapshot(blob))
+            self.ingested_sequences = int(extra.get("ingested_sequences", 0))
+        else:
+            self.ingested_sequences = 0  # replay refills from live traffic
         self.replay.beta = float(extra.get("replay_beta", self.replay.beta))
         self.weights.publish(self.state.params, self.train_steps)
         return True
@@ -197,8 +209,9 @@ class R2D2Learner:
             batch = stack_pytrees(items)
         with self.timer.stage("learn"):
             if self._batch_sharding is not None:
-                batch = jax.device_put(batch, self._batch_sharding)
-                is_weight = jax.device_put(is_weight, self._batch_sharding)
+                from distributed_reinforcement_learning_tpu.parallel import place_local_batch
+
+                batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
             self.state, priorities, metrics = self._learn(self.state, batch, is_weight)
         with self.timer.stage("replay_update"):
             self.replay.update_batch(idxs, np.asarray(priorities))

@@ -409,17 +409,45 @@ def run_role(
     agent_cfg, rt = load_config(config_path, section)
 
     if mode == "learner":
-        # Multi-chip learner: when this process sees >1 device (a TPU
-        # slice, or the CPU simulation), pjit the learn step over a
-        # data-axis mesh of the LOCAL devices. (Multi-host meshes need a
-        # per-host batch feed on top of parallel.distributed.initialize();
-        # the socket data plane itself already spans hosts.)
+        # Multi-chip / multi-host learner. parallel.distributed.initialize
+        # joins the JAX runtime when DRL_COORDINATOR/DRL_NUM_PROCESSES are
+        # set (no-op single-host); with N processes x M devices the learn
+        # step pjits over the GLOBAL (data,) mesh, each process dequeues
+        # its batch_size/N share from its own socket data plane, and
+        # place_local_batch assembles the global batch via
+        # jax.make_array_from_process_local_data. Single-host multi-chip
+        # (a TPU slice, or the CPU simulation) is the N=1 special case.
+        from distributed_reinforcement_learning_tpu.parallel import distributed
+
+        multihost = distributed.initialize()
+        local_batch = rt.batch_size
         mesh = None
-        if len(jax.local_devices()) > 1 and rt.batch_size % len(jax.local_devices()) == 0:
+        devs = jax.devices() if multihost else jax.local_devices()
+        if multihost:
+            nproc = jax.process_count()
+            if rt.batch_size % nproc != 0:
+                raise ValueError(
+                    f"batch_size {rt.batch_size} not divisible by {nproc} processes")
+            local_batch = rt.batch_size // nproc
+            print(f"[learner] multi-host: process {jax.process_index()}/{nproc}, "
+                  f"{len(jax.local_devices())} local of {len(devs)} devices, "
+                  f"local batch {local_batch}")
+        if len(devs) > 1 and rt.batch_size % len(devs) == 0:
             from distributed_reinforcement_learning_tpu.parallel import make_mesh
 
-            mesh = make_mesh(devices=jax.local_devices())
-            print(f"[learner] multi-chip mesh: {dict(mesh.shape)}")
+            mesh = make_mesh(devices=devs)
+            print(f"[learner] mesh: {dict(mesh.shape)}")
+        elif multihost:
+            # Refuse rather than silently run N independent un-psum'd
+            # learners whose weight copies would diverge.
+            raise ValueError(
+                f"multi-host learner needs batch_size divisible by the global "
+                f"device count ({rt.batch_size * jax.process_count()} global batch, "
+                f"{len(devs)} devices)")
+        if local_batch != rt.batch_size:
+            import dataclasses
+
+            rt = dataclasses.replace(rt, batch_size=local_batch)
         logger = MetricsLogger(run_dir)  # actors log nothing: no writer for them
         queue = _make_queue(rt.queue_size)
         from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
@@ -439,6 +467,8 @@ def run_role(
             ckpt = Checkpointer(checkpoint_dir)
             if learner.restore_checkpoint(ckpt):
                 print(f"[learner] resumed from step {learner.train_steps}")
+            if multihost and jax.process_index() != 0:
+                ckpt = None  # every process restores; only process 0 writes
         server = TransportServer(queue, weights, host="0.0.0.0", port=rt.server_port).start()
         print(f"[learner] serving on :{rt.server_port}; training {num_updates} updates")
         try:

@@ -20,7 +20,9 @@ from __future__ import annotations
 
 import json
 import os
+import pickle
 import re
+import sys
 import tempfile
 from pathlib import Path
 from typing import Any
@@ -28,6 +30,45 @@ from typing import Any
 from flax import serialization
 
 _CKPT_RE = re.compile(r"^ckpt_(\d{10})\.msgpack$")
+
+
+def encode_replay_snapshot(replay) -> bytes | None:
+    """Pickle a replay buffer's `snapshot()` for checkpointing, or None.
+
+    SURVEY §5.4's optional replay snapshot: without it a restarted
+    Ape-X/R2D2 learner resumes with an empty Memory. Disabled with
+    `DRL_CKPT_REPLAY=0`; skipped (with a log line) above
+    `DRL_CKPT_REPLAY_MAX_MB` (default 512) because a full Atari replay at
+    capacity 1e5 is ~5 GB and would dominate every checkpoint write.
+    """
+    if os.environ.get("DRL_CKPT_REPLAY", "1") == "0":
+        return None
+    snap = replay.snapshot()
+    nbytes = sum(
+        x.nbytes for x in _iter_array_leaves(snap["items"])
+    ) + snap["priorities"].nbytes
+    cap_mb = float(os.environ.get("DRL_CKPT_REPLAY_MAX_MB", "512"))
+    if nbytes > cap_mb * 1e6:
+        print(f"[checkpoint] replay snapshot {nbytes / 1e6:.0f} MB exceeds "
+              f"DRL_CKPT_REPLAY_MAX_MB={cap_mb:.0f}; skipping (set higher to keep it)",
+              file=sys.stderr)
+        return None
+    return pickle.dumps(snap, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode_replay_snapshot(data: bytes) -> dict:
+    return pickle.loads(data)
+
+
+def _iter_array_leaves(tree):
+    if hasattr(tree, "nbytes"):
+        yield tree
+    elif isinstance(tree, dict):
+        for v in tree.values():
+            yield from _iter_array_leaves(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _iter_array_leaves(v)
 
 
 def _atomic_write(path: Path, data: bytes) -> None:
@@ -73,15 +114,18 @@ class Checkpointer:
                 stale.unlink()
             except OSError:
                 pass
-        # Sweep sidecars without a committed payload: save() writes the
-        # extra.json first (the msgpack is the commit marker), so a crash
-        # between the two leaves an orphan that _prune — which iterates
-        # committed steps only — would never delete.
-        for extra in self.directory.glob("ckpt_*.extra.json"):
-            payload = extra.with_name(extra.name.replace(".extra.json", ".msgpack"))
-            if not payload.exists():
+        # Sweep sidecars (extra.json, auxiliary blobs) without a committed
+        # payload: save() writes them before the msgpack (the msgpack is
+        # the commit marker), so a crash between the writes leaves orphans
+        # that _prune — which iterates committed steps only — would never
+        # delete.
+        for side in list(self.directory.glob("ckpt_*.extra.json")) + list(
+            self.directory.glob("ckpt_*.blob.*")
+        ):
+            m = re.match(r"^ckpt_(\d{10})\.", side.name)
+            if m and not self._payload_path(int(m.group(1))).exists():
                 try:
-                    extra.unlink()
+                    side.unlink()
                 except OSError:
                     pass
 
@@ -90,6 +134,9 @@ class Checkpointer:
 
     def _extra_path(self, step: int) -> Path:
         return self.directory / f"ckpt_{step:010d}.extra.json"
+
+    def _blob_path(self, step: int, name: str) -> Path:
+        return self.directory / f"ckpt_{step:010d}.blob.{name}"
 
     def steps(self) -> list[int]:
         """Committed checkpoint steps, ascending."""
@@ -104,13 +151,27 @@ class Checkpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
-    def save(self, step: int, state: Any, extra: dict | None = None) -> Path:
-        """Persist `state` (+ host `extra`) as checkpoint `step`."""
+    def save(
+        self,
+        step: int,
+        state: Any,
+        extra: dict | None = None,
+        blobs: dict[str, bytes] | None = None,
+    ) -> Path:
+        """Persist `state` (+ host `extra`, + named auxiliary `blobs` such
+        as a replay-buffer snapshot) as checkpoint `step`. Sidecars are
+        written first; the msgpack payload is the commit marker."""
         _atomic_write(self._extra_path(step), json.dumps(extra or {}).encode())
+        for name, data in (blobs or {}).items():
+            _atomic_write(self._blob_path(step, name), data)
         path = self._payload_path(step)
         _atomic_write(path, serialization.to_bytes(state))
         self._prune()
         return path
+
+    def load_blob(self, step: int, name: str) -> bytes | None:
+        path = self._blob_path(step, name)
+        return path.read_bytes() if path.exists() else None
 
     def restore(self, template: Any, step: int | None = None) -> tuple[Any, dict, int] | None:
         """-> (state, extra, step) for `step` (default latest), or None.
@@ -132,7 +193,8 @@ class Checkpointer:
 
     def _prune(self) -> None:
         for step in self.steps()[: -self.retain]:
-            for p in (self._payload_path(step), self._extra_path(step)):
+            sides = list(self.directory.glob(f"ckpt_{step:010d}.blob.*"))
+            for p in (self._payload_path(step), self._extra_path(step), *sides):
                 try:
                     p.unlink()
                 except FileNotFoundError:

@@ -14,7 +14,7 @@ import time
 from pathlib import Path
 from typing import IO
 
-try:  # optional, absent in this image
+try:  # optional dependency (present in this image; guarded anyway)
     from tensorboardX import SummaryWriter  # type: ignore
 except Exception:  # pragma: no cover
     SummaryWriter = None

@@ -41,3 +41,55 @@ def synthetic_impala_batch(
         initial_h=(rng.standard_normal((B, T, lstm_size)) * 0.1).astype(np.float32),
         initial_c=(rng.standard_normal((B, T, lstm_size)) * 0.1).astype(np.float32),
     )
+
+
+def synthetic_apex_batch(
+    B: int,
+    obs_shape: tuple[int, ...],
+    num_actions: int,
+    seed: int = 0,
+    obs_dtype=np.float32,
+):
+    """Random ApexBatch (flat transitions) + IS weights."""
+    from distributed_reinforcement_learning_tpu.agents.apex import ApexBatch
+
+    rng = np.random.default_rng(seed)
+
+    def obs():
+        if np.issubdtype(obs_dtype, np.integer):
+            return rng.integers(0, 255, (B, *obs_shape)).astype(obs_dtype)
+        return rng.random((B, *obs_shape), dtype=np.float32)
+
+    batch = ApexBatch(
+        state=obs(),
+        next_state=obs(),
+        previous_action=rng.integers(0, num_actions, (B,)).astype(np.int32),
+        action=rng.integers(0, num_actions, (B,)).astype(np.int32),
+        reward=rng.random((B,), dtype=np.float32),
+        done=rng.random((B,)) < 0.1,
+    )
+    return batch, rng.random((B,), dtype=np.float32)
+
+
+def synthetic_r2d2_batch(
+    B: int,
+    T: int,
+    obs_shape: tuple[int, ...],
+    num_actions: int,
+    lstm_size: int,
+    seed: int = 0,
+):
+    """Random R2D2Batch (sequences with stored start state) + IS weights."""
+    from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Batch
+
+    rng = np.random.default_rng(seed)
+    batch = R2D2Batch(
+        state=rng.integers(0, 255, (B, T, *obs_shape)).astype(np.int32),
+        previous_action=rng.integers(0, num_actions, (B, T)).astype(np.int32),
+        action=rng.integers(0, num_actions, (B, T)).astype(np.int32),
+        reward=rng.random((B, T), dtype=np.float32),
+        done=rng.random((B, T)) < 0.1,
+        initial_h=(rng.standard_normal((B, lstm_size)) * 0.1).astype(np.float32),
+        initial_c=(rng.standard_normal((B, lstm_size)) * 0.1).astype(np.float32),
+    )
+    return batch, rng.random((B,), dtype=np.float32)

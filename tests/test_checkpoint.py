@@ -187,3 +187,113 @@ def test_orphan_sidecar_swept_on_startup(tmp_path):
     assert not orphan.exists()
     assert ckpt2.steps() == [1]
     assert (tmp_path / "ckpt_0000000001.extra.json").exists()
+
+
+@pytest.mark.parametrize("backend", ["python", "native"])
+def test_replay_snapshot_roundtrip(backend):
+    """snapshot() -> restore() preserves contents, priorities, and beta
+    on both replay implementations."""
+    from distributed_reinforcement_learning_tpu.data.native import native_available
+    from distributed_reinforcement_learning_tpu.data.replay import make_replay
+
+    if backend == "native" and not native_available():
+        pytest.skip("native sumtree not built")
+    replay = make_replay(64, backend=backend)
+    rng = np.random.default_rng(0)
+    errors = rng.random(40)
+    items = [{"x": np.full(3, i, np.float32)} for i in range(40)]
+    replay.add_batch(errors, items)
+    for _ in range(5):
+        replay.sample(8, np.random.RandomState(1))  # anneal beta
+
+    snap = replay.snapshot()
+    restored = make_replay(64, backend=backend)
+    restored.restore(snap)
+
+    assert len(restored) == len(replay) == 40
+    assert restored.beta == replay.beta
+    np.testing.assert_allclose(restored.tree.total, replay.tree.total, rtol=1e-12)
+    r_snap = restored.snapshot()
+    np.testing.assert_allclose(r_snap["priorities"], snap["priorities"])
+    for a, b in zip(r_snap["items"], snap["items"]):
+        np.testing.assert_array_equal(a["x"], b["x"])
+
+
+def test_apex_kill_and_resume_keeps_replay(tmp_path):
+    """A restarted Ape-X learner resumes with its replay contents and
+    priorities intact (VERDICT r1 Missing #4): the new learner can train
+    immediately instead of waiting on stale-policy actor re-samples."""
+    from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+    cfg = ApexConfig(obs_shape=(4,), num_actions=2, start_learning_rate=1e-3)
+    agent = ApexAgent(cfg)
+    queue = TrajectoryQueue(capacity=64)
+    weights = WeightStore()
+    learner = apex_runner.ApexLearner(
+        agent, queue, weights, batch_size=16, replay_capacity=1_000,
+        target_sync_interval=50, rng=jax.random.PRNGKey(0))
+    env = VectorCartPole(num_envs=8, seed=0)
+    actor = apex_runner.ApexActor(
+        agent, env, queue, weights, seed=1, unroll_size=16, local_capacity=500)
+    apex_runner.run_sync(learner, [actor], num_updates=12)
+    size_before = len(learner.replay)
+    total_before = learner.replay.tree.total
+    assert size_before > 100
+
+    learner.save_checkpoint(Checkpointer(tmp_path))
+
+    # "Kill": a fresh learner process restores from disk.
+    learner2 = apex_runner.ApexLearner(
+        ApexAgent(cfg), TrajectoryQueue(capacity=64), WeightStore(), batch_size=16,
+        replay_capacity=1_000, target_sync_interval=50, rng=jax.random.PRNGKey(9))
+    assert learner2.restore_checkpoint(Checkpointer(tmp_path))
+    assert len(learner2.replay) == size_before
+    np.testing.assert_allclose(learner2.replay.tree.total, total_before, rtol=1e-9)
+    assert learner2.train_steps == learner.train_steps
+    # Trains immediately from the restored buffer, no re-warm-up.
+    m = learner2.train()
+    assert m is not None and np.isfinite(m["loss"])
+
+
+def test_r2d2_kill_and_resume_keeps_replay(tmp_path):
+    from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+    cfg = R2D2Config(obs_shape=(2,), num_actions=2, seq_len=8, burn_in=2,
+                     lstm_size=32, learning_rate=1e-3)
+    agent = R2D2Agent(cfg)
+    queue = TrajectoryQueue(capacity=128)
+    weights = WeightStore()
+    learner = r2d2_runner.R2D2Learner(
+        agent, queue, weights, batch_size=8, replay_capacity=500,
+        target_sync_interval=50, rng=jax.random.PRNGKey(0))
+    env = VectorCartPole(num_envs=8, seed=0)
+    actor = r2d2_runner.R2D2Actor(
+        agent, env, queue, weights, seed=1, obs_transform=pomdp_project)
+    r2d2_runner.run_sync(learner, [actor], num_updates=8)
+    size_before = len(learner.replay)
+    assert size_before >= 16
+
+    learner.save_checkpoint(Checkpointer(tmp_path))
+
+    learner2 = r2d2_runner.R2D2Learner(
+        R2D2Agent(cfg), TrajectoryQueue(capacity=128), WeightStore(), batch_size=8,
+        replay_capacity=500, target_sync_interval=50, rng=jax.random.PRNGKey(9))
+    assert learner2.restore_checkpoint(Checkpointer(tmp_path))
+    assert len(learner2.replay) == size_before
+    m = learner2.train()
+    assert m is not None and np.isfinite(m["loss"])
+
+
+def test_replay_snapshot_disabled_by_env(tmp_path, monkeypatch):
+    from distributed_reinforcement_learning_tpu.data.replay import make_replay
+    from distributed_reinforcement_learning_tpu.utils.checkpoint import encode_replay_snapshot
+
+    replay = make_replay(16, backend="python")
+    replay.add_batch(np.ones(4), [{"x": np.ones(2, np.float32)}] * 4)
+    monkeypatch.setenv("DRL_CKPT_REPLAY", "0")
+    assert encode_replay_snapshot(replay) is None
+    monkeypatch.setenv("DRL_CKPT_REPLAY", "1")
+    monkeypatch.setenv("DRL_CKPT_REPLAY_MAX_MB", "0.00001")
+    assert encode_replay_snapshot(replay) is None  # over size cap
+    monkeypatch.setenv("DRL_CKPT_REPLAY_MAX_MB", "512")
+    assert encode_replay_snapshot(replay) is not None

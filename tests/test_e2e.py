@@ -1,9 +1,10 @@
 """End-to-end single-process training tests on CartPole.
 
-The IMPALA test asserts actual learning (mean episode return clearly above
-the random baseline); Ape-X and R2D2 assert the full loop runs, losses
-stay finite, and replay/priorities flow. Budgeted for the single-core CPU
-CI host.
+All three algorithms assert actual learning — mean episode return clearly
+above the ~20 random baseline (the reference's own de-facto verification
+is the tensorboard return curve, SURVEY §4; R2D2's demo solves
+CartPole-POMDP, `/root/reference/train_r2d2.py:176-178`). Budgeted for
+the single-core CPU CI host (~40s per algorithm at 300-400 updates).
 """
 
 import jax
@@ -85,12 +86,18 @@ def test_apex_trains_cartpole():
     actor = apex_runner.ApexActor(
         agent, env, queue, weights, seed=1, unroll_size=32, local_capacity=5_000)
 
-    result = apex_runner.run_sync(learner, [actor], num_updates=40)
+    result = apex_runner.run_sync(learner, [actor], num_updates=400)
 
-    assert learner.train_steps == 40
+    assert learner.train_steps == 400
     assert len(learner.replay) > 100
     assert np.isfinite(result["last_metrics"]["loss"])
-    assert len(result["episode_returns"]) > 0
+    returns = result["episode_returns"]
+    late = np.mean(returns[-20:])
+    early = np.mean(returns[:20])
+    # Measured on this host: early ~19, late ~150 @ 400 updates. Require
+    # unambiguous learning, not just finite losses.
+    assert late > 60, f"late mean return {late} (early {early})"
+    assert late > early
 
 
 def test_r2d2_trains_cartpole_pomdp():
@@ -106,9 +113,16 @@ def test_r2d2_trains_cartpole_pomdp():
     actor = r2d2_runner.R2D2Actor(
         agent, env, queue, weights, seed=1, obs_transform=pomdp_project)
 
-    result = r2d2_runner.run_sync(learner, [actor], num_updates=25)
+    result = r2d2_runner.run_sync(learner, [actor], num_updates=400)
 
-    assert learner.train_steps == 25
+    assert learner.train_steps == 400
     assert np.isfinite(result["last_metrics"]["loss"])
     assert len(learner.replay) >= 32
-    assert len(result["episode_returns"]) > 0
+    returns = result["episode_returns"]
+    late = np.mean(returns[-20:])
+    early = np.mean(returns[:20])
+    # The POMDP view (position+angle only) needs the LSTM to integrate
+    # velocity — a feedforward Q can't solve it. Measured: ~17 -> ~139
+    # @ 400 updates on this host.
+    assert late > 60, f"late mean return {late} (early {early})"
+    assert late > early

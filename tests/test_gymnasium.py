@@ -1,0 +1,118 @@
+"""Gymnasium integration: adapter contract + training on a real
+third-party env the framework didn't implement itself.
+
+The reference's envs all come from `gym.make` (`train_impala.py:117`);
+these tests prove the framework trains against the maintained fork of
+that exact surface (gymnasium ships in this image; ale-py does not, so
+Atari stays on the synthetic fallback — resolution is logged).
+"""
+
+import numpy as np
+import pytest
+
+gymnasium = pytest.importorskip("gymnasium")
+
+from distributed_reinforcement_learning_tpu.envs.batched import BatchedEnv
+from distributed_reinforcement_learning_tpu.envs.gymnasium_env import (
+    GymnasiumEnv,
+    ale_available,
+    gymnasium_available,
+)
+from distributed_reinforcement_learning_tpu.envs.registry import make_env
+
+
+class TestAdapterContract:
+    def test_env_protocol(self):
+        env = GymnasiumEnv("CartPole-v1", seed=0)
+        assert env.num_actions == 2
+        obs = env.reset()
+        assert obs.shape == (4,) and obs.dtype == np.float32
+        obs, reward, done, info = env.step(1)
+        assert obs.shape == (4,)
+        assert reward == 1.0
+        assert isinstance(done, bool)
+        env.close()
+
+    def test_episode_terminates(self):
+        env = GymnasiumEnv("CartPole-v1", seed=0)
+        env.reset()
+        done = False
+        for _ in range(501):  # v1 truncates at 500
+            _, _, done, _ = env.step(1)  # constant push falls over fast
+            if done:
+                break
+        assert done
+        env.close()
+
+    def test_seeding_is_deterministic(self):
+        a = GymnasiumEnv("CartPole-v1", seed=7).reset()
+        b = GymnasiumEnv("CartPole-v1", seed=7).reset()
+        np.testing.assert_array_equal(a, b)
+
+    def test_registry_routes_cartpole_through_gymnasium(self):
+        assert gymnasium_available()
+        env = make_env("CartPole-v0", seed=0)
+        assert isinstance(env, GymnasiumEnv)
+
+    def test_registry_fallback_flag(self, monkeypatch):
+        from distributed_reinforcement_learning_tpu.envs.cartpole import CartPoleEnv
+
+        monkeypatch.setenv("DRL_NO_GYMNASIUM", "1")
+        env = make_env("CartPole-v0", seed=0)
+        assert isinstance(env, CartPoleEnv)
+
+    def test_atari_fallback_warns_once(self, capsys, monkeypatch):
+        if ale_available():
+            pytest.skip("real ALE present; no fallback to warn about")
+        from distributed_reinforcement_learning_tpu.envs import registry
+
+        monkeypatch.delenv("DRL_SYNTHETIC_ATARI", raising=False)
+        monkeypatch.setattr(registry, "_warned_synthetic", set())
+        make_env("PongDeterministic-v4", seed=0, num_actions=6)
+        make_env("PongDeterministic-v4", seed=1, num_actions=6)
+        err = capsys.readouterr().err
+        assert err.count("SyntheticAtari") == 1  # once per name, not per env
+
+
+def test_impala_learns_on_gymnasium_cartpole():
+    """End-to-end learning on an environment this repo did not write:
+    IMPALA on gymnasium CartPole-v1 through the BatchedEnv seam."""
+    import jax
+
+    from distributed_reinforcement_learning_tpu.agents import ImpalaAgent, ImpalaConfig
+    from distributed_reinforcement_learning_tpu.data import TrajectoryQueue
+    from distributed_reinforcement_learning_tpu.runtime import WeightStore, impala_runner
+
+    cfg = ImpalaConfig(
+        obs_shape=(4,),
+        num_actions=2,
+        trajectory=16,
+        lstm_size=64,
+        discount_factor=0.99,
+        entropy_coef=0.01,
+        baseline_loss_coef=0.5,
+        start_learning_rate=5e-3,
+        end_learning_rate=5e-3,
+        learning_frame=10**9,
+        reward_clipping="abs_one",
+    )
+    agent = ImpalaAgent(cfg)
+    queue = TrajectoryQueue(capacity=64)
+    weights = WeightStore()
+    learner = impala_runner.ImpalaLearner(
+        agent, queue, weights, batch_size=16, rng=jax.random.PRNGKey(0))
+    env = BatchedEnv([
+        (lambda s=seed: GymnasiumEnv("CartPole-v1", seed=s)) for seed in range(16)
+    ])
+    actor = impala_runner.ImpalaActor(agent, env, queue, weights, seed=1)
+
+    result = impala_runner.run_sync(learner, [actor], num_updates=450)
+
+    returns = result["episode_returns"]
+    assert len(returns) > 20
+    late = np.mean(returns[-20:])
+    early = np.mean(returns[:20])
+    # Measured on this host: early ~17, late ~47 @ 300 updates, > 100 by
+    # 450; require unambiguous learning on the env this repo didn't write.
+    assert late > 60, f"late mean return {late} (early {early})"
+    assert late > early
