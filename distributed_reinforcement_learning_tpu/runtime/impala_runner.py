@@ -22,7 +22,7 @@ import threading
 import jax
 import numpy as np
 
-from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+from distributed_reinforcement_learning_tpu.agents.impala import ActOutput, ImpalaAgent, ImpalaConfig
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
 from distributed_reinforcement_learning_tpu.data.structures import ImpalaTrajectoryAccumulator
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
@@ -40,6 +40,7 @@ class ImpalaActor:
         seed: int = 0,
         available_action: int | None = None,
         life_loss_shaping: bool = False,
+        remote_act=None,  # SEED-style: RemoteInference; no weight pulls at all
     ):
         self.agent = agent
         self.env = env
@@ -47,6 +48,7 @@ class ImpalaActor:
         self.weights = weights
         self.available_action = available_action
         self.life_loss_shaping = life_loss_shaping
+        self.remote_act = remote_act
 
         self._rng = jax.random.PRNGKey(seed)
         self._obs = env.reset()
@@ -71,15 +73,24 @@ class ImpalaActor:
         Returns the number of env frames generated (N * T).
         """
         cfg = self.agent.cfg
-        self._sync_params()
-        if self._params is None:
-            raise RuntimeError("no weights published yet")
+        if self.remote_act is None:
+            self._sync_params()
+            if self._params is None:
+                raise RuntimeError("no weights published yet")
         acc = ImpalaTrajectoryAccumulator()
         n = self._obs.shape[0]
 
         for _ in range(cfg.trajectory):
-            self._rng, sub = jax.random.split(self._rng)
-            out = self.agent.act(self._params, self._obs, self._prev_action, self._h, self._c, sub)
+            if self.remote_act is not None:
+                # Centralized inference: the learner acts for us with its
+                # newest weights (zero staleness, no local params).
+                action_a, policy_a, h_a, c_a = self.remote_act.act(
+                    self._obs, self._prev_action, self._h, self._c)
+                out = ActOutput(action_a, policy_a, h_a, c_a)
+            else:
+                self._rng, sub = jax.random.split(self._rng)
+                out = self.agent.act(
+                    self._params, self._obs, self._prev_action, self._h, self._c, sub)
             actions = np.asarray(out.action)
             env_actions = actions % self.available_action if self.available_action else actions
             next_obs, reward, done, infos = self.env.step(env_actions)

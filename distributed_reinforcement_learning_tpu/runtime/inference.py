@@ -1,0 +1,198 @@
+"""SEED-style centralized inference: actors offload act() to the learner.
+
+The reference runs every policy forward on the actor's own CPU copy of
+the network — one `sess.run` per env step per actor
+(`/root/reference/agent/impala.py:118-130`, SURVEY §3.5). The
+TPU-native alternative SURVEY §3.5/§7 sketches is SEED RL's: actors
+send observations, a learner-side service batches requests from MANY
+actors into ONE jitted act on the TPU, and replies with actions. The
+wins: actors need no weight transfer at all (zero staleness — the
+service always acts with the newest published params), actor hosts need
+no accelerator math, and the forward passes ride the MXU at batch sizes
+a single actor can't reach.
+
+`InferenceServer` is transport-agnostic: `submit()` blocks the calling
+(connection-handler) thread until its rows come back from the next
+batched step. Batching policy: run as soon as `max_batch` rows are
+pending, or when `max_wait_ms` expires with at least one row — latency
+bounded, batch opportunistic. Rows are padded to bucket sizes so XLA
+compiles a handful of shapes, not one per actor-count.
+
+The recurrent state (h, c) stays ACTOR-side — each request carries its
+envs' (h, c) and gets the advanced state back. That keeps the service
+stateless (any request can join any batch, actors can die freely) at
+the cost of 2*lstm_size floats per env each way, which is noise next to
+an 84x84x4 frame.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+import jax
+
+
+def _bucket(n: int) -> int:
+    """Smallest power-of-two >= n: a handful of XLA act shapes total."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class InferenceServer:
+    """Batches concurrent act requests into single jitted calls.
+
+    `agent` must expose `act(params, obs, prev_action, h, c, rng)` (the
+    IMPALA surface; the jitted fn is taken as-is so the jit cache is
+    shared with any local actors). `weights` is the learner's
+    WeightStore — params are re-read every batch, so inference always
+    uses the newest published snapshot.
+    """
+
+    def __init__(
+        self,
+        agent,
+        weights,
+        max_batch: int = 256,
+        max_wait_ms: float = 2.0,
+        seed: int = 0,
+    ):
+        self.agent = agent
+        self.weights = weights
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1e3
+        self._rng = jax.random.PRNGKey(seed)
+        # Device-resident params cache keyed by the published version: the
+        # store holds host numpy (its actors pull over the wire), and
+        # re-feeding numpy into the jitted act would upload the whole
+        # network H2D on EVERY inference batch. One placement per publish
+        # instead (versions are identities, not ordered — compare !=).
+        self._device_params = None
+        self._cached_version: int | None = None
+        self._lock = threading.Lock()
+        self._batch_ready = threading.Condition(self._lock)
+        self._pending: list[dict] = []  # [{arrays, n, event, out}]
+        self._pending_rows = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="inference")
+        self._thread.start()
+        self.batches_run = 0
+        self.rows_served = 0
+
+    def submit(self, obs, prev_action, h, c) -> tuple[np.ndarray, ...]:
+        """Act for one request's `[n, ...]` rows; blocks until served.
+
+        Returns (action [n], policy [n, A], h' [n, H], c' [n, H]).
+        """
+        req = {
+            "obs": np.asarray(obs),
+            "prev_action": np.asarray(prev_action),
+            "h": np.asarray(h),
+            "c": np.asarray(c),
+            "event": threading.Event(),
+            "out": None,
+            "error": None,
+        }
+        with self._batch_ready:
+            if self._stop:
+                raise RuntimeError("inference server stopped")
+            self._pending.append(req)
+            self._pending_rows += req["obs"].shape[0]
+            self._batch_ready.notify()
+        req["event"].wait()
+        if req["error"] is not None:
+            raise RuntimeError("inference batch failed") from req["error"]
+        return req["out"]
+
+    def _take_batch(self) -> list[dict]:
+        """Wait for work: return pending requests when max_batch rows are
+        queued or max_wait elapsed since the first arrival. Takes whole
+        requests up to max_batch rows (always at least one), leaving the
+        rest pending so batch shapes stay within the bucketed range."""
+        with self._batch_ready:
+            deadline = None
+            while not self._stop:
+                if self._pending and deadline is None:
+                    deadline = time.monotonic() + self.max_wait
+                if self._pending_rows >= self.max_batch or (
+                    deadline is not None and time.monotonic() >= deadline and self._pending
+                ):
+                    batch, rows = [], 0
+                    while self._pending:
+                        k = self._pending[0]["obs"].shape[0]
+                        if batch and rows + k > self.max_batch:
+                            break
+                        rows += k
+                        batch.append(self._pending.pop(0))
+                    self._pending_rows -= rows
+                    return batch
+                # Idle (nothing pending): sleep until a submit notifies —
+                # no 2ms poll wakeups on a learner with no remote actors.
+                self._batch_ready.wait(
+                    timeout=None if deadline is None
+                    else max(1e-4, deadline - time.monotonic())
+                )
+            return []
+
+    def _loop(self) -> None:
+        while True:
+            reqs = self._take_batch()
+            if not reqs:
+                return  # stopped
+            try:
+                self._run(reqs)
+            except Exception as e:  # noqa: BLE001 — deliver to every waiter
+                for r in reqs:
+                    r["error"] = e
+                    r["event"].set()
+
+    def _run(self, reqs: list[dict]) -> None:
+        params, version = self.weights.get()
+        if params is None:
+            raise RuntimeError("no weights published yet")
+        if version != self._cached_version:
+            self._device_params = jax.device_put(params)
+            self._cached_version = version
+        obs = np.concatenate([r["obs"] for r in reqs])
+        prev = np.concatenate([r["prev_action"] for r in reqs])
+        h = np.concatenate([r["h"] for r in reqs])
+        c = np.concatenate([r["c"] for r in reqs])
+        n = obs.shape[0]
+        b = _bucket(n)
+        if b > n:  # pad rows so XLA sees a handful of shapes
+            pad = b - n
+            obs = np.concatenate([obs, np.repeat(obs[:1], pad, axis=0)])
+            prev = np.concatenate([prev, np.zeros(pad, prev.dtype)])
+            h = np.concatenate([h, np.zeros((pad, h.shape[1]), h.dtype)])
+            c = np.concatenate([c, np.zeros((pad, c.shape[1]), c.dtype)])
+        self._rng, sub = jax.random.split(self._rng)
+        out = self.agent.act(self._device_params, obs, prev, h, c, sub)
+        action = np.asarray(out.action)[:n]
+        policy = np.asarray(out.policy)[:n]
+        h_out = np.asarray(out.h)[:n]
+        c_out = np.asarray(out.c)[:n]
+        row = 0
+        for r in reqs:
+            k = r["obs"].shape[0]
+            sl = slice(row, row + k)
+            r["out"] = (action[sl], policy[sl], h_out[sl], c_out[sl])
+            row += k
+            r["event"].set()
+        self.batches_run += 1
+        self.rows_served += n
+
+    def stop(self) -> None:
+        with self._batch_ready:
+            self._stop = True
+            self._batch_ready.notify_all()
+        self._thread.join(timeout=5.0)
+        # Unblock any submitters that raced the shutdown.
+        for r in self._pending:
+            r["error"] = RuntimeError("inference server stopped")
+            r["event"].set()
+        self._pending = []

@@ -80,12 +80,14 @@ def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
 
 
 def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, weights,
-               seed: int = 0, agent=None):
+               seed: int = 0, agent=None, remote_act=None):
     """Actor `task` of the topology, over any queue/weight-store.
 
     The queue/weights may be the learner's own objects (single process) or
     transport adapters (multi-process) — same construction either way.
-    Pass `agent` to share one jit cache across runners in-process.
+    Pass `agent` to share one jit cache across runners in-process;
+    `remote_act` (IMPALA) switches the actor to SEED-style centralized
+    inference on the learner.
     """
     agent = agent or _AGENT_CLS[algo](agent_cfg)
     env = _make_batched_env(rt, task, agent_cfg.num_actions)
@@ -94,7 +96,7 @@ def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, w
         return impala_runner.ImpalaActor(
             agent, env, queue, weights, seed=seed,
             available_action=rt.available_action[task % len(rt.available_action)],
-            life_loss_shaping=atari)
+            life_loss_shaping=atari, remote_act=remote_act)
     if algo == "apex":
         return apex_runner.ApexActor(
             agent, env, queue, weights, seed=seed, life_loss_shaping=atari)

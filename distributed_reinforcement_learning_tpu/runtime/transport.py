@@ -36,11 +36,13 @@ OP_PUT_TRAJ = 1
 OP_GET_WEIGHTS = 2
 OP_QUEUE_SIZE = 3
 OP_PING = 4
+OP_ACT = 5  # SEED-style remote inference (runtime/inference.py)
 
 ST_OK = 0
 ST_ERROR = 1
 ST_CLOSED = 2
 ST_BUSY = 3  # bounded-queue timeout: retryable, not a dead learner
+ST_UNAVAILABLE = 4  # op permanently not served here (e.g. no --serve_inference)
 
 _HDR = struct.Struct("<BI")  # (op|status, payload_len)
 _I64 = struct.Struct("<q")
@@ -48,6 +50,15 @@ _I64 = struct.Struct("<q")
 
 class TransportError(ConnectionError):
     pass
+
+
+class InferenceUnavailableError(RuntimeError):
+    """OP_ACT permanently unserved (learner lacks --serve_inference).
+
+    Deliberately NOT a TransportError/OSError: the actor's elastic-grace
+    loop swallows those as transient outages, but a misconfigured
+    learner never recovers — this must fail fast with the real cause.
+    """
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -81,9 +92,11 @@ def _recv_msg(sock: socket.socket) -> tuple[int, bytes]:
 class TransportServer:
     """Learner-side service: owns nothing, serves the queue + weight store."""
 
-    def __init__(self, queue, weights, host: str = "0.0.0.0", port: int = 8000):
+    def __init__(self, queue, weights, host: str = "0.0.0.0", port: int = 8000,
+                 inference=None):
         self.queue = queue
         self.weights = weights
+        self.inference = inference  # optional InferenceServer for OP_ACT
         self.host, self.port = host, port
         self._sock: socket.socket | None = None
         self._threads: list[threading.Thread] = []
@@ -214,6 +227,23 @@ class TransportServer:
                         _send_msg(conn, ST_OK, _I64.pack(have))
                     else:
                         _send_msg(conn, ST_OK, _I64.pack(version), blob)
+                elif op == OP_ACT:
+                    # Own RuntimeError handling: an inference failure (e.g.
+                    # weights not published yet) must reply ST_ERROR, not
+                    # fall into the queue-closed ST_CLOSED arm below and
+                    # kill the actor's connection.
+                    if self.inference is None:
+                        _send_msg(conn, ST_UNAVAILABLE)
+                    else:
+                        try:
+                            req = codec.decode(payload, copy=True)
+                            action, policy, h, c = self.inference.submit(
+                                req["obs"], req["prev_action"], req["h"], req["c"])
+                        except RuntimeError:
+                            _send_msg(conn, ST_ERROR)
+                        else:
+                            _send_msg(conn, ST_OK, codec.encode(
+                                {"action": action, "policy": policy, "h": h, "c": c}))
                 elif op == OP_QUEUE_SIZE:
                     _send_msg(conn, ST_OK, _I64.pack(self.queue.size()))
                 elif op == OP_PING:
@@ -332,6 +362,25 @@ class TransportClient:
             return None
         return codec.decode(resp[_I64.size :], copy=True), version
 
+    def remote_act(self, obs, prev_action, h, c):
+        """SEED-style inference: ship observations, get actions.
+
+        Returns (action, policy, h', c') from the learner-side batched
+        act — always computed with the newest published weights, so the
+        actor never pulls params at all.
+        """
+        blob = codec.encode({"obs": obs, "prev_action": prev_action, "h": h, "c": c})
+        status, resp = self._exchange(OP_ACT, blob, retry=True, resend=True)
+        if status == ST_UNAVAILABLE:
+            raise InferenceUnavailableError(
+                "learner does not serve inference (start it with --serve_inference)")
+        if status == ST_CLOSED:
+            raise TransportError("learner closed the data plane")
+        if status != ST_OK:
+            raise TransportError("remote act failed on the learner side")
+        out = codec.decode(resp, copy=True)
+        return out["action"], out["policy"], out["h"], out["c"]
+
     def queue_size(self) -> int:
         return _I64.unpack(self._call(OP_QUEUE_SIZE))[0]
 
@@ -373,6 +422,16 @@ class RemoteWeights:
         return self._client.get_weights_if_newer(have_version)
 
 
+class RemoteInference:
+    """Actor-side act surface over OP_ACT (SEED-style remote inference)."""
+
+    def __init__(self, client: TransportClient):
+        self._client = client
+
+    def act(self, obs, prev_action, h, c):
+        return self._client.remote_act(obs, prev_action, h, c)
+
+
 def _make_queue(capacity: int):
     from distributed_reinforcement_learning_tpu.data.native import native_available
 
@@ -397,6 +456,8 @@ def run_role(
     checkpoint_dir: str | None = None,
     checkpoint_interval: int = 500,
     actor_grace: float = 120.0,
+    serve_inference: bool = False,
+    remote_act: bool = False,
 ) -> None:
     """One process of the reference topology: `--mode learner` or
     `--mode actor --task k` (reference role flags, `train_impala.py:16-20`)."""
@@ -469,7 +530,16 @@ def run_role(
                 print(f"[learner] resumed from step {learner.train_steps}")
             if multihost and jax.process_index() != 0:
                 ckpt = None  # every process restores; only process 0 writes
-        server = TransportServer(queue, weights, host="0.0.0.0", port=rt.server_port).start()
+        inference = None
+        if serve_inference:
+            if algo != "impala":
+                raise ValueError("--serve_inference currently supports impala only")
+            from distributed_reinforcement_learning_tpu.runtime.inference import InferenceServer
+
+            inference = InferenceServer(learner.agent, weights, seed=seed + 7777)
+            print("[learner] SEED-style inference service enabled")
+        server = TransportServer(queue, weights, host="0.0.0.0", port=rt.server_port,
+                                 inference=inference).start()
         print(f"[learner] serving on :{rt.server_port}; training {num_updates} updates")
         try:
             _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
@@ -479,14 +549,19 @@ def run_role(
             learner.close()  # stop prefetch thread, flush open profiler trace
             queue.close()
             server.stop()
+            if inference is not None:
+                inference.stop()
         print(f"[learner] done: {learner.train_steps} updates")
     elif mode == "actor":
         if task < 0:
             raise ValueError("actor mode needs --task k")
         client = TransportClient(rt.server_ip, rt.server_port)
+        if remote_act and algo != "impala":
+            raise ValueError("--remote_act currently supports impala only")
         actor = launch.make_actor(
             algo, agent_cfg, rt, task, RemoteQueue(client), RemoteWeights(client),
             seed=seed + 1 + task,
+            remote_act=RemoteInference(client) if remote_act else None,
         )
         print(f"[actor {task}] connected to {rt.server_ip}:{rt.server_port}")
         # Elastic recovery (SURVEY §5.3 — the reference had none: a dead

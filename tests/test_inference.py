@@ -1,0 +1,205 @@
+"""SEED-style centralized inference: batching server + remote-act actors.
+
+The reference computes every policy forward on the actor's own network
+copy (one `sess.run` per env step, `/root/reference/agent/impala.py:118-130`);
+these tests cover the TPU-native alternative — a learner-side service
+that batches act requests from many actors into single jitted calls
+(SURVEY §3.5), and an IMPALA actor training through it over real TCP
+with zero weight pulls.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+from distributed_reinforcement_learning_tpu.runtime.inference import InferenceServer, _bucket
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+
+def _tiny_agent():
+    cfg = ImpalaConfig(obs_shape=(4,), num_actions=2, trajectory=8, lstm_size=32,
+                       start_learning_rate=1e-3, learning_frame=10**6)
+    return ImpalaAgent(cfg), cfg
+
+
+class TestInferenceServer:
+    def test_bucket(self):
+        assert [_bucket(n) for n in (1, 2, 3, 5, 8, 9, 250)] == [1, 2, 4, 8, 8, 16, 256]
+        assert _bucket(300) == 512  # uncapped pow2: padding always applies
+
+    def test_submit_matches_local_act_distribution(self):
+        """Served actions/policies come from the same network: policies
+        must match the local act exactly (same params, same inputs)."""
+        agent, cfg = _tiny_agent()
+        weights = WeightStore()
+        params = agent.init_state(jax.random.PRNGKey(0)).params
+        weights.publish(params, 0)
+        server = InferenceServer(agent, weights, max_batch=64, max_wait_ms=1.0)
+        try:
+            obs = np.random.default_rng(0).random((5, 4), np.float32)
+            prev = np.zeros(5, np.int32)
+            h = c = np.zeros((5, cfg.lstm_size), np.float32)
+            action, policy, h2, c2 = server.submit(obs, prev, h, c)
+            local = agent.act(params, obs, prev, h, c, jax.random.PRNGKey(1))
+            np.testing.assert_allclose(policy, np.asarray(local.policy), rtol=1e-5)
+            np.testing.assert_allclose(h2, np.asarray(local.h), rtol=1e-5)
+            assert action.shape == (5,) and set(np.unique(action)) <= {0, 1}
+        finally:
+            server.stop()
+
+    def test_concurrent_submits_are_batched(self):
+        """N threads submitting simultaneously should be served in far
+        fewer jitted calls than N (the whole point of the service)."""
+        agent, cfg = _tiny_agent()
+        weights = WeightStore()
+        weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+        server = InferenceServer(agent, weights, max_batch=64, max_wait_ms=20.0)
+        results = [None] * 8
+
+        def one(i):
+            obs = np.full((4, 4), i / 10.0, np.float32)
+            results[i] = server.submit(
+                obs, np.zeros(4, np.int32),
+                np.zeros((4, cfg.lstm_size), np.float32),
+                np.zeros((4, cfg.lstm_size), np.float32))
+
+        try:
+            # Warm the jit cache so the first real batch isn't serialized
+            # behind a compile (which would defeat the batching window).
+            one(0)
+            threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30.0)
+            assert all(r is not None for r in results)
+            assert server.rows_served == 4 + 8 * 4
+            # 8 concurrent 4-row submits inside a 20ms window: at most a
+            # few batches, not 8.
+            assert server.batches_run <= 4, f"{server.batches_run} batches for 8 submits"
+            for i, r in enumerate(results):
+                assert r[0].shape == (4,)
+        finally:
+            server.stop()
+
+    def test_no_weights_raises(self):
+        agent, cfg = _tiny_agent()
+        server = InferenceServer(agent, WeightStore(), max_wait_ms=1.0)
+        try:
+            with pytest.raises(RuntimeError):
+                server.submit(np.zeros((1, 4), np.float32), np.zeros(1, np.int32),
+                              np.zeros((1, cfg.lstm_size), np.float32),
+                              np.zeros((1, cfg.lstm_size), np.float32))
+        finally:
+            server.stop()
+
+
+def test_impala_actor_trains_via_remote_act():
+    """Full loop over TCP: a remote-act actor (no local weight pulls)
+    feeds a live learner through the OP_ACT + OP_PUT_TRAJ ops."""
+    from distributed_reinforcement_learning_tpu.runtime import impala_runner
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        RemoteInference, RemoteQueue, RemoteWeights, TransportClient, TransportServer)
+    from distributed_reinforcement_learning_tpu.envs.cartpole import VectorCartPole
+
+    agent, cfg = _tiny_agent()
+    queue = TrajectoryQueue(capacity=32)
+    weights = WeightStore()
+    learner = impala_runner.ImpalaLearner(agent, queue, weights, batch_size=8,
+                                          rng=jax.random.PRNGKey(0))
+    inference = InferenceServer(agent, weights, max_wait_ms=2.0)
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = TransportServer(queue, weights, host="127.0.0.1", port=port,
+                             inference=inference).start()
+    client = TransportClient("127.0.0.1", port)
+    actor = impala_runner.ImpalaActor(
+        agent, VectorCartPole(num_envs=4, seed=0), RemoteQueue(client),
+        RemoteWeights(client), seed=1, remote_act=RemoteInference(client))
+
+    stop = threading.Event()
+
+    def actor_loop():
+        while not stop.is_set():
+            try:
+                actor.run_unroll()
+            except (ConnectionError, RuntimeError):
+                return
+
+    t = threading.Thread(target=actor_loop, daemon=True)
+    t.start()
+    try:
+        for _ in range(3):
+            m = learner.step(timeout=60.0)
+            assert m is not None and np.isfinite(m["total_loss"])
+        assert learner.train_steps == 3
+        assert inference.rows_served > 0  # actions actually came from the service
+        assert actor._params is None  # the actor never pulled weights
+    finally:
+        stop.set()
+        queue.close()
+        server.stop()
+        inference.stop()
+        t.join(timeout=5.0)
+        client.close()
+
+
+def test_remote_act_against_plain_learner_fails_fast():
+    """An actor pointed at a learner without --serve_inference must get a
+    clear, PERMANENT error — not spin out the elastic-grace window on a
+    retryable TransportError."""
+    import socket
+
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        InferenceUnavailableError, TransportClient, TransportServer)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = TransportServer(TrajectoryQueue(8), WeightStore(),
+                             host="127.0.0.1", port=port).start()  # no inference
+    client = TransportClient("127.0.0.1", port)
+    try:
+        with pytest.raises(InferenceUnavailableError, match="serve_inference"):
+            client.remote_act(np.zeros((1, 4), np.float32), np.zeros(1, np.int32),
+                              np.zeros((1, 8), np.float32), np.zeros((1, 8), np.float32))
+    finally:
+        server.stop()
+        client.close()
+
+
+def test_oversized_pending_is_chunked():
+    """More queued rows than max_batch: the server serves them in
+    max_batch-sized chunks (bounded XLA shapes), not one giant batch."""
+    agent, cfg = _tiny_agent()
+    weights = WeightStore()
+    weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+    # max_batch=8 with 4-row submits: two submits per batch, never three.
+    server = InferenceServer(agent, weights, max_batch=8, max_wait_ms=50.0)
+    results = [None] * 6
+
+    def one(i):
+        results[i] = server.submit(
+            np.zeros((4, 4), np.float32), np.zeros(4, np.int32),
+            np.zeros((4, cfg.lstm_size), np.float32),
+            np.zeros((4, cfg.lstm_size), np.float32))
+
+    try:
+        one(0)  # warm jit
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert all(r is not None for r in results)
+        assert server.rows_served == 4 + 6 * 4
+    finally:
+        server.stop()
