@@ -42,6 +42,7 @@ class ApexActor:
         epsilon_decay: float = 0.05,  # `train_apex.py:229`
         sync_every_steps: int = 100,
         life_loss_shaping: bool = False,
+        remote_act=None,  # SEED-style: RemoteInference; no weight pulls at all
     ):
         self.agent = agent
         self.env = env
@@ -52,6 +53,7 @@ class ApexActor:
         self.epsilon_decay = epsilon_decay
         self.sync_every_steps = sync_every_steps
         self.life_loss_shaping = life_loss_shaping
+        self.remote_act = remote_act
 
         self._rng = jax.random.PRNGKey(seed)
         self._buffer = UniformBuffer(local_capacity, seed=seed)
@@ -77,16 +79,24 @@ class ApexActor:
 
     def run_steps(self, num_steps: int) -> int:
         """Step the envs `num_steps` times; push buffer re-samples when warm."""
-        if self._steps % self.sync_every_steps == 0 or self._params is None:
-            self._sync_params()
-        if self._params is None:
-            raise RuntimeError("no weights published yet")
+        if self.remote_act is None:
+            if self._steps % self.sync_every_steps == 0 or self._params is None:
+                self._sync_params()
+            if self._params is None:
+                raise RuntimeError("no weights published yet")
 
         for _ in range(num_steps):
-            self._rng, sub = jax.random.split(self._rng)
-            actions, _ = self.agent.act(
-                self._params, self._obs, self._prev_action, self.epsilon, sub
-            )
+            if self.remote_act is not None:
+                # The epsilon schedule stays actor-side: exploration is
+                # the actor's identity even with centralized inference.
+                r = self.remote_act({"obs": self._obs, "prev_action": self._prev_action,
+                                     "epsilon": self.epsilon.astype(np.float32)})
+                actions = r["action"]
+            else:
+                self._rng, sub = jax.random.split(self._rng)
+                actions, _ = self.agent.act(
+                    self._params, self._obs, self._prev_action, self.epsilon, sub
+                )
             actions = np.asarray(actions)
             next_obs, reward, done, infos = self.env.step(actions)
 

@@ -84,9 +84,9 @@ class ImpalaActor:
             if self.remote_act is not None:
                 # Centralized inference: the learner acts for us with its
                 # newest weights (zero staleness, no local params).
-                action_a, policy_a, h_a, c_a = self.remote_act.act(
-                    self._obs, self._prev_action, self._h, self._c)
-                out = ActOutput(action_a, policy_a, h_a, c_a)
+                r = self.remote_act({"obs": self._obs, "prev_action": self._prev_action,
+                                     "h": self._h, "c": self._c})
+                out = ActOutput(r["action"], r["policy"], r["h"], r["c"])
             else:
                 self._rng, sub = jax.random.split(self._rng)
                 out = self.agent.act(

@@ -11,15 +11,23 @@ service always acts with the newest published params), actor hosts need
 no accelerator math, and the forward passes ride the MXU at batch sizes
 a single actor can't reach.
 
-`InferenceServer` is transport-agnostic: `submit()` blocks the calling
-(connection-handler) thread until its rows come back from the next
-batched step. Batching policy: run as soon as `max_batch` rows are
-pending, or when `max_wait_ms` expires with at least one row — latency
-bounded, batch opportunistic. Rows are padded to bucket sizes so XLA
-compiles a handful of shapes, not one per actor-count.
+The server is algorithm-agnostic: requests and replies are flat dicts
+of `[n, ...]` row arrays, and a per-algorithm `act adapter`
+(`make_act_adapter`) maps a merged row-dict through the agent's jitted
+act. IMPALA rows carry (obs, prev_action, h, c); Ape-X (obs,
+prev_action, epsilon — the actor still owns its exploration schedule);
+R2D2 (obs, h, c, prev_action, epsilon).
 
-The recurrent state (h, c) stays ACTOR-side — each request carries its
-envs' (h, c) and gets the advanced state back. That keeps the service
+`InferenceServer.submit()` blocks the calling (connection-handler)
+thread until its rows come back from the next batched step. Batching
+policy: run as soon as `max_batch` rows are pending, or when
+`max_wait_ms` expires with at least one row — latency bounded, batch
+opportunistic; oversubscription is served in max_batch-row chunks. Rows
+are padded to power-of-two buckets so XLA compiles a handful of shapes,
+not one per actor-count.
+
+Recurrent state (h, c) stays ACTOR-side — each request carries its
+envs' state and gets the advanced state back. That keeps the service
 stateless (any request can join any batch, actors can die freely) at
 the cost of 2*lstm_size floats per env each way, which is noise next to
 an 84x84x4 frame.
@@ -29,7 +37,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any
+from typing import Callable
 
 import numpy as np
 
@@ -44,25 +52,53 @@ def _bucket(n: int) -> int:
     return b
 
 
+def make_act_adapter(algo: str, agent) -> Callable:
+    """-> act_fn(params, rows: dict, rng) -> dict of `[n, ...]` outputs.
+
+    Uses the agent's already-jitted `act`, so the jit cache is shared
+    with any local actors in the same process.
+    """
+    if algo == "impala":
+        def impala_fn(params, rows, rng):
+            out = agent.act(params, rows["obs"], rows["prev_action"],
+                            rows["h"], rows["c"], rng)
+            return {"action": out.action, "policy": out.policy, "h": out.h, "c": out.c}
+        impala_fn.expected_keys = frozenset({"obs", "prev_action", "h", "c"})
+        return impala_fn
+    if algo == "apex":
+        def apex_fn(params, rows, rng):
+            action, q = agent.act(params, rows["obs"], rows["prev_action"],
+                                  rows["epsilon"], rng)
+            return {"action": action, "q": q}
+        apex_fn.expected_keys = frozenset({"obs", "prev_action", "epsilon"})
+        return apex_fn
+    if algo == "r2d2":
+        def r2d2_fn(params, rows, rng):
+            action, q, h, c = agent.act(params, rows["obs"], rows["h"], rows["c"],
+                                        rows["prev_action"], rows["epsilon"], rng)
+            return {"action": action, "q": q, "h": h, "c": c}
+        r2d2_fn.expected_keys = frozenset({"obs", "h", "c", "prev_action", "epsilon"})
+        return r2d2_fn
+    raise ValueError(f"unknown algorithm {algo!r}")
+
+
 class InferenceServer:
     """Batches concurrent act requests into single jitted calls.
 
-    `agent` must expose `act(params, obs, prev_action, h, c, rng)` (the
-    IMPALA surface; the jitted fn is taken as-is so the jit cache is
-    shared with any local actors). `weights` is the learner's
-    WeightStore — params are re-read every batch, so inference always
-    uses the newest published snapshot.
+    `act_fn` is a `make_act_adapter` product. `weights` is the learner's
+    WeightStore — params are re-read every batch (device-cached per
+    published version), so inference always uses the newest snapshot.
     """
 
     def __init__(
         self,
-        agent,
+        act_fn: Callable,
         weights,
         max_batch: int = 256,
         max_wait_ms: float = 2.0,
         seed: int = 0,
     ):
-        self.agent = agent
+        self.act_fn = act_fn
         self.weights = weights
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1e3
@@ -76,7 +112,7 @@ class InferenceServer:
         self._cached_version: int | None = None
         self._lock = threading.Lock()
         self._batch_ready = threading.Condition(self._lock)
-        self._pending: list[dict] = []  # [{arrays, n, event, out}]
+        self._pending: list[dict] = []
         self._pending_rows = 0
         self._stop = False
         self._thread = threading.Thread(target=self._loop, daemon=True, name="inference")
@@ -84,25 +120,37 @@ class InferenceServer:
         self.batches_run = 0
         self.rows_served = 0
 
-    def submit(self, obs, prev_action, h, c) -> tuple[np.ndarray, ...]:
-        """Act for one request's `[n, ...]` rows; blocks until served.
+    @classmethod
+    def for_agent(cls, algo: str, agent, weights, **kwargs) -> "InferenceServer":
+        return cls(make_act_adapter(algo, agent), weights, **kwargs)
 
-        Returns (action [n], policy [n, A], h' [n, H], c' [n, H]).
+    def submit(self, request: dict) -> dict:
+        """Act for one request's `[n, ...]` row-dict; blocks until served.
+
+        Validates the request HERE so a malformed or algorithm-mismatched
+        actor fails alone (its connection gets ST_ERROR) instead of
+        poisoning the whole batch it would have joined — and so row-count
+        mismatches can never misalign the scatter back to other actors.
         """
-        req = {
-            "obs": np.asarray(obs),
-            "prev_action": np.asarray(prev_action),
-            "h": np.asarray(h),
-            "c": np.asarray(c),
-            "event": threading.Event(),
-            "out": None,
-            "error": None,
-        }
+        request = {k: np.asarray(v) for k, v in request.items()}
+        if not request:
+            raise RuntimeError("empty inference request")
+        expected = getattr(self.act_fn, "expected_keys", None)
+        if expected is not None and set(request) != set(expected):
+            raise RuntimeError(
+                f"inference request keys {sorted(request)} != expected "
+                f"{sorted(expected)} (actor/learner algorithm mismatch?)")
+        ns = {k: v.shape[0] if v.ndim else -1 for k, v in request.items()}
+        if len(set(ns.values())) != 1:
+            raise RuntimeError(f"inference request row counts disagree: {ns}")
+        n = next(iter(request.values())).shape[0]
+        req = {"rows": request, "n": n, "event": threading.Event(),
+               "out": None, "error": None}
         with self._batch_ready:
             if self._stop:
                 raise RuntimeError("inference server stopped")
             self._pending.append(req)
-            self._pending_rows += req["obs"].shape[0]
+            self._pending_rows += n
             self._batch_ready.notify()
         req["event"].wait()
         if req["error"] is not None:
@@ -124,7 +172,7 @@ class InferenceServer:
                 ):
                     batch, rows = [], 0
                     while self._pending:
-                        k = self._pending[0]["obs"].shape[0]
+                        k = self._pending[0]["n"]
                         if batch and rows + k > self.max_batch:
                             break
                         rows += k
@@ -132,7 +180,7 @@ class InferenceServer:
                     self._pending_rows -= rows
                     return batch
                 # Idle (nothing pending): sleep until a submit notifies —
-                # no 2ms poll wakeups on a learner with no remote actors.
+                # no poll wakeups on a learner with no remote actors.
                 self._batch_ready.wait(
                     timeout=None if deadline is None
                     else max(1e-4, deadline - time.monotonic())
@@ -158,30 +206,23 @@ class InferenceServer:
         if version != self._cached_version:
             self._device_params = jax.device_put(params)
             self._cached_version = version
-        obs = np.concatenate([r["obs"] for r in reqs])
-        prev = np.concatenate([r["prev_action"] for r in reqs])
-        h = np.concatenate([r["h"] for r in reqs])
-        c = np.concatenate([r["c"] for r in reqs])
-        n = obs.shape[0]
+        keys = reqs[0]["rows"].keys()
+        rows = {k: np.concatenate([r["rows"][k] for r in reqs]) for k in keys}
+        n = sum(r["n"] for r in reqs)
         b = _bucket(n)
-        if b > n:  # pad rows so XLA sees a handful of shapes
+        if b > n:
+            # Pad by repeating row 0: always valid values for any dtype
+            # (obs, epsilon, state), sliced off before the scatter below.
             pad = b - n
-            obs = np.concatenate([obs, np.repeat(obs[:1], pad, axis=0)])
-            prev = np.concatenate([prev, np.zeros(pad, prev.dtype)])
-            h = np.concatenate([h, np.zeros((pad, h.shape[1]), h.dtype)])
-            c = np.concatenate([c, np.zeros((pad, c.shape[1]), c.dtype)])
+            rows = {k: np.concatenate([v, np.repeat(v[:1], pad, axis=0)])
+                    for k, v in rows.items()}
         self._rng, sub = jax.random.split(self._rng)
-        out = self.agent.act(self._device_params, obs, prev, h, c, sub)
-        action = np.asarray(out.action)[:n]
-        policy = np.asarray(out.policy)[:n]
-        h_out = np.asarray(out.h)[:n]
-        c_out = np.asarray(out.c)[:n]
+        out = {k: np.asarray(v)[:n] for k, v in self.act_fn(self._device_params, rows, sub).items()}
         row = 0
         for r in reqs:
-            k = r["obs"].shape[0]
-            sl = slice(row, row + k)
-            r["out"] = (action[sl], policy[sl], h_out[sl], c_out[sl])
-            row += k
+            sl = slice(row, row + r["n"])
+            r["out"] = {k: v[sl] for k, v in out.items()}
+            row += r["n"]
             r["event"].set()
         self.batches_run += 1
         self.rows_served += n

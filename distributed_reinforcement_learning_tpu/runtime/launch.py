@@ -86,8 +86,8 @@ def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, w
     The queue/weights may be the learner's own objects (single process) or
     transport adapters (multi-process) — same construction either way.
     Pass `agent` to share one jit cache across runners in-process;
-    `remote_act` (IMPALA) switches the actor to SEED-style centralized
-    inference on the learner.
+    `remote_act` (any algorithm) switches the actor to SEED-style
+    centralized inference on the learner.
     """
     agent = agent or _AGENT_CLS[algo](agent_cfg)
     env = _make_batched_env(rt, task, agent_cfg.num_actions)
@@ -99,10 +99,12 @@ def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, w
             life_loss_shaping=atari, remote_act=remote_act)
     if algo == "apex":
         return apex_runner.ApexActor(
-            agent, env, queue, weights, seed=seed, life_loss_shaping=atari)
+            agent, env, queue, weights, seed=seed, life_loss_shaping=atari,
+            remote_act=remote_act)
     transform = pomdp_project if agent_cfg.obs_shape == (2,) else None
     return r2d2_runner.R2D2Actor(
-        agent, env, queue, weights, seed=seed, obs_transform=transform)
+        agent, env, queue, weights, seed=seed, obs_transform=transform,
+        remote_act=remote_act)
 
 
 _RUN_SYNC = {

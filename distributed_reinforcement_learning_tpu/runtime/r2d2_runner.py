@@ -37,6 +37,7 @@ class R2D2Actor:
         seed: int = 0,
         epsilon_decay: float = 0.1,  # `train_r2d2.py:221`
         obs_transform=None,  # e.g. envs.cartpole.pomdp_project
+        remote_act=None,  # SEED-style: RemoteInference; no weight pulls at all
     ):
         self.agent = agent
         self.env = env
@@ -44,6 +45,7 @@ class R2D2Actor:
         self.weights = weights
         self.epsilon_decay = epsilon_decay
         self.obs_transform = obs_transform or (lambda x: x)
+        self.remote_act = remote_act
 
         self._rng = jax.random.PRNGKey(seed)
         self._obs = self.obs_transform(env.reset())
@@ -68,18 +70,27 @@ class R2D2Actor:
     def run_unroll(self) -> int:
         """One seq_len unroll from all envs -> N sequences into the queue."""
         cfg = self.agent.cfg
-        self._sync_params()
-        if self._params is None:
-            raise RuntimeError("no weights published yet")
+        if self.remote_act is None:
+            self._sync_params()
+            if self._params is None:
+                raise RuntimeError("no weights published yet")
         acc = R2D2SequenceAccumulator()
         acc.reset(self._h, self._c)
         n = self._obs.shape[0]
 
         for _ in range(cfg.seq_len):
-            self._rng, sub = jax.random.split(self._rng)
-            action, _, h, c = self.agent.act(
-                self._params, self._obs, self._h, self._c, self._prev_action, self.epsilon, sub
-            )
+            if self.remote_act is not None:
+                r = self.remote_act({
+                    "obs": self._obs, "h": self._h, "c": self._c,
+                    "prev_action": self._prev_action,
+                    "epsilon": self.epsilon.astype(np.float32)})
+                action, h, c = r["action"], r["h"], r["c"]
+            else:
+                self._rng, sub = jax.random.split(self._rng)
+                action, _, h, c = self.agent.act(
+                    self._params, self._obs, self._h, self._c, self._prev_action,
+                    self.epsilon, sub
+                )
             action = np.asarray(action)
             next_obs_raw, reward, done, infos = self.env.step(action)
             next_obs = self.obs_transform(next_obs_raw)

@@ -236,14 +236,11 @@ class TransportServer:
                         _send_msg(conn, ST_UNAVAILABLE)
                     else:
                         try:
-                            req = codec.decode(payload, copy=True)
-                            action, policy, h, c = self.inference.submit(
-                                req["obs"], req["prev_action"], req["h"], req["c"])
+                            out = self.inference.submit(codec.decode(payload, copy=True))
                         except RuntimeError:
                             _send_msg(conn, ST_ERROR)
                         else:
-                            _send_msg(conn, ST_OK, codec.encode(
-                                {"action": action, "policy": policy, "h": h, "c": c}))
+                            _send_msg(conn, ST_OK, codec.encode(out))
                 elif op == OP_QUEUE_SIZE:
                     _send_msg(conn, ST_OK, _I64.pack(self.queue.size()))
                 elif op == OP_PING:
@@ -362,15 +359,14 @@ class TransportClient:
             return None
         return codec.decode(resp[_I64.size :], copy=True), version
 
-    def remote_act(self, obs, prev_action, h, c):
-        """SEED-style inference: ship observations, get actions.
+    def remote_act(self, request: dict) -> dict:
+        """SEED-style inference: ship observation rows, get action rows.
 
-        Returns (action, policy, h', c') from the learner-side batched
-        act — always computed with the newest published weights, so the
-        actor never pulls params at all.
+        Request/reply are the algorithm-specific row dicts of
+        `runtime/inference.py` — always computed with the learner's
+        newest published weights, so the actor never pulls params.
         """
-        blob = codec.encode({"obs": obs, "prev_action": prev_action, "h": h, "c": c})
-        status, resp = self._exchange(OP_ACT, blob, retry=True, resend=True)
+        status, resp = self._exchange(OP_ACT, codec.encode(request), retry=True, resend=True)
         if status == ST_UNAVAILABLE:
             raise InferenceUnavailableError(
                 "learner does not serve inference (start it with --serve_inference)")
@@ -378,8 +374,7 @@ class TransportClient:
             raise TransportError("learner closed the data plane")
         if status != ST_OK:
             raise TransportError("remote act failed on the learner side")
-        out = codec.decode(resp, copy=True)
-        return out["action"], out["policy"], out["h"], out["c"]
+        return codec.decode(resp, copy=True)
 
     def queue_size(self) -> int:
         return _I64.unpack(self._call(OP_QUEUE_SIZE))[0]
@@ -423,13 +418,15 @@ class RemoteWeights:
 
 
 class RemoteInference:
-    """Actor-side act surface over OP_ACT (SEED-style remote inference)."""
+    """Actor-side act surface over OP_ACT (SEED-style remote inference).
+
+    Callable with the algorithm's row dict; returns the reply dict."""
 
     def __init__(self, client: TransportClient):
         self._client = client
 
-    def act(self, obs, prev_action, h, c):
-        return self._client.remote_act(obs, prev_action, h, c)
+    def __call__(self, request: dict) -> dict:
+        return self._client.remote_act(request)
 
 
 def _make_queue(capacity: int):
@@ -532,11 +529,10 @@ def run_role(
                 ckpt = None  # every process restores; only process 0 writes
         inference = None
         if serve_inference:
-            if algo != "impala":
-                raise ValueError("--serve_inference currently supports impala only")
             from distributed_reinforcement_learning_tpu.runtime.inference import InferenceServer
 
-            inference = InferenceServer(learner.agent, weights, seed=seed + 7777)
+            inference = InferenceServer.for_agent(algo, learner.agent, weights,
+                                                  seed=seed + 7777)
             print("[learner] SEED-style inference service enabled")
         server = TransportServer(queue, weights, host="0.0.0.0", port=rt.server_port,
                                  inference=inference).start()
@@ -556,8 +552,6 @@ def run_role(
         if task < 0:
             raise ValueError("actor mode needs --task k")
         client = TransportClient(rt.server_ip, rt.server_port)
-        if remote_act and algo != "impala":
-            raise ValueError("--remote_act currently supports impala only")
         actor = launch.make_actor(
             algo, agent_cfg, rt, task, RemoteQueue(client), RemoteWeights(client),
             seed=seed + 1 + task,

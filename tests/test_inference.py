@@ -4,8 +4,8 @@ The reference computes every policy forward on the actor's own network
 copy (one `sess.run` per env step, `/root/reference/agent/impala.py:118-130`);
 these tests cover the TPU-native alternative — a learner-side service
 that batches act requests from many actors into single jitted calls
-(SURVEY §3.5), and an IMPALA actor training through it over real TCP
-with zero weight pulls.
+(SURVEY §3.5) for ALL THREE algorithms, and actors training through it
+over real TCP with zero weight pulls.
 """
 
 import threading
@@ -26,6 +26,15 @@ def _tiny_agent():
     return ImpalaAgent(cfg), cfg
 
 
+def _impala_request(cfg, n, fill=0.0):
+    return {
+        "obs": np.full((n, 4), fill, np.float32),
+        "prev_action": np.zeros(n, np.int32),
+        "h": np.zeros((n, cfg.lstm_size), np.float32),
+        "c": np.zeros((n, cfg.lstm_size), np.float32),
+    }
+
+
 class TestInferenceServer:
     def test_bucket(self):
         assert [_bucket(n) for n in (1, 2, 3, 5, 8, 9, 250)] == [1, 2, 4, 8, 8, 16, 256]
@@ -38,16 +47,17 @@ class TestInferenceServer:
         weights = WeightStore()
         params = agent.init_state(jax.random.PRNGKey(0)).params
         weights.publish(params, 0)
-        server = InferenceServer(agent, weights, max_batch=64, max_wait_ms=1.0)
+        server = InferenceServer.for_agent("impala", agent, weights,
+                                           max_batch=64, max_wait_ms=1.0)
         try:
-            obs = np.random.default_rng(0).random((5, 4), np.float32)
-            prev = np.zeros(5, np.int32)
-            h = c = np.zeros((5, cfg.lstm_size), np.float32)
-            action, policy, h2, c2 = server.submit(obs, prev, h, c)
-            local = agent.act(params, obs, prev, h, c, jax.random.PRNGKey(1))
-            np.testing.assert_allclose(policy, np.asarray(local.policy), rtol=1e-5)
-            np.testing.assert_allclose(h2, np.asarray(local.h), rtol=1e-5)
-            assert action.shape == (5,) and set(np.unique(action)) <= {0, 1}
+            req = _impala_request(cfg, 5)
+            req["obs"] = np.random.default_rng(0).random((5, 4), np.float32)
+            out = server.submit(req)
+            local = agent.act(params, req["obs"], req["prev_action"],
+                              req["h"], req["c"], jax.random.PRNGKey(1))
+            np.testing.assert_allclose(out["policy"], np.asarray(local.policy), rtol=1e-5)
+            np.testing.assert_allclose(out["h"], np.asarray(local.h), rtol=1e-5)
+            assert out["action"].shape == (5,) and set(np.unique(out["action"])) <= {0, 1}
         finally:
             server.stop()
 
@@ -57,15 +67,12 @@ class TestInferenceServer:
         agent, cfg = _tiny_agent()
         weights = WeightStore()
         weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
-        server = InferenceServer(agent, weights, max_batch=64, max_wait_ms=20.0)
+        server = InferenceServer.for_agent("impala", agent, weights,
+                                           max_batch=64, max_wait_ms=20.0)
         results = [None] * 8
 
         def one(i):
-            obs = np.full((4, 4), i / 10.0, np.float32)
-            results[i] = server.submit(
-                obs, np.zeros(4, np.int32),
-                np.zeros((4, cfg.lstm_size), np.float32),
-                np.zeros((4, cfg.lstm_size), np.float32))
+            results[i] = server.submit(_impala_request(cfg, 4, fill=i / 10.0))
 
         try:
             # Warm the jit cache so the first real batch isn't serialized
@@ -81,19 +88,60 @@ class TestInferenceServer:
             # 8 concurrent 4-row submits inside a 20ms window: at most a
             # few batches, not 8.
             assert server.batches_run <= 4, f"{server.batches_run} batches for 8 submits"
-            for i, r in enumerate(results):
-                assert r[0].shape == (4,)
+            for r in results:
+                assert r["action"].shape == (4,)
         finally:
             server.stop()
 
     def test_no_weights_raises(self):
         agent, cfg = _tiny_agent()
-        server = InferenceServer(agent, WeightStore(), max_wait_ms=1.0)
+        server = InferenceServer.for_agent("impala", agent, WeightStore(), max_wait_ms=1.0)
         try:
             with pytest.raises(RuntimeError):
-                server.submit(np.zeros((1, 4), np.float32), np.zeros(1, np.int32),
-                              np.zeros((1, cfg.lstm_size), np.float32),
-                              np.zeros((1, cfg.lstm_size), np.float32))
+                server.submit(_impala_request(cfg, 1))
+        finally:
+            server.stop()
+
+    def test_apex_adapter(self):
+        """Ape-X rows carry the actor-side epsilon; greedy rows (eps=0)
+        must argmax the same Q the local act computes."""
+        from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexConfig
+
+        agent = ApexAgent(ApexConfig(obs_shape=(4,), num_actions=3,
+                                     start_learning_rate=1e-3))
+        weights = WeightStore()
+        params = agent.init_state(jax.random.PRNGKey(0)).params
+        weights.publish(params, 0)
+        server = InferenceServer.for_agent("apex", agent, weights, max_wait_ms=1.0)
+        try:
+            obs = np.random.default_rng(1).random((6, 4), np.float32)
+            out = server.submit({"obs": obs, "prev_action": np.zeros(6, np.int32),
+                                 "epsilon": np.zeros(6, np.float32)})
+            _, q_local = agent.act(params, obs, np.zeros(6, np.int32),
+                                   np.zeros(6, np.float32), jax.random.PRNGKey(2))
+            np.testing.assert_allclose(out["q"], np.asarray(q_local), rtol=1e-5)
+            np.testing.assert_array_equal(out["action"], np.argmax(out["q"], axis=-1))
+        finally:
+            server.stop()
+
+    def test_r2d2_adapter(self):
+        from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Config
+
+        agent = R2D2Agent(R2D2Config(obs_shape=(2,), num_actions=2, seq_len=6,
+                                     burn_in=2, lstm_size=32, learning_rate=1e-3))
+        weights = WeightStore()
+        weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+        server = InferenceServer.for_agent("r2d2", agent, weights, max_wait_ms=1.0)
+        try:
+            out = server.submit({
+                "obs": np.random.default_rng(2).integers(0, 255, (3, 2)).astype(np.int32),
+                "h": np.zeros((3, 32), np.float32),
+                "c": np.zeros((3, 32), np.float32),
+                "prev_action": np.zeros(3, np.int32),
+                "epsilon": np.zeros(3, np.float32),
+            })
+            assert out["action"].shape == (3,)
+            assert out["h"].shape == (3, 32) and np.any(out["h"] != 0)
         finally:
             server.stop()
 
@@ -111,7 +159,7 @@ def test_impala_actor_trains_via_remote_act():
     weights = WeightStore()
     learner = impala_runner.ImpalaLearner(agent, queue, weights, batch_size=8,
                                           rng=jax.random.PRNGKey(0))
-    inference = InferenceServer(agent, weights, max_wait_ms=2.0)
+    inference = InferenceServer.for_agent("impala", agent, weights, max_wait_ms=2.0)
 
     import socket
 
@@ -152,6 +200,32 @@ def test_impala_actor_trains_via_remote_act():
         client.close()
 
 
+def test_r2d2_actor_runs_via_remote_act_inprocess():
+    """R2D2 remote-act path: unrolls flow with LSTM state round-tripping
+    through the service (in-process adapters, no TCP needed here)."""
+    from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Config
+    from distributed_reinforcement_learning_tpu.envs.cartpole import VectorCartPole, pomdp_project
+    from distributed_reinforcement_learning_tpu.runtime import r2d2_runner
+
+    agent = R2D2Agent(R2D2Config(obs_shape=(2,), num_actions=2, seq_len=6,
+                                 burn_in=2, lstm_size=32, learning_rate=1e-3))
+    weights = WeightStore()
+    weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+    inference = InferenceServer.for_agent("r2d2", agent, weights, max_wait_ms=1.0)
+    queue = TrajectoryQueue(capacity=64)
+    actor = r2d2_runner.R2D2Actor(
+        agent, VectorCartPole(num_envs=4, seed=0), queue, weights, seed=1,
+        obs_transform=pomdp_project, remote_act=inference.submit)
+    try:
+        frames = actor.run_unroll()
+        assert frames == 4 * 6
+        assert queue.size() == 4
+        assert actor._params is None
+        assert inference.rows_served >= 4 * 6
+    finally:
+        inference.stop()
+
+
 def test_remote_act_against_plain_learner_fails_fast():
     """An actor pointed at a learner without --serve_inference must get a
     clear, PERMANENT error — not spin out the elastic-grace window on a
@@ -169,8 +243,7 @@ def test_remote_act_against_plain_learner_fails_fast():
     client = TransportClient("127.0.0.1", port)
     try:
         with pytest.raises(InferenceUnavailableError, match="serve_inference"):
-            client.remote_act(np.zeros((1, 4), np.float32), np.zeros(1, np.int32),
-                              np.zeros((1, 8), np.float32), np.zeros((1, 8), np.float32))
+            client.remote_act({"obs": np.zeros((1, 4), np.float32)})
     finally:
         server.stop()
         client.close()
@@ -183,14 +256,12 @@ def test_oversized_pending_is_chunked():
     weights = WeightStore()
     weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
     # max_batch=8 with 4-row submits: two submits per batch, never three.
-    server = InferenceServer(agent, weights, max_batch=8, max_wait_ms=50.0)
+    server = InferenceServer.for_agent("impala", agent, weights,
+                                       max_batch=8, max_wait_ms=50.0)
     results = [None] * 6
 
     def one(i):
-        results[i] = server.submit(
-            np.zeros((4, 4), np.float32), np.zeros(4, np.int32),
-            np.zeros((4, cfg.lstm_size), np.float32),
-            np.zeros((4, cfg.lstm_size), np.float32))
+        results[i] = server.submit(_impala_request(cfg, 4))
 
     try:
         one(0)  # warm jit
@@ -201,5 +272,55 @@ def test_oversized_pending_is_chunked():
             t.join(timeout=30.0)
         assert all(r is not None for r in results)
         assert server.rows_served == 4 + 6 * 4
+    finally:
+        server.stop()
+
+
+def test_apex_actor_runs_via_remote_act_inprocess():
+    from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexConfig
+    from distributed_reinforcement_learning_tpu.envs.cartpole import VectorCartPole
+    from distributed_reinforcement_learning_tpu.runtime import apex_runner
+
+    agent = ApexAgent(ApexConfig(obs_shape=(4,), num_actions=2,
+                                 start_learning_rate=1e-3))
+    weights = WeightStore()
+    weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+    inference = InferenceServer.for_agent("apex", agent, weights, max_wait_ms=1.0)
+    queue = TrajectoryQueue(capacity=64)
+    actor = apex_runner.ApexActor(
+        agent, VectorCartPole(num_envs=4, seed=0), queue, weights, seed=1,
+        unroll_size=8, local_capacity=200, remote_act=inference.submit)
+    try:
+        frames = actor.run_steps(16)
+        assert frames == 16 * 4
+        assert len(actor._buffer) == 16 * 4
+        assert actor._params is None
+        assert inference.rows_served >= 16 * 4
+    finally:
+        inference.stop()
+
+
+def test_mismatched_request_fails_alone():
+    """An algorithm-mismatched request must be rejected at submit (its
+    connection only), never joined to a batch it would poison."""
+    agent, cfg = _tiny_agent()
+    weights = WeightStore()
+    weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+    server = InferenceServer.for_agent("impala", agent, weights, max_wait_ms=5.0)
+    try:
+        with pytest.raises(RuntimeError, match="algorithm mismatch"):
+            server.submit({"obs": np.zeros((2, 4), np.float32),
+                           "prev_action": np.zeros(2, np.int32),
+                           "epsilon": np.zeros(2, np.float32)})  # apex-shaped
+        with pytest.raises(RuntimeError, match="row counts disagree"):
+            server.submit({"obs": np.zeros((2, 4), np.float32),
+                           "prev_action": np.zeros(3, np.int32),
+                           "h": np.zeros((2, cfg.lstm_size), np.float32),
+                           "c": np.zeros((2, cfg.lstm_size), np.float32)})
+        with pytest.raises(RuntimeError, match="empty"):
+            server.submit({})
+        # Healthy requests still serve fine afterwards.
+        out = server.submit(_impala_request(cfg, 2))
+        assert out["action"].shape == (2,)
     finally:
         server.stop()

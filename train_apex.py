@@ -26,6 +26,10 @@ def main() -> None:
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. 'cpu'); actors default to cpu "
                         "so they never grab the TPU chip")
+    p.add_argument("--serve_inference", action="store_true",
+                   help="learner mode: serve SEED-style centralized inference")
+    p.add_argument("--remote_act", action="store_true",
+                   help="actor mode: offload act() to the learner's inference service")
     args = p.parse_args()
 
     platform = args.platform or ("cpu" if args.mode == "actor" else None)
@@ -46,7 +50,9 @@ def main() -> None:
                  num_updates=args.updates, run_dir=args.run_dir, seed=args.seed,
                  checkpoint_dir=args.checkpoint_dir,
                  checkpoint_interval=args.checkpoint_interval,
-                 actor_grace=args.actor_grace)
+                 actor_grace=args.actor_grace,
+                 serve_inference=args.serve_inference,
+                 remote_act=args.remote_act)
 
 
 if __name__ == "__main__":
