@@ -129,10 +129,13 @@ def bench_e2e(cfg, B: int, updates: int, feeders: int = 3) -> dict:
         OP_PUT_TRAJ, TransportClient, TransportServer, _make_queue)
     from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 
+    publish_interval = int(os.environ.get("BENCH_PUBLISH_INTERVAL", "1"))
     agent = ImpalaAgent(cfg)
     queue = _make_queue(max(4 * B, 128))
     weights = WeightStore()
-    learner = ImpalaLearner(agent, queue, weights, batch_size=B, prefetch=True)
+    learner = ImpalaLearner(
+        agent, queue, weights, batch_size=B, prefetch=True,
+        publish_interval=publish_interval)
     learner.timer.log_every = updates  # one flush covering the measured window
     port = _free_port()
     server = TransportServer(queue, weights, host="127.0.0.1", port=port).start()
@@ -181,8 +184,8 @@ def bench_e2e(cfg, B: int, updates: int, feeders: int = 3) -> dict:
     stage_ms = {k: round(v, 3) for k, v in stage_ms.items()}
     print(f"[bench] e2e B={B}: {updates} updates in {dt:.2f}s = {fps:,.0f} frames/s, "
           f"stages {stage_ms}", file=sys.stderr)
-    return {"B": B, "feeders": feeders, "frames_per_s": round(fps, 1),
-            "stage_ms": stage_ms}
+    return {"B": B, "feeders": feeders, "publish_interval": publish_interval,
+            "frames_per_s": round(fps, 1), "stage_ms": stage_ms}
 
 
 def bench_kernels(cfg, B: int, iters: int) -> dict:
@@ -277,8 +280,9 @@ def main() -> None:
     sweep_default = "32,64,128" if on_accel else "8"
     sweep = [int(b) for b in os.environ.get("BENCH_SWEEP", sweep_default).split(",")]
 
-    cfg = ImpalaConfig(dtype=dtype)
-    extra: dict = {"platform": platform, "dtype": str(dtype.__name__)}
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    cfg = ImpalaConfig(dtype=dtype, remat=remat)
+    extra: dict = {"platform": platform, "dtype": str(dtype.__name__), "remat": remat}
 
     results = [bench_learn_step(cfg, B, iters) for B in sweep]
     best = max(results, key=lambda r: r["frames_per_s"])

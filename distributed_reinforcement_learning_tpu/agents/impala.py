@@ -21,6 +21,7 @@ Loss math parity (`agent/impala.py:63-93`):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, NamedTuple
 
 import jax
@@ -48,6 +49,11 @@ class ImpalaConfig:
     end_learning_rate: float = 0.0
     learning_frame: int = 1_000_000_000
     dtype: Any = jnp.float32
+    # Rematerialize the [B*T] stored-state forward in the backward pass
+    # (jax.checkpoint): trades ~1 extra forward of FLOPs for not holding
+    # conv/LSTM activations of B*T frames in HBM — the knob that lets
+    # batch size keep scaling once activations, not params, bound memory.
+    remat: bool = False
 
 
 class ImpalaBatch(NamedTuple):
@@ -112,8 +118,10 @@ class ImpalaAgent:
     # -- learn -----------------------------------------------------------
     def _loss(self, params, batch: ImpalaBatch):
         cfg = self.cfg
-        policy, value = apply_stored_state(
-            self.model,
+        forward = functools.partial(apply_stored_state, self.model)
+        if cfg.remat:
+            forward = jax.checkpoint(forward)
+        policy, value = forward(
             params,
             common.normalize_obs(batch.state),
             batch.previous_action,

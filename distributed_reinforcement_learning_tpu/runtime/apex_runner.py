@@ -147,6 +147,7 @@ class ApexLearner:
         rng: jax.Array | None = None,
         seed: int = 0,
         mesh=None,
+        publish_interval: int = 1,
     ):
         self.agent = agent
         self.queue = queue
@@ -173,6 +174,10 @@ class ApexLearner:
             self.state = agent.init_state(rng)
         self.state = agent.sync_target(self.state)
         self._np_rng = np.random.RandomState(seed)
+        # Publish cadence (see ImpalaLearner): here the step syncs on the
+        # TD/priority read regardless, so interval>1 saves only the
+        # per-step D2H params copy.
+        self.publish_interval = max(1, publish_interval)
         self.ingested_unrolls = 0
         self.train_steps = 0
         self.timer = StageTimer(self.logger)
@@ -245,8 +250,9 @@ class ApexLearner:
         with self.timer.stage("replay_update"):
             self.replay.update_batch(idxs, np.asarray(td))
         self.train_steps += 1
-        with self.timer.stage("publish"):
-            self.weights.publish(self.state.params, self.train_steps)
+        if self.train_steps % self.publish_interval == 0:
+            with self.timer.stage("publish"):
+                self.weights.publish(self.state.params, self.train_steps)
         if self.train_steps % self.target_sync_interval == 0:
             self.state = self.agent.sync_target(self.state)
         metrics = {k: float(v) for k, v in metrics.items()}
@@ -256,6 +262,8 @@ class ApexLearner:
         return metrics
 
     def close(self) -> None:
+        if self.train_steps > 0 and self.train_steps % self.publish_interval != 0:
+            self.weights.publish(self.state.params, self.train_steps)  # final flush
         self._profiler.close()
 
 

@@ -141,6 +141,7 @@ class ImpalaLearner:
         rng: jax.Array | None = None,
         prefetch: bool = False,
         mesh=None,
+        publish_interval: int = 1,
     ):
         self.agent = agent
         self.queue = queue
@@ -172,6 +173,13 @@ class ImpalaLearner:
 
             self._prefetcher = DevicePrefetcher(
                 queue, batch_size, sharding=self._batch_sharding)
+        # Publish cadence: every step (interval=1, reference-parity
+        # freshness) forces a full D2H param copy + device sync per step.
+        # interval=K lets K device steps pipeline back-to-back before the
+        # next host sync — a real TPU throughput lever at the cost of
+        # actors acting on weights up to K-1 updates staler (V-trace
+        # already corrects exactly this off-policyness).
+        self.publish_interval = max(1, publish_interval)
         self.state = (
             self._sharded.init_state(rng) if self._sharded is not None
             else agent.init_state(rng)
@@ -216,20 +224,32 @@ class ImpalaLearner:
             self.state, metrics = self._learn(self.state, batch)
         self.train_steps += 1
         self.frames_learned += self.batch_size * self.agent.cfg.trajectory
-        # publish's host snapshot (np.asarray) is the step's device sync,
-        # so "learn" above measures dispatch and "publish" compute+D2H.
-        with self.timer.stage("publish"):
-            self.weights.publish(self.state.params, self.train_steps)
-        metrics = {k: float(v) for k, v in metrics.items()}
+        if self.train_steps % self.publish_interval == 0:
+            # publish's host snapshot (np.asarray) is this step's device
+            # sync, so "learn" above measures dispatch and "publish"
+            # compute+D2H; metric conversion after it is free.
+            with self.timer.stage("publish"):
+                self.weights.publish(self.state.params, self.train_steps)
+            metrics = {k: float(v) for k, v in metrics.items()}
+            self.logger.add_scalars(
+                {f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
+        # Non-publish steps return the metrics as DEVICE arrays and log
+        # nothing: forcing a float() here would block on the step and
+        # defeat the whole point of the interval (letting K device steps
+        # pipeline back-to-back with no host sync between them). Callers
+        # that read a value pay the sync themselves.
         self.timer.step_done(self.train_steps)
         self._profiler.on_step(self.train_steps)
-        self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
         return metrics
 
     def close(self) -> None:
         """Stop the prefetch thread and flush any open profiler trace.
 
         Called by every run path (run_sync/run_async/run_role) on exit."""
+        # Final flush: with publish_interval=K and num_updates % K != 0
+        # the last <K updates would otherwise never reach the store.
+        if self.train_steps > 0 and self.train_steps % self.publish_interval != 0:
+            self.weights.publish(self.state.params, self.train_steps)
         if self._prefetcher is not None:
             self._prefetcher.close()
         self._profiler.close()

@@ -65,18 +65,18 @@ def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
     if algo == "impala":
         return impala_runner.ImpalaLearner(
             agent, queue, weights, rt.batch_size, logger=logger, rng=rng,
-            prefetch=prefetch, mesh=mesh)
+            prefetch=prefetch, mesh=mesh, publish_interval=rt.publish_interval)
     if algo == "apex":
         return apex_runner.ApexLearner(
             agent, queue, weights, rt.batch_size,
             replay_capacity=rt.replay_capacity,
             target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng,
-            mesh=mesh)
+            mesh=mesh, publish_interval=rt.publish_interval)
     return r2d2_runner.R2D2Learner(
         agent, queue, weights, rt.batch_size,
         replay_capacity=rt.replay_capacity,
         target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng,
-        mesh=mesh)
+        mesh=mesh, publish_interval=rt.publish_interval)
 
 
 def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, weights,

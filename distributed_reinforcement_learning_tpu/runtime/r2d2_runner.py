@@ -130,6 +130,7 @@ class R2D2Learner:
         rng: jax.Array | None = None,
         seed: int = 0,
         mesh=None,
+        publish_interval: int = 1,
     ):
         self.agent = agent
         self.queue = queue
@@ -153,6 +154,10 @@ class R2D2Learner:
             self.state = agent.init_state(rng)
         self.state = agent.sync_target(self.state)
         self._np_rng = np.random.RandomState(seed)
+        # Publish cadence (see ImpalaLearner): the step syncs on the
+        # priority read regardless, so interval>1 saves only the per-step
+        # D2H params copy.
+        self.publish_interval = max(1, publish_interval)
         self.ingested_sequences = 0
         self.train_steps = 0
         self.timer = StageTimer(self.logger)
@@ -227,8 +232,9 @@ class R2D2Learner:
         with self.timer.stage("replay_update"):
             self.replay.update_batch(idxs, np.asarray(priorities))
         self.train_steps += 1
-        with self.timer.stage("publish"):
-            self.weights.publish(self.state.params, self.train_steps)
+        if self.train_steps % self.publish_interval == 0:
+            with self.timer.stage("publish"):
+                self.weights.publish(self.state.params, self.train_steps)
         if self.train_steps % self.target_sync_interval == 0:
             self.state = self.agent.sync_target(self.state)
         metrics = {k: float(v) for k, v in metrics.items()}
@@ -238,6 +244,8 @@ class R2D2Learner:
         return metrics
 
     def close(self) -> None:
+        if self.train_steps > 0 and self.train_steps % self.publish_interval != 0:
+            self.weights.publish(self.state.params, self.train_steps)  # final flush
         self._profiler.close()
 
 

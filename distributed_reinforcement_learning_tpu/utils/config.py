@@ -35,6 +35,7 @@ class RuntimeConfig:
     replay_capacity: int = 100_000
     target_sync_interval: int = 100  # `train_apex.py:151-152`, `train_r2d2.py:163-164`
     train_start_factor: int = 3  # learner trains when queue > factor*batch (`train_impala.py:94`)
+    publish_interval: int = 1  # IMPALA weight-publish cadence (1 = reference parity)
 
 
 def check_config(rt: RuntimeConfig, num_actions: int) -> None:
@@ -62,6 +63,7 @@ def _runtime_from_section(algo: str, d: dict[str, Any]) -> RuntimeConfig:
         replay_capacity=int(d.get("replay_capacity", 1e5)),
         target_sync_interval=d.get("target_sync_interval", 100),
         train_start_factor=d.get("train_start_factor", 3),
+        publish_interval=d.get("publish_interval", 1),
     )
 
 

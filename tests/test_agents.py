@@ -220,3 +220,25 @@ class TestR2D2:
         state_b = agent.init_state(jax.random.PRNGKey(0))
         _, _, m2 = agent.learn(state_b, batch2, jnp.ones((4,)))
         np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+
+
+def test_impala_remat_matches_exact():
+    """jax.checkpoint must change memory, not math: one learn step with
+    remat on/off from identical init produces identical params."""
+    from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_impala_batch
+
+    base = dict(obs_shape=(12, 12, 4), num_actions=3, trajectory=6, lstm_size=32,
+                start_learning_rate=1e-3, learning_frame=10**6)
+    a_plain = ImpalaAgent(ImpalaConfig(**base))
+    a_remat = ImpalaAgent(ImpalaConfig(**base, remat=True))
+    batch = synthetic_impala_batch(4, 6, (12, 12, 4), 3, 32)
+
+    s_plain = a_plain.init_state(jax.random.PRNGKey(3))
+    s_remat = a_remat.init_state(jax.random.PRNGKey(3))
+    s_plain, m_plain = a_plain.learn(s_plain, jax.tree.map(jnp.asarray, batch))
+    s_remat, m_remat = a_remat.learn(s_remat, jax.tree.map(jnp.asarray, batch))
+
+    np.testing.assert_allclose(
+        float(m_plain["total_loss"]), float(m_remat["total_loss"]), rtol=1e-6)
+    for p, r in zip(jax.tree.leaves(s_plain.params), jax.tree.leaves(s_remat.params)):
+        np.testing.assert_allclose(np.asarray(p), np.asarray(r), rtol=2e-5, atol=1e-6)

@@ -126,3 +126,30 @@ def test_r2d2_trains_cartpole_pomdp():
     # @ 400 updates on this host.
     assert late > 60, f"late mean return {late} (early {early})"
     assert late > early
+
+
+def test_impala_publish_interval_still_learns():
+    """publish_interval=4: actors act on weights up to 3 updates stale
+    (V-trace's correction target); learning must survive and versions
+    advance only on publish steps."""
+    cfg = ImpalaConfig(
+        obs_shape=(4,), num_actions=2, trajectory=16, lstm_size=64,
+        discount_factor=0.99, entropy_coef=0.01, baseline_loss_coef=0.5,
+        start_learning_rate=5e-3, end_learning_rate=5e-3,
+        learning_frame=10**9, reward_clipping="abs_one",
+    )
+    agent = ImpalaAgent(cfg)
+    queue = TrajectoryQueue(capacity=64)
+    weights = WeightStore()
+    learner = impala_runner.ImpalaLearner(
+        agent, queue, weights, batch_size=16, rng=jax.random.PRNGKey(0),
+        publish_interval=4)
+    env = VectorCartPole(num_envs=16, seed=0)
+    actor = impala_runner.ImpalaActor(agent, env, queue, weights, seed=1)
+
+    result = impala_runner.run_sync(learner, [actor], num_updates=300)
+
+    assert weights.version == 300  # last step is a publish step (300 % 4 == 0)
+    returns = result["episode_returns"]
+    late = np.mean(returns[-20:])
+    assert late > 60, f"late mean return {late}"
