@@ -1,0 +1,150 @@
+"""Multi-head attention ops: dense reference + blockwise online-softmax.
+
+The reference has no attention at all — its long-context strategy is
+short LSTM unrolls with stored state and burn-in (SURVEY §5.7,
+`/root/reference/model/r2d2_lstm.py:65-112`). This module is the
+TPU-native long-context generalization: a causal multi-head attention
+primitive whose blockwise form (online-softmax accumulation over KV
+blocks, the flash-attention recurrence) is exactly the per-device step
+of ring attention (`parallel/sequence.py`), so the sequence-parallel
+path and the single-device path share one numerics core.
+
+Conventions: `q/k/v` are `[B, T, H, D]` (batch, time, heads, head_dim);
+positions are absolute so sequence-sharded callers can pass global
+offsets for causal masking.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Finite stand-in for -inf in masked logits: big enough that exp(x - m)
+# underflows against any real logit, small enough that subtracting two of
+# them is exact (no nan from inf - inf in the online-softmax rescale).
+_MASK_VALUE = -0.5 * float(jnp.finfo(jnp.float32).max)
+
+
+def _causal_mask(q_pos: jax.Array, k_pos: jax.Array) -> jax.Array:
+    """[Tq, Tk] bool: query at global position i may attend keys <= i."""
+    return q_pos[:, None] >= k_pos[None, :]
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_offset: int | jax.Array = 0,
+    kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Plain softmax(QKᵀ/√d)V — the golden reference the blockwise and
+    ring paths are tested against, and the fast path for short sequences
+    where one fused XLA softmax beats any blocking."""
+    dim = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (dim**-0.5)
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = kv_offset + jnp.arange(k.shape[1])
+        logits = jnp.where(_causal_mask(q_pos, k_pos)[None, None], logits, _MASK_VALUE)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention_block_init(q: jax.Array):
+    """(m, l, o) accumulator for online-softmax over KV blocks.
+
+    m: running row max of logits `[B, H, Tq]` (f32); l: running softmax
+    denominator `[B, H, Tq]` (f32); o: unnormalized numerator
+    `[B, Tq, H, D]` (f32 — accumulating in the compute dtype loses the
+    small-probability tail in bf16).
+    """
+    b, t, h, _ = q.shape
+    m = jnp.full((b, h, t), _MASK_VALUE, jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+    o = jnp.zeros(q.shape, jnp.float32)
+    return m, l, o
+
+
+def attention_block_step(
+    acc,
+    q: jax.Array,
+    k_block: jax.Array,
+    v_block: jax.Array,
+    *,
+    causal: bool,
+    q_pos: jax.Array,
+    k_pos: jax.Array,
+):
+    """Fold one KV block into the accumulator (flash-attention recurrence).
+
+    `q_pos`/`k_pos` are global positions (`[Tq]`, `[Tk]`), so a
+    sequence-sharded caller gets correct causal masking across shards.
+    Masked probabilities are zeroed explicitly (not just pushed to
+    `_MASK_VALUE`) so a fully-masked block contributes exactly nothing.
+    """
+    m, l, o = acc
+    dim = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_block).astype(jnp.float32) * (dim**-0.5)
+    if causal:
+        mask = _causal_mask(q_pos, k_pos)[None, None]
+        s = jnp.where(mask, s, _MASK_VALUE)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    scale = jnp.exp(m - m_new)
+    l_new = l * scale + jnp.sum(p, axis=-1)
+    o_new = o * scale.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v_block.astype(jnp.float32)
+    )
+    return m_new, l_new, o_new
+
+
+def attention_block_finish(acc, dtype) -> jax.Array:
+    """Normalize the accumulator into the attention output `[B, T, H, D]`."""
+    _, l, o = acc
+    denom = jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
+    return (o / denom.transpose(0, 2, 1)[..., None]).astype(dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_size: int = 512,
+) -> jax.Array:
+    """Single-device attention computed block-by-block over keys.
+
+    Memory is O(T·block) instead of O(T²) — the long-context path when a
+    full logits matrix would blow HBM. Same numerics core as ring
+    attention; used as its single-device functional test double.
+    """
+    t_kv = k.shape[1]
+    block_size = min(block_size, t_kv)
+    if t_kv % block_size != 0:
+        raise ValueError(f"kv length {t_kv} not divisible by block {block_size}")
+    n_blocks = t_kv // block_size
+    q_pos = jnp.arange(q.shape[1])
+    kb = k.reshape(k.shape[0], n_blocks, block_size, *k.shape[2:])
+    vb = v.reshape(v.shape[0], n_blocks, block_size, *v.shape[2:])
+
+    def step(acc, blk):
+        k_blk, v_blk, i = blk
+        k_pos = i * block_size + jnp.arange(block_size)
+        return (
+            attention_block_step(
+                acc, q, k_blk, v_blk, causal=causal, q_pos=q_pos, k_pos=k_pos
+            ),
+            None,
+        )
+
+    acc, _ = jax.lax.scan(
+        step,
+        attention_block_init(q),
+        (kb.swapaxes(0, 1), vb.swapaxes(0, 1), jnp.arange(n_blocks)),
+    )
+    return attention_block_finish(acc, q.dtype)

@@ -1,6 +1,7 @@
 from distributed_reinforcement_learning_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    SEQ_AXIS,
     data_sharding,
     make_mesh,
     model_kernel_sharding,
@@ -11,11 +12,16 @@ from distributed_reinforcement_learning_tpu.parallel.learner import (
     ShardedLearner,
     train_state_sharding,
 )
+from distributed_reinforcement_learning_tpu.parallel.sequence import (
+    ring_attention,
+    ulysses_attention,
+)
 from distributed_reinforcement_learning_tpu.parallel import distributed
 
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "SEQ_AXIS",
     "distributed",
     "ShardedLearner",
     "data_sharding",
@@ -23,5 +29,7 @@ __all__ = [
     "model_kernel_sharding",
     "place_local_batch",
     "replicated",
+    "ring_attention",
     "train_state_sharding",
+    "ulysses_attention",
 ]

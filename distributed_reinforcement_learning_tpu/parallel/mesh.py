@@ -12,6 +12,11 @@ is a learner spanning a `jax.sharding.Mesh` of chips:
   head matmuls sharded on their output feature dim, Megatron column style).
   Size 1 by default — the reference-parity configs are small enough that
   DP is the only axis that pays.
+- `seq` axis: optional sequence/context parallelism for long-context
+  attention (`parallel/sequence.py` ring / all-to-all). Size 1 by
+  default; sized >1 it sits between `data` and `model` so neighboring
+  devices carry adjacent sequence shards and the ring's `ppermute`
+  rides nearest ICI links.
 
 Everything here is plain `jax.sharding`; no torch-style process groups.
 """
@@ -23,18 +28,21 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
 
 def make_mesh(
     n_devices: int | None = None,
     model_parallel: int = 1,
+    seq_parallel: int = 1,
     devices: list | None = None,
 ) -> Mesh:
-    """Build a `(data, model)` mesh over the first `n_devices` devices.
+    """Build a `(data, seq, model)` mesh over the first `n_devices` devices.
 
     `model_parallel` chips are adjacent in device order so the model axis
-    rides the fastest ICI links on real TPU topologies.
+    rides the fastest ICI links on real TPU topologies; the `seq` axis is
+    next-innermost for the same reason.
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
@@ -45,10 +53,13 @@ def make_mesh(
             )
         devices = devices[:n_devices]
     n = len(devices)
-    if n % model_parallel != 0:
-        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
-    arr = np.array(devices).reshape(n // model_parallel, model_parallel)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+    inner = model_parallel * seq_parallel
+    if n % inner != 0:
+        raise ValueError(
+            f"{n} devices not divisible by seq_parallel*model_parallel={inner}"
+        )
+    arr = np.array(devices).reshape(n // inner, seq_parallel, model_parallel)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,169 @@
+"""Sequence/context parallelism: ring attention + all-to-all (Ulysses).
+
+The reference caps context at T=20 LSTM unrolls on one device (SURVEY
+§5.7); the TPU-native framework treats long context as a first-class
+parallelism axis. Two standard strategies over a `seq` mesh axis, both
+pure `shard_map` + XLA collectives (no NCCL-style process groups):
+
+- **Ring attention** (`ring_attention`): Q/K/V stay sequence-sharded
+  `[B, T/n, H, D]` per device; KV blocks rotate around the ring with
+  `lax.ppermute` while each device folds them into a flash-attention
+  online-softmax accumulator (`ops/attention.py`). After n-1 rotations
+  every query has seen every key. Peak memory is O(T/n) per device and
+  the ppermute rides neighbor ICI links, overlapping with the block
+  matmuls. Works for any head count.
+
+- **Ulysses all-to-all** (`ulysses_attention`): two `lax.all_to_all`
+  reshards — sequence-sharded -> head-sharded, dense attention on full
+  sequences for H/n local heads, then back. Fewer collective hops than
+  the ring when heads divide the axis; needs H % n == 0.
+
+Both are differentiable (ppermute/all_to_all have transpose rules), so
+the same code path serves training — verified against dense attention,
+values and grads, in tests/test_sequence.py on an 8-virtual-device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_reinforcement_learning_tpu.ops import attention as att
+from distributed_reinforcement_learning_tpu.parallel.mesh import SEQ_AXIS
+
+
+def _ring_shard(q, k, v, *, axis_name: str, causal: bool, varying_axes=()):
+    """Per-device body: local Q against the rotating KV ring."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    q_pos = idx * t_local + jnp.arange(t_local)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, hop):
+        k_blk, v_blk, acc = carry
+        # After `hop` rotations this device holds the block that started
+        # on device (idx - hop) mod n; its global positions follow.
+        src = (idx - hop) % n
+        k_pos = src * t_local + jnp.arange(t_local)
+
+        def attend(acc):
+            return att.attention_block_step(
+                acc, q, k_blk, v_blk, causal=causal, q_pos=q_pos, k_pos=k_pos
+            )
+
+        if causal:
+            # A block strictly in this shard's future is fully masked:
+            # skip its matmuls entirely (lax.cond, predicate uniform per
+            # device). The ring itself stays synchronous — each hop still
+            # waits on some device that does attend — so this trims FLOPs
+            # /energy, not worst-case latency; a balanced (zig-zag /
+            # striped) block placement is the known fix for the latter.
+            acc = jax.lax.cond(src > idx, lambda a: a, attend, acc)
+        else:
+            acc = attend(acc)
+        # Rotate even on the last hop: a static-shape scan body keeps XLA
+        # free to overlap the permute with the next block's matmul, and
+        # the final (unused) hop costs one neighbor copy.
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, acc), None
+
+    # The zero accumulator must be typed as varying over every sharded mesh
+    # axis (the scan writes shard-dependent values into it) — shard_map's
+    # VMA typing rejects an unvarying init against a varying carry.
+    acc0 = jax.tree.map(
+        lambda x: jax.lax.pcast(x, (axis_name, *varying_axes), to="varying"),
+        att.attention_block_init(q),
+    )
+    (_, _, acc), _ = jax.lax.scan(step, (k, v, acc0), jnp.arange(n))
+    return att.attention_block_finish(acc, q.dtype)
+
+
+def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool):
+    """Per-device body: reshard seq->heads, dense attention, reshard back."""
+
+    def seq_to_heads(x):  # [B, T/n, H, D] -> [B, T, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):  # [B, T, H/n, D] -> [B, T/n, H, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    out = att.dense_attention(
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal=causal
+    )
+    return heads_to_seq(out)
+
+
+def _sp_attention(
+    mesh: Mesh,
+    body: Callable,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    batch_axis: str | None,
+) -> jax.Array:
+    spec = P(batch_axis, SEQ_AXIS, None, None)
+    kwargs = dict(axis_name=SEQ_AXIS, causal=causal)
+    if body is _ring_shard and batch_axis is not None:
+        kwargs["varying_axes"] = (batch_axis,)
+    f = jax.shard_map(
+        functools.partial(body, **kwargs),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return f(q, k, v)
+
+
+def ring_attention(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    batch_axis: str | None = None,
+) -> jax.Array:
+    """Causal MHA with Q/K/V sharded over `mesh`'s `seq` axis.
+
+    Global shapes `[B, T, H, D]`; T must divide by the seq-axis size.
+    Optionally also batch-sharded over `batch_axis` (e.g. `data`).
+    """
+    _check(mesh, q, heads_divide=False)
+    return _sp_attention(mesh, _ring_shard, q, k, v, causal=causal, batch_axis=batch_axis)
+
+
+def ulysses_attention(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    batch_axis: str | None = None,
+) -> jax.Array:
+    """All-to-all sequence parallelism; needs heads % seq-axis == 0."""
+    _check(mesh, q, heads_divide=True)
+    return _sp_attention(
+        mesh, _ulysses_shard, q, k, v, causal=causal, batch_axis=batch_axis
+    )
+
+
+def _check(mesh: Mesh, q: jax.Array, *, heads_divide: bool) -> None:
+    n = mesh.shape.get(SEQ_AXIS)
+    if n is None:
+        raise ValueError(f"mesh {dict(mesh.shape)} has no '{SEQ_AXIS}' axis")
+    if q.shape[1] % n != 0:
+        raise ValueError(f"sequence length {q.shape[1]} not divisible by seq axis {n}")
+    if heads_divide and q.shape[2] % n != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by seq axis ({n}); "
+            "use ring_attention otherwise"
+        )

@@ -23,6 +23,7 @@ import jax
 from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexBatch
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
 from distributed_reinforcement_learning_tpu.data.replay import UniformBuffer, make_replay
+from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
 from distributed_reinforcement_learning_tpu.utils.profiling import ProfilerSession, StageTimer
@@ -133,7 +134,7 @@ class ApexActor:
         return num_steps * self._obs.shape[0]
 
 
-class ApexLearner:
+class ApexLearner(PublishCadenceMixin):
     def __init__(
         self,
         agent: ApexAgent,

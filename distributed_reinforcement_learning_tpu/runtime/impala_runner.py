@@ -25,6 +25,7 @@ import numpy as np
 from distributed_reinforcement_learning_tpu.agents.impala import ActOutput, ImpalaAgent, ImpalaConfig
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
 from distributed_reinforcement_learning_tpu.data.structures import ImpalaTrajectoryAccumulator
+from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
 from distributed_reinforcement_learning_tpu.utils.profiling import ProfilerSession, StageTimer
@@ -130,7 +131,7 @@ class ImpalaActor:
         return n * cfg.trajectory
 
 
-class ImpalaLearner:
+class ImpalaLearner(PublishCadenceMixin):
     def __init__(
         self,
         agent: ImpalaAgent,
@@ -224,12 +225,10 @@ class ImpalaLearner:
             self.state, metrics = self._learn(self.state, batch)
         self.train_steps += 1
         self.frames_learned += self.batch_size * self.agent.cfg.trajectory
-        if self.train_steps % self.publish_interval == 0:
-            # publish's host snapshot (np.asarray) is this step's device
-            # sync, so "learn" above measures dispatch and "publish"
-            # compute+D2H; metric conversion after it is free.
-            with self.timer.stage("publish"):
-                self.weights.publish(self.state.params, self.train_steps)
+        if self.maybe_publish():
+            # The publish was this step's device sync, so "learn" above
+            # measured dispatch, "publish" compute+D2H; the float()
+            # conversion after it is free.
             metrics = {k: float(v) for k, v in metrics.items()}
             self.logger.add_scalars(
                 {f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
@@ -246,10 +245,7 @@ class ImpalaLearner:
         """Stop the prefetch thread and flush any open profiler trace.
 
         Called by every run path (run_sync/run_async/run_role) on exit."""
-        # Final flush: with publish_interval=K and num_updates % K != 0
-        # the last <K updates would otherwise never reach the store.
-        if self.train_steps > 0 and self.train_steps % self.publish_interval != 0:
-            self.weights.publish(self.state.params, self.train_steps)
+        self.flush_publish()
         if self._prefetcher is not None:
             self._prefetcher.close()
         self._profiler.close()
@@ -286,6 +282,9 @@ def run_sync(
     finally:
         learner.close()
     returns = [r for a in actors for r in a.episode_returns]
+    # On a non-publish step `metrics` holds device arrays (the interval's
+    # pipelining contract); the public result is always host floats.
+    metrics = {k: float(v) for k, v in metrics.items()}
     return {"frames": frames, "last_metrics": metrics, "episode_returns": returns}
 
 

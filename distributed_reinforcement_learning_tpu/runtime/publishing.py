@@ -1,0 +1,31 @@
+"""Publish-cadence gating shared by the three learners.
+
+One place for the every-K-steps weight-publication semantics (the
+`publish_interval` throughput knob) and its close()-time flush, so the
+three runner classes cannot drift apart on them. Mixin contract: the
+host class provides `weights`, `state`, `train_steps`,
+`publish_interval`, and `timer`.
+"""
+
+from __future__ import annotations
+
+
+class PublishCadenceMixin:
+    def maybe_publish(self) -> bool:
+        """Publish every `publish_interval`-th train step.
+
+        The publish's host snapshot (np.asarray) is the step's device
+        sync, so with K>1 the intervening learn steps pipeline on-device
+        with no host sync between them. Returns True when it published.
+        """
+        if self.train_steps % self.publish_interval != 0:
+            return False
+        with self.timer.stage("publish"):
+            self.weights.publish(self.state.params, self.train_steps)
+        return True
+
+    def flush_publish(self) -> None:
+        """close()-time flush: with interval K and total steps % K != 0
+        the last <K updates would otherwise never reach the store."""
+        if self.train_steps > 0 and self.train_steps % self.publish_interval != 0:
+            self.weights.publish(self.state.params, self.train_steps)

@@ -22,6 +22,7 @@ from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Ba
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
 from distributed_reinforcement_learning_tpu.data.replay import make_replay
 from distributed_reinforcement_learning_tpu.data.structures import R2D2SequenceAccumulator
+from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
 from distributed_reinforcement_learning_tpu.utils.profiling import ProfilerSession, StageTimer
@@ -117,7 +118,7 @@ class R2D2Actor:
         return n * cfg.seq_len
 
 
-class R2D2Learner:
+class R2D2Learner(PublishCadenceMixin):
     def __init__(
         self,
         agent: R2D2Agent,
