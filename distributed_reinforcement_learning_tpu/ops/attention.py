@@ -30,6 +30,23 @@ def _causal_mask(q_pos: jax.Array, k_pos: jax.Array) -> jax.Array:
     return q_pos[:, None] >= k_pos[None, :]
 
 
+def _combined_mask(causal, q_pos, k_pos, q_seg, k_seg):
+    """[B|1, 1, Tq, Tk] bool mask, or None when nothing constrains.
+
+    Segment ids (per batch row, e.g. episode indices from cumsum(done))
+    confine attention within an episode: RL sequences cross episode
+    boundaries mid-unroll, and a transformer must not attend across a
+    reset the way the recurrent nets zero their (h, c) carries.
+    """
+    mask = None
+    if causal:
+        mask = _causal_mask(q_pos, k_pos)[None, None]
+    if q_seg is not None:
+        seg = (q_seg[:, None, :, None] == k_seg[:, None, None, :])
+        mask = seg if mask is None else (mask & seg)
+    return mask
+
+
 def dense_attention(
     q: jax.Array,
     k: jax.Array,
@@ -38,18 +55,25 @@ def dense_attention(
     causal: bool = True,
     q_offset: int | jax.Array = 0,
     kv_offset: int | jax.Array = 0,
+    q_seg: jax.Array | None = None,
+    k_seg: jax.Array | None = None,
 ) -> jax.Array:
     """Plain softmax(QKᵀ/√d)V — the golden reference the blockwise and
     ring paths are tested against, and the fast path for short sequences
     where one fused XLA softmax beats any blocking."""
     dim = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (dim**-0.5)
-    if causal:
-        q_pos = q_offset + jnp.arange(q.shape[1])
-        k_pos = kv_offset + jnp.arange(k.shape[1])
-        logits = jnp.where(_causal_mask(q_pos, k_pos)[None, None], logits, _MASK_VALUE)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    q_pos = q_offset + jnp.arange(q.shape[1])
+    k_pos = kv_offset + jnp.arange(k.shape[1])
+    mask = _combined_mask(causal, q_pos, k_pos, q_seg, k_seg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, _MASK_VALUE)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if mask is not None:
+        # A fully-masked row (no same-segment key) must output zeros, not
+        # a uniform average of _MASK_VALUE logits.
+        probs = jnp.where(mask, probs, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
 def attention_block_init(q: jax.Array):
@@ -76,23 +100,27 @@ def attention_block_step(
     causal: bool,
     q_pos: jax.Array,
     k_pos: jax.Array,
+    q_seg: jax.Array | None = None,
+    k_seg: jax.Array | None = None,
 ):
     """Fold one KV block into the accumulator (flash-attention recurrence).
 
     `q_pos`/`k_pos` are global positions (`[Tq]`, `[Tk]`), so a
-    sequence-sharded caller gets correct causal masking across shards.
-    Masked probabilities are zeroed explicitly (not just pushed to
-    `_MASK_VALUE`) so a fully-masked block contributes exactly nothing.
+    sequence-sharded caller gets correct causal masking across shards;
+    `q_seg`/`k_seg` (`[B, Tq]`, `[B, Tk]`) optionally confine attention
+    within episode segments. Masked probabilities are zeroed explicitly
+    (not just pushed to `_MASK_VALUE`) so a fully-masked block
+    contributes exactly nothing.
     """
     m, l, o = acc
     dim = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k_block).astype(jnp.float32) * (dim**-0.5)
-    if causal:
-        mask = _causal_mask(q_pos, k_pos)[None, None]
+    mask = _combined_mask(causal, q_pos, k_pos, q_seg, k_seg)
+    if mask is not None:
         s = jnp.where(mask, s, _MASK_VALUE)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     p = jnp.exp(s - m_new[..., None])
-    if causal:
+    if mask is not None:
         p = jnp.where(mask, p, 0.0)
     scale = jnp.exp(m - m_new)
     l_new = l * scale + jnp.sum(p, axis=-1)
@@ -116,12 +144,14 @@ def blockwise_attention(
     *,
     causal: bool = True,
     block_size: int = 512,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Single-device attention computed block-by-block over keys.
 
     Memory is O(T·block) instead of O(T²) — the long-context path when a
     full logits matrix would blow HBM. Same numerics core as ring
     attention; used as its single-device functional test double.
+    `segment_ids` `[B, T]` optionally confines attention within episodes.
     """
     t_kv = k.shape[1]
     block_size = min(block_size, t_kv)
@@ -131,20 +161,28 @@ def blockwise_attention(
     q_pos = jnp.arange(q.shape[1])
     kb = k.reshape(k.shape[0], n_blocks, block_size, *k.shape[2:])
     vb = v.reshape(v.shape[0], n_blocks, block_size, *v.shape[2:])
+    segb = (
+        None
+        if segment_ids is None
+        else segment_ids.reshape(segment_ids.shape[0], n_blocks, block_size)
+    )
 
     def step(acc, blk):
-        k_blk, v_blk, i = blk
+        k_blk, v_blk, seg_blk, i = blk
         k_pos = i * block_size + jnp.arange(block_size)
         return (
             attention_block_step(
-                acc, q, k_blk, v_blk, causal=causal, q_pos=q_pos, k_pos=k_pos
+                acc, q, k_blk, v_blk, causal=causal, q_pos=q_pos, k_pos=k_pos,
+                q_seg=segment_ids, k_seg=seg_blk,
             ),
             None,
         )
 
-    acc, _ = jax.lax.scan(
-        step,
-        attention_block_init(q),
-        (kb.swapaxes(0, 1), vb.swapaxes(0, 1), jnp.arange(n_blocks)),
+    xs = (
+        kb.swapaxes(0, 1),
+        vb.swapaxes(0, 1),
+        None if segb is None else segb.swapaxes(0, 1),
+        jnp.arange(n_blocks),
     )
+    acc, _ = jax.lax.scan(step, attention_block_init(q), xs)
     return attention_block_finish(acc, q.dtype)

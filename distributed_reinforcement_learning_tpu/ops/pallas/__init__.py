@@ -26,9 +26,32 @@ import os
 import jax
 
 
-def pick_block(b: int, block: int) -> int:
-    """Batch-tile size for a 1-D grid over B: tile by `block` when it
-    divides B, otherwise one program owns the whole (padded) batch."""
+# Per-kernel scoped VMEM is 16MB on current TPUs; leave slack for the
+# compiler's own scratch and the replicated (non-tiled) operands.
+_VMEM_BUDGET = 11 << 20
+
+
+def pick_block(
+    b: int, block: int, per_row_bytes: int = 0, fixed_bytes: int = 0
+) -> int:
+    """Batch-tile size for a 1-D grid over B.
+
+    Tile by `block` when it divides B, otherwise one program owns the
+    whole (padded) batch. When `per_row_bytes` (total bytes of all tiled
+    refs per batch row) is given, the tile is instead the largest DIVISOR
+    of B, at most `block`, whose VMEM footprint — double-buffered tiles +
+    `fixed_bytes` of replicated operands — fits the scoped budget, so big
+    [T, B, 4H] workloads don't hit the 16MB scoped-vmem stack limit (seen
+    at B=256, T=20, H=256) even when B is not a power of two (a
+    whole-batch fallback here would reintroduce exactly that failure).
+    """
+    if per_row_bytes:
+        n = min(block, b)
+        while n > 1 and (
+            b % n != 0 or fixed_bytes + 2 * n * per_row_bytes > _VMEM_BUDGET
+        ):
+            n -= 1
+        return n
     return b if b < block or b % block != 0 else block
 
 

@@ -72,22 +72,31 @@ def from_importance_weights(
     with `parallel_iterations=1, back_prop=False`; here XLA compiles the
     whole thing and `stop_gradient` replaces `back_prop=False`).
 
-    A fused Pallas kernel exists (`ops/pallas/vtrace.py`, opt in with
-    `backend="pallas"`), but measured on TPU v5e at IMPALA shapes
-    (T=20, B=256) it is ~6% slower than this scan (280us vs 263us per
-    call in-graph): the recursion is bandwidth-trivial, so the pallas
-    launch overhead outweighs the fusion win — unlike the LSTM kernel
-    (`ops/pallas/lstm.py`, 2.2x faster), which carries MXU matmuls per
-    step. `backend="auto"` therefore resolves to the scan here.
+    `backend="auto"` resolves to the fused Pallas kernel on TPU
+    (`ops/pallas/vtrace.py`): measured on v5e at IMPALA shapes (T=20,
+    B=256) with an on-device timing loop — the only methodology that
+    survives the remote-tunnel dispatch noise, see bench.py
+    `bench_kernels` — the kernel runs the whole reverse recursion in one
+    VMEM-resident launch at ~2.4us/call vs ~9.2us for this lax.scan
+    (whose T=20 while-loop iterations each round-trip their carries
+    through HBM). Artifact: BENCH_r02 `kernel_compare`. Round 1's
+    opposite conclusion (280us vs 263us, kernel disabled by default) came
+    from host-side per-dispatch timing, which the tunnel makes
+    meaningless.
     """
     from distributed_reinforcement_learning_tpu.ops.pallas import resolve_backend
 
-    resolved = "reference" if backend == "auto" else resolve_backend(backend)
+    resolved = resolve_backend(backend)
     if resolved != "reference":
         from distributed_reinforcement_learning_tpu.ops.pallas.vtrace import vtrace_pallas
 
+        # The whole V-trace target is stop-gradded (the reference's
+        # `back_prop=False`), so cut the tape at the kernel's INPUTS too:
+        # pallas_call has no jvp rule, and linearization would otherwise
+        # fail inside value_and_grad even though no cotangent ever flows.
+        sg = jax.lax.stop_gradient
         vs, clipped = vtrace_pallas(
-            log_rhos, discounts, rewards, values, bootstrap_value,
+            sg(log_rhos), sg(discounts), sg(rewards), sg(values), sg(bootstrap_value),
             clip_rho_threshold=clip_rho_threshold,
             clip_c_threshold=clip_c_threshold,
             interpret=(resolved == "pallas_interpret"),

@@ -18,8 +18,10 @@ pure `shard_map` + XLA collectives (no NCCL-style process groups):
   sequences for H/n local heads, then back. Fewer collective hops than
   the ring when heads divide the axis; needs H % n == 0.
 
-Both are differentiable (ppermute/all_to_all have transpose rules), so
-the same code path serves training — verified against dense attention,
+Both support per-row episode segment ids (attention confined within an
+episode, the transformer counterpart of done-masked (h, c) resets) and
+are differentiable (ppermute/all_to_all have transpose rules), so the
+same code path serves training — verified against dense attention,
 values and grads, in tests/test_sequence.py on an 8-virtual-device mesh.
 """
 
@@ -36,8 +38,12 @@ from distributed_reinforcement_learning_tpu.ops import attention as att
 from distributed_reinforcement_learning_tpu.parallel.mesh import SEQ_AXIS
 
 
-def _ring_shard(q, k, v, *, axis_name: str, causal: bool, varying_axes=()):
-    """Per-device body: local Q against the rotating KV ring."""
+def _ring_shard(q, k, v, seg, *, axis_name: str, causal: bool, varying_axes=()):
+    """Per-device body: local Q against the rotating KV ring.
+
+    `seg` is the per-shard segment-id slice `[B, T/n]` or None; it
+    rotates around the ring alongside its KV block.
+    """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
@@ -45,7 +51,7 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool, varying_axes=()):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, hop):
-        k_blk, v_blk, acc = carry
+        k_blk, v_blk, k_seg, acc = carry
         # After `hop` rotations this device holds the block that started
         # on device (idx - hop) mod n; its global positions follow.
         src = (idx - hop) % n
@@ -53,7 +59,8 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool, varying_axes=()):
 
         def attend(acc):
             return att.attention_block_step(
-                acc, q, k_blk, v_blk, causal=causal, q_pos=q_pos, k_pos=k_pos
+                acc, q, k_blk, v_blk, causal=causal, q_pos=q_pos, k_pos=k_pos,
+                q_seg=seg, k_seg=k_seg,
             )
 
         if causal:
@@ -69,9 +76,10 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool, varying_axes=()):
         # Rotate even on the last hop: a static-shape scan body keeps XLA
         # free to overlap the permute with the next block's matmul, and
         # the final (unused) hop costs one neighbor copy.
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (k_blk, v_blk, acc), None
+        rotate = lambda x: jax.lax.ppermute(x, axis_name, perm)
+        k_blk, v_blk = rotate(k_blk), rotate(v_blk)
+        k_seg = None if k_seg is None else rotate(k_seg)
+        return (k_blk, v_blk, k_seg, acc), None
 
     # The zero accumulator must be typed as varying over every sharded mesh
     # axis (the scan writes shard-dependent values into it) — shard_map's
@@ -80,11 +88,11 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool, varying_axes=()):
         lambda x: jax.lax.pcast(x, (axis_name, *varying_axes), to="varying"),
         att.attention_block_init(q),
     )
-    (_, _, acc), _ = jax.lax.scan(step, (k, v, acc0), jnp.arange(n))
+    (_, _, _, acc), _ = jax.lax.scan(step, (k, v, seg, acc0), jnp.arange(n))
     return att.attention_block_finish(acc, q.dtype)
 
 
-def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool):
+def _ulysses_shard(q, k, v, seg, *, axis_name: str, causal: bool):
     """Per-device body: reshard seq->heads, dense attention, reshard back."""
 
     def seq_to_heads(x):  # [B, T/n, H, D] -> [B, T, H/n, D]
@@ -93,8 +101,13 @@ def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool):
     def heads_to_seq(x):  # [B, T, H/n, D] -> [B, T/n, H, D]
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
+    if seg is not None:
+        # Segments have no head axis to scatter; every device needs the
+        # full-length ids for its full-sequence local heads.
+        seg = jax.lax.all_gather(seg, axis_name, axis=1, tiled=True)
     out = att.dense_attention(
-        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal=causal
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal=causal,
+        q_seg=seg, k_seg=seg,
     )
     return heads_to_seq(out)
 
@@ -105,21 +118,23 @@ def _sp_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    segment_ids: jax.Array | None,
     *,
     causal: bool,
     batch_axis: str | None,
 ) -> jax.Array:
     spec = P(batch_axis, SEQ_AXIS, None, None)
+    seg_spec = P(batch_axis, SEQ_AXIS)
     kwargs = dict(axis_name=SEQ_AXIS, causal=causal)
     if body is _ring_shard and batch_axis is not None:
         kwargs["varying_axes"] = (batch_axis,)
     f = jax.shard_map(
         functools.partial(body, **kwargs),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, None if segment_ids is None else seg_spec),
         out_specs=spec,
     )
-    return f(q, k, v)
+    return f(q, k, v, segment_ids)
 
 
 def ring_attention(
@@ -130,14 +145,18 @@ def ring_attention(
     *,
     causal: bool = True,
     batch_axis: str | None = None,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Causal MHA with Q/K/V sharded over `mesh`'s `seq` axis.
 
     Global shapes `[B, T, H, D]`; T must divide by the seq-axis size.
-    Optionally also batch-sharded over `batch_axis` (e.g. `data`).
+    Optionally also batch-sharded over `batch_axis` (e.g. `data`), and
+    episode-confined via `segment_ids` `[B, T]`.
     """
     _check(mesh, q, heads_divide=False)
-    return _sp_attention(mesh, _ring_shard, q, k, v, causal=causal, batch_axis=batch_axis)
+    return _sp_attention(
+        mesh, _ring_shard, q, k, v, segment_ids, causal=causal, batch_axis=batch_axis
+    )
 
 
 def ulysses_attention(
@@ -148,11 +167,12 @@ def ulysses_attention(
     *,
     causal: bool = True,
     batch_axis: str | None = None,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """All-to-all sequence parallelism; needs heads % seq-axis == 0."""
     _check(mesh, q, heads_divide=True)
     return _sp_attention(
-        mesh, _ulysses_shard, q, k, v, causal=causal, batch_axis=batch_axis
+        mesh, _ulysses_shard, q, k, v, segment_ids, causal=causal, batch_axis=batch_axis
     )
 
 

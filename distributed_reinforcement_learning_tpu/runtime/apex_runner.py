@@ -251,9 +251,7 @@ class ApexLearner(PublishCadenceMixin):
         with self.timer.stage("replay_update"):
             self.replay.update_batch(idxs, np.asarray(td))
         self.train_steps += 1
-        if self.train_steps % self.publish_interval == 0:
-            with self.timer.stage("publish"):
-                self.weights.publish(self.state.params, self.train_steps)
+        self.maybe_publish()
         if self.train_steps % self.target_sync_interval == 0:
             self.state = self.agent.sync_target(self.state)
         metrics = {k: float(v) for k, v in metrics.items()}
@@ -263,8 +261,7 @@ class ApexLearner(PublishCadenceMixin):
         return metrics
 
     def close(self) -> None:
-        if self.train_steps > 0 and self.train_steps % self.publish_interval != 0:
-            self.weights.publish(self.state.params, self.train_steps)  # final flush
+        self.flush_publish()
         self._profiler.close()
 
 

@@ -233,9 +233,7 @@ class R2D2Learner(PublishCadenceMixin):
         with self.timer.stage("replay_update"):
             self.replay.update_batch(idxs, np.asarray(priorities))
         self.train_steps += 1
-        if self.train_steps % self.publish_interval == 0:
-            with self.timer.stage("publish"):
-                self.weights.publish(self.state.params, self.train_steps)
+        self.maybe_publish()
         if self.train_steps % self.target_sync_interval == 0:
             self.state = self.agent.sync_target(self.state)
         metrics = {k: float(v) for k, v in metrics.items()}
@@ -245,8 +243,7 @@ class R2D2Learner(PublishCadenceMixin):
         return metrics
 
     def close(self) -> None:
-        if self.train_steps > 0 and self.train_steps % self.publish_interval != 0:
-            self.weights.publish(self.state.params, self.train_steps)  # final flush
+        self.flush_publish()
         self._profiler.close()
 
 
