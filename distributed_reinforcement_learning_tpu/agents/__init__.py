@@ -4,6 +4,7 @@ from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexBa
 from distributed_reinforcement_learning_tpu.agents.common import TargetTrainState, TrainState
 from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaBatch, ImpalaConfig
 from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Batch, R2D2Config
+from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent, XformerBatch, XformerConfig
 
 __all__ = [
     "ApexAgent",
@@ -16,5 +17,8 @@ __all__ = [
     "R2D2Batch",
     "R2D2Config",
     "TrainState",
+    "XformerAgent",
+    "XformerBatch",
+    "XformerConfig",
     "TargetTrainState",
 ]

@@ -153,3 +153,60 @@ def epsilon_greedy(
     explore = jax.random.uniform(key_e, greedy.shape) <= epsilon
     random_action = jax.random.randint(key_a, greedy.shape, 0, num_actions)
     return jnp.where(explore, random_action, greedy)
+
+
+def sequence_double_q_td(main_q, target_q, action, reward, discounts,
+                         *, burn_in: int, rescale_eps: float):
+    """Shared R2D2-family target math (`agent/r2d2.py:64-87`).
+
+    Burn-in slice, (t, t+1) alignment, double-Q action selection on the
+    main net, value-function rescaling on the bootstrapped target.
+    Inputs are full-sequence `[B, T, ...]`; returns (target_value, sav)
+    over the supervised positions. One implementation serves both the
+    LSTM and the transformer agents so the replay semantics cannot drift.
+    """
+    from distributed_reinforcement_learning_tpu.ops import dqn, value_rescale
+
+    b = burn_in
+    main_b, target_b = main_q[:, b:], target_q[:, b:]
+    reward_b, disc_b, action_b = reward[:, b:], discounts[:, b:], action[:, b:]
+
+    sav = dqn.take_state_action_value(main_b[:, :-1], action_b[:, :-1])
+    next_action = jnp.argmax(main_b[:, 1:], axis=-1)
+    next_sav = dqn.take_state_action_value(target_b[:, 1:], next_action)
+
+    descaled = value_rescale.inverse_value_rescale(next_sav, rescale_eps)
+    raw_target = jax.lax.stop_gradient(descaled * disc_b[:, :-1] + reward_b[:, :-1])
+    target_value = value_rescale.value_rescale(raw_target, rescale_eps)
+    return target_value, sav
+
+
+class SequenceReplayLearnMixin:
+    """td_error/loss/learn shared by the sequence-replay agents.
+
+    Host class provides `_sequence_td(params, target_params, batch)`
+    -> (target_value, sav) and `self.tx`. Loss = IS-weighted mean over
+    time of squared TD (`agent/r2d2.py:88-89`); priority = |mean TD| per
+    sequence (`agent/r2d2.py:151-153`).
+    """
+
+    def _td_error(self, state, batch):
+        tv, sav = self._sequence_td(state.params, state.target_params, batch)
+        return jnp.abs(jnp.mean(tv - sav, axis=1))
+
+    def _loss(self, params, target_params, batch, is_weight):
+        tv, sav = self._sequence_td(params, target_params, batch)
+        per_seq = jnp.mean(jnp.square(tv - sav), axis=1)
+        loss = jnp.mean(per_seq * is_weight)
+        priorities = jnp.abs(jnp.mean(tv - sav, axis=1))
+        return loss, priorities
+
+    def _learn(self, state, batch, is_weight):
+        (loss, priorities), grads = jax.value_and_grad(self._loss, has_aux=True)(
+            state.params, state.target_params, batch, is_weight
+        )
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        new_state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
+        metrics = {"loss": loss, "grad_norm": global_norm(grads)}
+        return new_state, priorities, metrics

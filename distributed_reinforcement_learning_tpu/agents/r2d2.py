@@ -26,7 +26,6 @@ import jax.numpy as jnp
 
 from distributed_reinforcement_learning_tpu.agents import common
 from distributed_reinforcement_learning_tpu.models.r2d2_net import R2D2Net
-from distributed_reinforcement_learning_tpu.ops import dqn, value_rescale
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,7 +55,7 @@ class R2D2Batch(NamedTuple):
     initial_c: jax.Array  # [B, H]
 
 
-class R2D2Agent:
+class R2D2Agent(common.SequenceReplayLearnMixin):
     def __init__(self, cfg: R2D2Config):
         self.cfg = cfg
         self.model = R2D2Net(num_actions=cfg.num_actions, lstm_size=cfg.lstm_size, dtype=cfg.dtype)
@@ -85,56 +84,16 @@ class R2D2Agent:
         return action, q, new_h, new_c
 
     # -- shared sequence target math -------------------------------------
+    # _td_error/_loss/_learn come from SequenceReplayLearnMixin; this
+    # supplies the model forward. Burn-in, double-Q, and rescaling live
+    # in `common.sequence_double_q_td` (`agent/r2d2.py:64-87`).
     def _sequence_td(self, params, target_params, batch: R2D2Batch):
         cfg = self.cfg
         obs = common.normalize_obs(batch.state)
         unroll = lambda p: self.model.apply(
             p, obs, batch.previous_action, batch.done, batch.initial_h, batch.initial_c,
             method=self.model.unroll)
-        main_q = unroll(params)
-        target_q = unroll(target_params)
-
         discounts = (~batch.done).astype(jnp.float32) * cfg.discount_factor
-
-        # Burn-in slice, then (t, t+1) alignment (`agent/r2d2.py:64-82`).
-        b = cfg.burn_in
-        main_b, target_b = main_q[:, b:], target_q[:, b:]
-        reward_b, disc_b, action_b = batch.reward[:, b:], discounts[:, b:], batch.action[:, b:]
-
-        state_q = main_b[:, :-1]
-        next_main = main_b[:, 1:]
-        next_target = target_b[:, 1:]
-        action = action_b[:, :-1]
-
-        sav = dqn.take_state_action_value(state_q, action)
-        next_action = jnp.argmax(next_main, axis=-1)
-        next_sav = dqn.take_state_action_value(next_target, next_action)
-
-        # Rescaled double-Q target (`agent/r2d2.py:83-87`).
-        descaled = value_rescale.inverse_value_rescale(next_sav, cfg.rescale_eps)
-        raw_target = jax.lax.stop_gradient(descaled * disc_b[:, :-1] + reward_b[:, :-1])
-        target_value = value_rescale.value_rescale(raw_target, cfg.rescale_eps)
-        return target_value, sav
-
-    def _td_error(self, state: common.TargetTrainState, batch: R2D2Batch):
-        """Per-sequence priority |mean_t TD| (`agent/r2d2.py:151-153`)."""
-        tv, sav = self._sequence_td(state.params, state.target_params, batch)
-        return jnp.abs(jnp.mean(tv - sav, axis=1))
-
-    # -- learn -----------------------------------------------------------
-    def _loss(self, params, target_params, batch: R2D2Batch, is_weight):
-        tv, sav = self._sequence_td(params, target_params, batch)
-        per_seq = jnp.mean(jnp.square(tv - sav), axis=1)
-        loss = jnp.mean(per_seq * is_weight)
-        priorities = jnp.abs(jnp.mean(tv - sav, axis=1))
-        return loss, priorities
-
-    def _learn(self, state: common.TargetTrainState, batch: R2D2Batch, is_weight):
-        (loss, priorities), grads = jax.value_and_grad(self._loss, has_aux=True)(
-            state.params, state.target_params, batch, is_weight
-        )
-        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
-        params = jax.tree.map(lambda p, u: p + u, state.params, updates)
-        new_state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
-        metrics = {"loss": loss, "grad_norm": common.global_norm(grads)}
-        return new_state, priorities, metrics
+        return common.sequence_double_q_td(
+            unroll(params), unroll(target_params), batch.action, batch.reward,
+            discounts, burn_in=cfg.burn_in, rescale_eps=cfg.rescale_eps)

@@ -1,0 +1,153 @@
+"""Transformer-R2D2 agent: attention-based recurrent replay.
+
+Fourth algorithm family, extending the reference's three: R2D2's
+distributed prioritized sequence replay (`/root/reference/agent/r2d2.py`,
+`train_r2d2.py`) with the LSTM swapped for the causal transformer of
+`models/transformer_net.py`. All replay-side semantics are kept
+identical to the in-tree R2D2 agent so the two are drop-in alternates
+behind the same runners/queues:
+
+- burn-in: first `burn_in` steps sliced out of the loss, not the forward
+  (`agent/r2d2.py:64-68`) — for a transformer they serve as attention
+  context exactly as they warm the LSTM state;
+- double-Q over sequences + value rescaling on the target
+  (`agent/r2d2.py:70-87`); loss = IS-weighted mean over time of squared
+  TD; priority = |mean TD| (`agent/r2d2.py:151-153`); plain Adam.
+
+What replaces the stored (h, c): nothing needs storing — the sequence
+IS the state. Acting runs the same forward over a rolling window of the
+last `seq_len` steps (the actor keeps the window host-side); training
+attends over the stored sequence with episode-segment masking standing
+in for done-masked carry resets.
+
+Long context is where this family pays: `seq_len` is a knob, and with
+`attention="ring"|"ulysses"` + a mesh whose `seq` axis > 1 the learn
+step shards the sequence dimension over devices
+(`parallel/sequence.py`), which no recurrent model can do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.agents import common
+from distributed_reinforcement_learning_tpu.models.transformer_net import TransformerQNet
+
+
+@dataclasses.dataclass(frozen=True)
+class XformerConfig:
+    """R2D2 replay hyperparameters + transformer size knobs."""
+
+    obs_shape: tuple[int, ...] = (2,)
+    num_actions: int = 2
+    seq_len: int = 10
+    burn_in: int = 5
+    d_model: int = 256
+    num_heads: int = 4
+    num_layers: int = 2
+    discount_factor: float = 0.997
+    learning_rate: float = 1e-4
+    rescale_eps: float = 1e-3
+    dtype: Any = jnp.float32
+    # "dense" on one device; "ring"/"ulysses" shard the sequence over the
+    # mesh's `seq` axis (pass the mesh at construction).
+    attention: str = "dense"
+
+
+class XformerBatch(NamedTuple):
+    """Sequence batch — the R2D2 queue payload minus the stored (h, c)."""
+
+    state: jax.Array  # [B, T, *obs]
+    previous_action: jax.Array  # [B, T] i32
+    action: jax.Array  # [B, T] i32
+    reward: jax.Array  # [B, T] f32
+    done: jax.Array  # [B, T] bool
+
+
+class XformerAgent(common.SequenceReplayLearnMixin):
+    def __init__(self, cfg: XformerConfig, mesh=None):
+        self.cfg = cfg
+        self._mesh = mesh
+        attention_fn = None
+        if cfg.attention != "dense":
+            if mesh is None:
+                raise ValueError(f"attention={cfg.attention!r} needs a mesh")
+            from distributed_reinforcement_learning_tpu.parallel import sequence as sp
+            from distributed_reinforcement_learning_tpu.parallel.mesh import DATA_AXIS
+
+            fn = {"ring": sp.ring_attention, "ulysses": sp.ulysses_attention}[cfg.attention]
+            attention_fn = functools.partial(
+                lambda f, q, k, v, segs: f(
+                    mesh, q, k, v, causal=True, batch_axis=DATA_AXIS, segment_ids=segs
+                ),
+                fn,
+            )
+        make_model = lambda fn: TransformerQNet(
+            num_actions=cfg.num_actions,
+            d_model=cfg.d_model,
+            num_heads=cfg.num_heads,
+            num_layers=cfg.num_layers,
+            max_len=max(cfg.seq_len, 16),
+            dtype=cfg.dtype,
+            attention_fn=fn,
+        )
+        self.model = make_model(attention_fn)
+        # Dense twin over the SAME params: ingest-time priority scoring
+        # runs on whatever ragged batch the queue drained, which need not
+        # divide the mesh's data axis the way fixed-size learn batches do.
+        self._dense_model = make_model(None) if attention_fn is not None else self.model
+        self.tx = common.adam_with_clip(cfg.learning_rate, clip_norm=None)
+        self.act = jax.jit(self._act)
+        self.td_error = jax.jit(self._td_error)
+        self.learn = jax.jit(self._learn, donate_argnums=(0,))
+        self.sync_target = jax.jit(lambda s: s.sync_target())
+
+    def init_state(self, rng: jax.Array) -> common.TargetTrainState:
+        t = self.cfg.seq_len
+        # With sequence-parallel attention the init forward runs through
+        # shard_map too, so the dummy batch must cover the data axis.
+        b = 1 if self._mesh is None else self._mesh.shape.get("data", 1)
+        obs = jnp.zeros((b, t, *self.cfg.obs_shape), jnp.float32)
+        pa = jnp.zeros((b, t), jnp.int32)
+        done = jnp.zeros((b, t), bool)
+        params = self.model.init(rng, obs, pa, done)
+        return common.TargetTrainState.create(params, self.tx)
+
+    # -- act ---------------------------------------------------------------
+    def _act(self, params, obs_win, prev_action_win, done_win, epsilon, rng):
+        """Batched epsilon-greedy over the LAST step of a rolling window.
+
+        `obs_win [N, W, *obs]`: the actor's recent history, a window the
+        actor maintains host-side — the transformer counterpart of
+        carrying (h, c) between steps.
+        """
+        q_seq = self.model.apply(
+            params, common.normalize_obs(obs_win), prev_action_win, done_win)
+        q = q_seq[:, -1]
+        action = common.epsilon_greedy(q, epsilon, self.cfg.num_actions, rng)
+        return action, q
+
+    # -- shared sequence target math --------------------------------------
+    # _td_error/_loss/_learn come from SequenceReplayLearnMixin; this
+    # supplies the transformer forward. Replay semantics live in
+    # `common.sequence_double_q_td` — shared with the LSTM agent so the
+    # two families cannot drift.
+    def _sequence_td(self, params, target_params, batch: XformerBatch, model=None):
+        cfg = self.cfg
+        model = model or self.model
+        obs = common.normalize_obs(batch.state)
+        forward = lambda p: model.apply(p, obs, batch.previous_action, batch.done)
+        discounts = (~batch.done).astype(jnp.float32) * cfg.discount_factor
+        return common.sequence_double_q_td(
+            forward(params), forward(target_params), batch.action, batch.reward,
+            discounts, burn_in=cfg.burn_in, rescale_eps=cfg.rescale_eps)
+
+    def _td_error(self, state: common.TargetTrainState, batch: XformerBatch):
+        tv, sav = self._sequence_td(
+            state.params, state.target_params, batch, model=self._dense_model)
+        return jnp.abs(jnp.mean(tv - sav, axis=1))

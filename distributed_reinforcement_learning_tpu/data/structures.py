@@ -106,3 +106,30 @@ def transitions_from_unroll(
         )
         for t in range(state.shape[0])
     ]
+
+
+class XformerSequenceAccumulator:
+    """Collects seq_len steps per env for the transformer family.
+
+    Same queue payload as the R2D2 accumulator minus the stored LSTM
+    state: the transformer re-attends over the stored sequence, so the
+    sequence is its own state (agents/xformer.py).
+    """
+
+    def __init__(self):
+        self._steps: list[dict] = []
+
+    def append(self, **step_fields: np.ndarray) -> None:
+        self._steps.append(step_fields)
+
+    def __len__(self) -> int:
+        return len(self._steps)
+
+    def extract(self) -> list:
+        from distributed_reinforcement_learning_tpu.agents.xformer import XformerBatch
+
+        fields = {
+            k: np.stack([s[k] for s in self._steps], axis=1) for k in self._steps[0]
+        }
+        n = next(iter(fields.values())).shape[0]
+        return [XformerBatch(**{k: v[i] for k, v in fields.items()}) for i in range(n)]

@@ -1,0 +1,138 @@
+"""Transformer Q-network: attention-based long-context alternative to LSTM.
+
+The reference's only sequence model is a Python-loop LSTM with stored
+state (`/root/reference/model/r2d2_lstm.py:65-112`), which caps usable
+context at the unroll length. This model family removes the recurrence:
+a causal pre-LN transformer over the sequence whose attention is
+confined within episodes by segment ids derived from `done` — the exact
+transformer counterpart of the reference's done-masked (h, c) zeroing
+(`model/r2d2_lstm.py:78-80`). Context length is then a config knob, and
+for long sequences the attention routes through the sequence-parallel
+ring / all-to-all paths in `parallel/sequence.py` via `attention_fn`.
+
+Torso/head conventions follow the in-tree R2D2 net (`models/r2d2_net.py`):
+two 256-wide MLP layers on the observation, a prev-action embedding, and
+the reference's nonstandard dueling head `value - learned_mean`
+(`/root/reference/model/r2d2_lstm.py:45-47`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.models.torso import ActionEmbedding
+from distributed_reinforcement_learning_tpu.ops.attention import dense_attention
+
+_glorot = nn.initializers.xavier_uniform()
+
+# attention_fn contract: (q, k, v, segment_ids) -> out, all [B, T, H, D]
+# (segment_ids [B, T]); must implement causal masking internally.
+AttentionFn = Callable[[jax.Array, jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+def episode_segments(done_seq: jax.Array) -> jax.Array:
+    """[B, T] episode ids from done flags.
+
+    done[t] marks transition t as terminal: step t still belongs to the
+    ending episode, t+1 starts the next — matching where the recurrent
+    nets zero their carries (*after* the step at which done is set).
+    """
+    d = done_seq.astype(jnp.int32)
+    return jnp.concatenate([jnp.zeros_like(d[:, :1]), jnp.cumsum(d, axis=1)[:, :-1]], axis=1)
+
+
+def rope(x: jax.Array, base: float = 10_000.0) -> jax.Array:
+    """Rotary position embedding over the time axis of `[B, T, H, D]`.
+
+    RELATIVE positions are the load-bearing choice, not a style one: the
+    TD loss supervises window positions burn_in..T-2 while the actor
+    always queries the final position of its rolling window. A learned
+    absolute embedding leaves that acting position untrained (it only
+    ever feeds the stop-gradded double-Q argmax), which measurably
+    prevented CartPole-POMDP learning; with RoPE, "current step
+    attending k back" is the same computation wherever the window sits.
+    """
+    d2 = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
+    angles = jnp.arange(x.shape[1], dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+class SelfAttentionBlock(nn.Module):
+    d_model: int
+    num_heads: int
+    dtype: jnp.dtype
+    attention_fn: AttentionFn | None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, segs: jax.Array) -> jax.Array:
+        b, t, _ = x.shape
+        head_dim = self.d_model // self.num_heads
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.d_model, kernel_init=_glorot, dtype=self.dtype)(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda z: z.reshape(b, t, self.num_heads, head_dim)
+        q, k, v = rope(split(q)), rope(split(k)), split(v)
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v, segs)
+        else:
+            out = dense_attention(q, k, v, causal=True, q_seg=segs, k_seg=segs)
+        out = out.reshape(b, t, self.d_model).astype(self.dtype)
+        x = x + nn.Dense(self.d_model, kernel_init=_glorot, dtype=self.dtype)(out)
+
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(4 * self.d_model, kernel_init=_glorot, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        return x + nn.Dense(self.d_model, kernel_init=_glorot, dtype=self.dtype)(y)
+
+
+class TransformerQNet(nn.Module):
+    """MLP torso + action embed -> causal transformer -> dueling head.
+
+    One signature: `(obs_seq [B,T,...], prev_action_seq [B,T],
+    done_seq [B,T]) -> q [B,T,A]`. Acting uses the same forward over a
+    rolling window (the actor's "recurrent state" is the window itself);
+    training unrolls the stored sequence exactly like the recurrent nets,
+    so burn-in/double-Q logic is model-agnostic.
+    """
+
+    num_actions: int
+    d_model: int = 256
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 512
+    dtype: jnp.dtype = jnp.float32
+    attention_fn: AttentionFn | None = None
+
+    @nn.compact
+    def __call__(self, obs_seq: jax.Array, prev_action_seq: jax.Array, done_seq: jax.Array):
+        b, t = prev_action_seq.shape
+        if t > self.max_len:
+            raise ValueError(f"sequence length {t} exceeds max_len {self.max_len}")
+        x = obs_seq.astype(self.dtype).reshape(b, t, -1)
+        x = nn.relu(nn.Dense(256, kernel_init=_glorot, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(256, kernel_init=_glorot, dtype=self.dtype)(x))
+        a = ActionEmbedding(self.num_actions, dtype=self.dtype)(prev_action_seq)
+        z = jnp.concatenate([x, a], axis=-1)
+        z = nn.Dense(self.d_model, kernel_init=_glorot, dtype=self.dtype)(z)
+        # No absolute position embedding: order information enters via
+        # RoPE on (q, k) inside each block — see `rope` for why relative
+        # positions are required here.
+
+        segs = episode_segments(done_seq)
+        for _ in range(self.num_layers):
+            z = SelfAttentionBlock(
+                self.d_model, self.num_heads, self.dtype, self.attention_fn
+            )(z, segs)
+        z = nn.LayerNorm(dtype=self.dtype)(z)
+        h = nn.relu(nn.Dense(128, kernel_init=_glorot, dtype=self.dtype)(z))
+        q = nn.Dense(self.num_actions, kernel_init=_glorot, dtype=self.dtype)(h)
+        mean = nn.Dense(1, kernel_init=_glorot, dtype=self.dtype)(h)
+        return (q - mean).astype(jnp.float32)

@@ -79,6 +79,15 @@ def make_act_adapter(algo: str, agent) -> Callable:
             return {"action": action, "q": q, "h": h, "c": c}
         r2d2_fn.expected_keys = frozenset({"obs", "h", "c", "prev_action", "epsilon"})
         return r2d2_fn
+    if algo == "xformer":
+        # Rows carry the actor's rolling window (the transformer's
+        # stand-in for recurrent state), not a single step.
+        def xformer_fn(params, rows, rng):
+            action, q = agent.act(params, rows["obs"], rows["prev_action"],
+                                  rows["done"], rows["epsilon"], rng)
+            return {"action": action, "q": q}
+        xformer_fn.expected_keys = frozenset({"obs", "prev_action", "done", "epsilon"})
+        return xformer_fn
     raise ValueError(f"unknown algorithm {algo!r}")
 
 

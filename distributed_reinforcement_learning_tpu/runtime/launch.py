@@ -18,11 +18,12 @@ import jax
 from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexConfig
 from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
 from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Config
+from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent, XformerConfig
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
 from distributed_reinforcement_learning_tpu.envs.batched import BatchedEnv
 from distributed_reinforcement_learning_tpu.envs.cartpole import pomdp_project
 from distributed_reinforcement_learning_tpu.envs.registry import make_env
-from distributed_reinforcement_learning_tpu.runtime import apex_runner, impala_runner, r2d2_runner
+from distributed_reinforcement_learning_tpu.runtime import apex_runner, impala_runner, r2d2_runner, xformer_runner
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.config import RuntimeConfig, load_config
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
@@ -48,10 +49,37 @@ def _algo_of(agent_cfg: Any) -> str:
         return "apex"
     if isinstance(agent_cfg, R2D2Config):
         return "r2d2"
+    if isinstance(agent_cfg, XformerConfig):
+        return "xformer"
     raise TypeError(f"unknown agent config {type(agent_cfg)}")
 
 
-_AGENT_CLS = {"impala": ImpalaAgent, "apex": ApexAgent, "r2d2": R2D2Agent}
+_AGENT_CLS = {"impala": ImpalaAgent, "apex": ApexAgent, "r2d2": R2D2Agent,
+              "xformer": XformerAgent}
+
+
+def make_agent(algo: str, agent_cfg: Any, rt: RuntimeConfig, mesh=None, actor: bool = False):
+    """Construct the algorithm's agent.
+
+    Only the transformer family needs care: with `attention="ring"` /
+    `"ulysses"` the LEARNER's agent shards the sequence dimension over a
+    mesh (built here over local devices, `seq_parallel` from the config,
+    when the caller has none). ACTORS always get a dense-attention twin —
+    the attention implementation does not change the parameters, and an
+    actor process acts on a small [N, seq_len] window on its own (often
+    single-device) host where a collective mesh is wrong or impossible.
+    """
+    if algo == "xformer" and agent_cfg.attention != "dense":
+        import dataclasses
+
+        if actor:
+            return XformerAgent(dataclasses.replace(agent_cfg, attention="dense"))
+        if mesh is None:
+            from distributed_reinforcement_learning_tpu.parallel import make_mesh
+
+            mesh = make_mesh(seq_parallel=rt.seq_parallel)
+        return XformerAgent(agent_cfg, mesh=mesh)
+    return _AGENT_CLS[algo](agent_cfg)
 
 
 def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
@@ -61,7 +89,7 @@ def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
 
     `mesh`: optional `jax.sharding.Mesh` — the learn step is pjit-sharded
     over it (batch on the data axis) instead of running single-device."""
-    agent = agent or _AGENT_CLS[algo](agent_cfg)
+    agent = agent or make_agent(algo, agent_cfg, rt, mesh=mesh)
     if algo == "impala":
         return impala_runner.ImpalaLearner(
             agent, queue, weights, rt.batch_size, logger=logger, rng=rng,
@@ -72,7 +100,9 @@ def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
             replay_capacity=rt.replay_capacity,
             target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng,
             mesh=mesh, publish_interval=rt.publish_interval)
-    return r2d2_runner.R2D2Learner(
+    cls = (xformer_runner.XformerLearner if algo == "xformer"
+           else r2d2_runner.R2D2Learner)
+    return cls(
         agent, queue, weights, rt.batch_size,
         replay_capacity=rt.replay_capacity,
         target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng,
@@ -89,7 +119,7 @@ def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, w
     `remote_act` (any algorithm) switches the actor to SEED-style
     centralized inference on the learner.
     """
-    agent = agent or _AGENT_CLS[algo](agent_cfg)
+    agent = agent or make_agent(algo, agent_cfg, rt, actor=True)
     env = _make_batched_env(rt, task, agent_cfg.num_actions)
     atari = _is_atari(rt)
     if algo == "impala":
@@ -102,6 +132,10 @@ def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, w
             agent, env, queue, weights, seed=seed, life_loss_shaping=atari,
             remote_act=remote_act)
     transform = pomdp_project if agent_cfg.obs_shape == (2,) else None
+    if algo == "xformer":
+        return xformer_runner.XformerActor(
+            agent, env, queue, weights, seed=seed, obs_transform=transform,
+            remote_act=remote_act)
     return r2d2_runner.R2D2Actor(
         agent, env, queue, weights, seed=seed, obs_transform=transform,
         remote_act=remote_act)
@@ -111,6 +145,7 @@ _RUN_SYNC = {
     "impala": impala_runner.run_sync,
     "apex": apex_runner.run_sync,
     "r2d2": r2d2_runner.run_sync,
+    "xformer": xformer_runner.run_sync,
 }
 
 
@@ -120,11 +155,16 @@ def build_local(agent_cfg: Any, rt: RuntimeConfig, run_dir: str | None = None, s
     logger = MetricsLogger(run_dir)
     queue = TrajectoryQueue(rt.queue_size)
     weights = WeightStore()
-    agent = _AGENT_CLS[algo](agent_cfg)  # one jit cache for all runners
+    sp = algo == "xformer" and agent_cfg.attention != "dense"
+    # One jit cache for all runners — except the sequence-parallel
+    # learner, whose ring/all-to-all attention the actors must not share.
+    agent = make_agent(algo, agent_cfg, rt)
+    actor_agent = make_agent(algo, agent_cfg, rt, actor=True) if sp else agent
     learner = make_learner(algo, agent_cfg, rt, queue, weights,
                            logger=logger, rng=jax.random.PRNGKey(seed), agent=agent)
     actors = [
-        make_actor(algo, agent_cfg, rt, i, queue, weights, seed=seed + 1 + i, agent=agent)
+        make_actor(algo, agent_cfg, rt, i, queue, weights, seed=seed + 1 + i,
+                   agent=actor_agent)
         for i in range(rt.num_actors)
     ]
     return learner, actors, _RUN_SYNC[algo]

@@ -493,7 +493,7 @@ def run_role(
         if len(devs) > 1 and rt.batch_size % len(devs) == 0:
             from distributed_reinforcement_learning_tpu.parallel import make_mesh
 
-            mesh = make_mesh(devices=devs)
+            mesh = make_mesh(devices=devs, seq_parallel=rt.seq_parallel)
             print(f"[learner] mesh: {dict(mesh.shape)}")
         elif multihost:
             # Refuse rather than silently run N independent un-psum'd
@@ -614,7 +614,7 @@ def _learner_loop(
             if learner.train() is None and not drained:
                 time.sleep(0.05)
             maybe_checkpoint()
-    elif algo == "r2d2":
+    elif algo in ("r2d2", "xformer"):  # same prioritized sequence-replay loop
         while learner.train_steps < num_updates:
             got = learner.ingest_batch(timeout=0.05)
             if learner.train() is None and not got:

@@ -1,0 +1,146 @@
+"""Transformer-R2D2 actor/learner loops.
+
+Fourth algorithm family (see agents/xformer.py): R2D2's prioritized
+sequence-replay topology (`/root/reference/train_r2d2.py:86-238`) with a
+causal transformer instead of the LSTM. The learner is EXACTLY the R2D2
+learner — it only touches `agent.{td_error,learn,sync_target}` and
+sequence pytrees from the queue, all of which the transformer agent
+reproduces — so it is reused wholesale (one cadence/replay/checkpoint
+implementation to maintain, not two).
+
+Only the actor differs: instead of carrying (h, c) between steps it
+maintains a rolling window of the last seq_len (obs, prev_action, done)
+triples and acts on the window's final position. Window slots that
+predate an episode reset are isolated by the segment masking inside the
+model, so the window never needs explicit clearing — the recorded done
+flags do the work the recurrent actors' keep-masked state updates do.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+from distributed_reinforcement_learning_tpu.data.structures import XformerSequenceAccumulator
+from distributed_reinforcement_learning_tpu.runtime.r2d2_runner import (
+    R2D2Learner,
+    run_sync,  # noqa: F401  (re-exported: the sync loop is topology-only)
+)
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+
+class XformerLearner(R2D2Learner):
+    """R2D2Learner bound to an XformerAgent; see module docstring."""
+
+
+class XformerActor:
+    def __init__(
+        self,
+        agent: XformerAgent,
+        env,  # VectorEnv over full observations
+        queue: TrajectoryQueue,
+        weights: WeightStore,
+        seed: int = 0,
+        epsilon_decay: float = 0.1,  # `train_r2d2.py:221`
+        epsilon_floor: float = 0.15,
+        obs_transform=None,  # e.g. envs.cartpole.pomdp_project
+        remote_act=None,  # SEED-style: RemoteInference; no weight pulls at all
+    ):
+        self.agent = agent
+        self.env = env
+        self.queue = queue
+        self.weights = weights
+        self.epsilon_decay = epsilon_decay
+        # The transformer's Q takes longer to become state-discriminating
+        # than the LSTM's (measured: takeoff at ~500-700 updates vs
+        # ~200-400 on CartPole-POMDP), and the reference's per-episode
+        # decay (`train_r2d2.py:221`) starves exploration well before
+        # that. A floor — in the spirit of Ape-X's fixed per-actor
+        # epsilons (`train_apex.py:229`) — keeps the data stream
+        # informative until the attention features settle.
+        self.epsilon_floor = epsilon_floor
+        self.obs_transform = obs_transform or (lambda x: x)
+        self.remote_act = remote_act
+
+        self._rng = jax.random.PRNGKey(seed)
+        self._obs = self.obs_transform(env.reset())
+        n = self._obs.shape[0]
+        w = agent.cfg.seq_len
+        # Rolling window, oldest first. Padding slots are marked done so
+        # segment masking isolates them from the live episode.
+        self._win_obs = np.zeros((n, w, *self._obs.shape[1:]), self._obs.dtype)
+        self._win_pa = np.zeros((n, w), np.int32)
+        self._win_done = np.ones((n, w), bool)
+        self._prev_action = np.zeros(n, np.int32)
+        self._episodes = np.zeros(n, np.int64)
+        self._params = None
+        self._version = -1
+        self.episode_returns: list[float] = []
+
+    @property
+    def epsilon(self) -> np.ndarray:
+        return np.maximum(
+            1.0 / (self.epsilon_decay * self._episodes + 1.0), self.epsilon_floor)
+
+    def _sync_params(self) -> None:
+        got = self.weights.get_if_newer(self._version)
+        if got is not None:
+            self._params, self._version = got
+
+    def _push_window(self, obs, prev_action) -> None:
+        """Slide the window and append the CURRENT step (done not yet
+        known — False placeholder; segments only read earlier slots)."""
+        for arr, val in ((self._win_obs, obs), (self._win_pa, prev_action),
+                         (self._win_done, False)):
+            arr[:, :-1] = arr[:, 1:]
+            arr[:, -1] = val
+
+    def run_unroll(self) -> int:
+        """One seq_len unroll from all envs -> N sequences into the queue."""
+        cfg = self.agent.cfg
+        if self.remote_act is None:
+            self._sync_params()
+            if self._params is None:
+                raise RuntimeError("no weights published yet")
+        acc = XformerSequenceAccumulator()
+        n = self._obs.shape[0]
+
+        for _ in range(cfg.seq_len):
+            self._push_window(self._obs, self._prev_action)
+            if self.remote_act is not None:
+                r = self.remote_act({
+                    "obs": self._win_obs, "prev_action": self._win_pa,
+                    "done": self._win_done,
+                    "epsilon": self.epsilon.astype(np.float32)})
+                action = r["action"]
+            else:
+                self._rng, sub = jax.random.split(self._rng)
+                action, _ = self.agent.act(
+                    self._params, self._win_obs, self._win_pa, self._win_done,
+                    self.epsilon, sub,
+                )
+            action = np.asarray(action)
+            next_obs_raw, reward, done, infos = self.env.step(action)
+            next_obs = self.obs_transform(next_obs_raw)
+
+            acc.append(
+                state=self._obs,
+                previous_action=self._prev_action,
+                action=action,
+                reward=reward.astype(np.float32),
+                done=done,
+            )
+
+            self._win_done[:, -1] = done  # now known; future windows see it
+            self._prev_action = np.where(done, 0, action).astype(np.int32)
+            self._obs = next_obs
+            self._episodes += done
+            for ret in infos.get("episode_return", [])[done]:
+                self.episode_returns.append(float(ret))
+
+        for seq in acc.extract():
+            self.queue.put(seq)
+        return n * cfg.seq_len

@@ -17,6 +17,7 @@ from typing import Any
 from distributed_reinforcement_learning_tpu.agents.apex import ApexConfig
 from distributed_reinforcement_learning_tpu.agents.impala import ImpalaConfig
 from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Config
+from distributed_reinforcement_learning_tpu.agents.xformer import XformerConfig
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,6 +37,7 @@ class RuntimeConfig:
     target_sync_interval: int = 100  # `train_apex.py:151-152`, `train_r2d2.py:163-164`
     train_start_factor: int = 3  # learner trains when queue > factor*batch (`train_impala.py:94`)
     publish_interval: int = 1  # IMPALA weight-publish cadence (1 = reference parity)
+    seq_parallel: int = 1  # xformer: devices carving the mesh's `seq` axis
 
 
 def check_config(rt: RuntimeConfig, num_actions: int) -> None:
@@ -64,6 +66,7 @@ def _runtime_from_section(algo: str, d: dict[str, Any]) -> RuntimeConfig:
         target_sync_interval=d.get("target_sync_interval", 100),
         train_start_factor=d.get("train_start_factor", 3),
         publish_interval=d.get("publish_interval", 1),
+        seq_parallel=d.get("seq_parallel", 1),
     )
 
 
@@ -115,6 +118,19 @@ def load_config(path: str | Path, section: str):
             lstm_size=d.get("lstm_size", 512),
             discount_factor=d.get("discount_factor", 0.997),
             learning_rate=d.get("start_learning_rate", 1e-4),
+        )
+    elif algorithm == "xformer":
+        agent_cfg = XformerConfig(
+            obs_shape=tuple(d["model_input"]),
+            num_actions=d["model_output"],
+            seq_len=d.get("seq_len", 10),
+            burn_in=d.get("burn_in", 5),
+            d_model=d.get("d_model", 128),
+            num_heads=d.get("num_heads", 4),
+            num_layers=d.get("num_layers", 2),
+            discount_factor=d.get("discount_factor", 0.997),
+            learning_rate=d.get("start_learning_rate", 1e-4),
+            attention=d.get("attention", "dense"),
         )
     else:
         raise ValueError(f"unknown algorithm {algorithm!r}")

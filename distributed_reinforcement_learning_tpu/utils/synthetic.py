@@ -93,3 +93,24 @@ def synthetic_r2d2_batch(
         initial_c=(rng.standard_normal((B, lstm_size)) * 0.1).astype(np.float32),
     )
     return batch, rng.random((B,), dtype=np.float32)
+
+
+def synthetic_xformer_batch(
+    B: int,
+    T: int,
+    obs_shape: tuple[int, ...],
+    num_actions: int,
+    seed: int = 0,
+):
+    """Random XformerBatch (sequences, no stored state) + IS weights."""
+    from distributed_reinforcement_learning_tpu.agents.xformer import XformerBatch
+
+    rng = np.random.default_rng(seed)
+    batch = XformerBatch(
+        state=rng.integers(0, 255, (B, T, *obs_shape)).astype(np.int32),
+        previous_action=rng.integers(0, num_actions, (B, T)).astype(np.int32),
+        action=rng.integers(0, num_actions, (B, T)).astype(np.int32),
+        reward=rng.random((B, T), dtype=np.float32),
+        done=rng.random((B, T)) < 0.1,
+    )
+    return batch, rng.random((B,), dtype=np.float32)

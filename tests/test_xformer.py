@@ -1,0 +1,204 @@
+"""Transformer-R2D2 family: model semantics, agent learning, SP training.
+
+Covers the contracts nothing else exercises:
+- episode_segments' boundary shift (done at t => split AFTER t, mirroring
+  post-step (h, c) zeroing in the recurrent nets);
+- causality and episode isolation of the transformer forward;
+- agent math (burn-in alignment, finite priorities, loss descends);
+- ring/Ulysses sequence-parallel training matches the dense agent on an
+  8-virtual-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.agents.xformer import (
+    XformerAgent,
+    XformerBatch,
+    XformerConfig,
+)
+from distributed_reinforcement_learning_tpu.models.transformer_net import (
+    TransformerQNet,
+    episode_segments,
+)
+from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_xformer_batch
+
+
+class TestEpisodeSegments:
+    def test_boundary_shift(self):
+        # done at t=2: steps 0-2 are episode 0, step 3 onward episode 1.
+        done = jnp.asarray([[False, False, True, False, False]])
+        np.testing.assert_array_equal(
+            np.asarray(episode_segments(done))[0], [0, 0, 0, 1, 1])
+
+    def test_multiple_and_adjacent_dones(self):
+        done = jnp.asarray([[True, True, False, True, False]])
+        np.testing.assert_array_equal(
+            np.asarray(episode_segments(done))[0], [0, 1, 2, 2, 3])
+
+    def test_no_dones(self):
+        done = jnp.zeros((2, 4), bool)
+        np.testing.assert_array_equal(np.asarray(episode_segments(done)), 0)
+
+
+def _model_and_params(t=8, obs=(2,), seed=0, **kw):
+    model = TransformerQNet(num_actions=3, d_model=32, num_heads=2,
+                            num_layers=2, max_len=16, **kw)
+    rng = np.random.RandomState(seed)
+    obs_seq = jnp.asarray(rng.randn(2, t, *obs).astype(np.float32))
+    pa = jnp.asarray(rng.randint(0, 3, (2, t)))
+    done = jnp.zeros((2, t), bool)
+    params = model.init(jax.random.PRNGKey(seed), obs_seq, pa, done)
+    return model, params, obs_seq, pa, done
+
+
+class TestTransformerQNet:
+    def test_output_shape_and_finite(self):
+        model, params, obs, pa, done = _model_and_params()
+        q = model.apply(params, obs, pa, done)
+        assert q.shape == (2, 8, 3) and q.dtype == jnp.float32
+        assert np.all(np.isfinite(np.asarray(q)))
+
+    def test_causality(self):
+        """Perturbing a future observation must not change past Q-values."""
+        model, params, obs, pa, done = _model_and_params()
+        q1 = model.apply(params, obs, pa, done)
+        obs2 = obs.at[:, 5:].set(0.0)
+        q2 = model.apply(params, obs2, pa, done)
+        np.testing.assert_allclose(
+            np.asarray(q1[:, :5]), np.asarray(q2[:, :5]), atol=1e-6)
+        assert float(jnp.max(jnp.abs(q1[:, 5:] - q2[:, 5:]))) > 1e-4
+
+    def test_episode_isolation(self):
+        """Q after a reset must not depend on pre-reset observations."""
+        model, params, obs, pa, _ = _model_and_params()
+        done = jnp.zeros((2, 8), bool).at[:, 3].set(True)  # split after t=3
+        q1 = model.apply(params, obs, pa, done)
+        obs2 = obs.at[:, :4].set(0.0)  # perturb only episode 0
+        q2 = model.apply(params, obs2, pa, done)
+        np.testing.assert_allclose(
+            np.asarray(q1[:, 4:]), np.asarray(q2[:, 4:]), atol=1e-6)
+        assert float(jnp.max(jnp.abs(q1[:, :4] - q2[:, :4]))) > 1e-4
+
+    def test_max_len_guard(self):
+        model, params, obs, pa, done = _model_and_params()
+        long = jnp.zeros((2, 32, 2))
+        with pytest.raises(ValueError, match="max_len"):
+            model.apply(params, long, jnp.zeros((2, 32), jnp.int32),
+                        jnp.zeros((2, 32), bool))
+
+
+def _agent(attention="dense", mesh=None, seq_len=8, heads=2):
+    cfg = XformerConfig(
+        obs_shape=(2,), num_actions=3, seq_len=seq_len, burn_in=2,
+        d_model=32, num_heads=heads, num_layers=2, attention=attention)
+    return XformerAgent(cfg, mesh=mesh)
+
+
+class TestXformerAgent:
+    def test_act_epsilon_extremes(self):
+        agent = _agent()
+        state = agent.init_state(jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        obs = jnp.asarray(rng.randn(4, 8, 2).astype(np.float32))
+        pa = jnp.zeros((4, 8), jnp.int32)
+        done = jnp.zeros((4, 8), bool)
+        a_greedy, q = agent.act(state.params, obs, pa, done, 0.0, jax.random.PRNGKey(1))
+        assert a_greedy.shape == (4,) and q.shape == (4, 3)
+        np.testing.assert_array_equal(np.asarray(a_greedy), np.asarray(jnp.argmax(q, -1)))
+
+    def test_learn_descends_and_priorities_finite(self):
+        agent = _agent()
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch, w = synthetic_xformer_batch(16, 8, (2,), 3)
+        losses = []
+        for _ in range(30):
+            state, pri, metrics = agent.learn(state, batch, w)
+            losses.append(float(metrics["loss"]))
+        assert np.all(np.isfinite(losses))
+        assert np.asarray(pri).shape == (16,) and np.all(np.isfinite(np.asarray(pri)))
+        assert losses[-1] < 0.5 * losses[0], losses[::10]
+
+    def test_td_error_matches_learn_priorities(self):
+        agent = _agent()
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch, w = synthetic_xformer_batch(8, 8, (2,), 3, seed=1)
+        pri_td = agent.td_error(state, batch)
+        _, pri_learn, _ = agent.learn(state, batch, w)
+        np.testing.assert_allclose(np.asarray(pri_td), np.asarray(pri_learn), atol=1e-5)
+
+    def test_target_sync(self):
+        agent = _agent()
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch, w = synthetic_xformer_batch(8, 8, (2,), 3)
+        state, _, _ = agent.learn(state, batch, w)
+        diff = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                            state.params, state.target_params)
+        assert max(jax.tree.leaves(diff)) > 0
+        state = agent.sync_target(state)
+        diff = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                            state.params, state.target_params)
+        assert max(jax.tree.leaves(diff)) == 0
+
+
+class TestSequenceParallelTraining:
+    """The long-context payoff: the SAME agent math with the sequence
+    dimension sharded over the mesh's seq axis."""
+
+    @pytest.mark.parametrize("attention", ["ring", "ulysses"])
+    def test_matches_dense_agent(self, attention):
+        from distributed_reinforcement_learning_tpu.parallel import make_mesh
+
+        mesh = make_mesh(8, seq_parallel=4)  # data=2 x seq=4
+        heads = 4 if attention == "ulysses" else 2  # ulysses: heads % seq == 0
+        dense = _agent(heads=heads)
+        sp = _agent(attention=attention, mesh=mesh, heads=heads)
+        state_d = dense.init_state(jax.random.PRNGKey(0))
+        state_s = sp.init_state(jax.random.PRNGKey(0))
+        batch, w = synthetic_xformer_batch(8, 8, (2,), 3, seed=2)
+
+        state_d, pri_d, m_d = dense.learn(state_d, batch, w)
+        state_s, pri_s, m_s = sp.learn(state_s, batch, w)
+        np.testing.assert_allclose(np.asarray(pri_d), np.asarray(pri_s), atol=1e-4)
+        assert abs(float(m_d["loss"]) - float(m_s["loss"])) < 1e-5
+        # One more step so sharded optimizer state keeps working.
+        state_s, _, m_s2 = sp.learn(state_s, batch, w)
+        assert np.isfinite(float(m_s2["loss"]))
+
+    def test_ring_reachable_from_config_path(self):
+        """attention="ring" must work through the documented config/CLI
+        path (build_local), not only via direct agent construction — the
+        learner gets a (data, seq) mesh over local devices, actors get a
+        dense-attention twin."""
+        import dataclasses
+
+        from distributed_reinforcement_learning_tpu.utils.config import RuntimeConfig
+        from distributed_reinforcement_learning_tpu.runtime.launch import build_local
+
+        cfg = XformerConfig(obs_shape=(2,), num_actions=2, seq_len=8, burn_in=2,
+                            d_model=32, num_heads=2, num_layers=1, attention="ring")
+        rt = RuntimeConfig(algorithm="xformer", num_actors=1, envs=("CartPole-v0",),
+                           available_action=(2,), batch_size=8, envs_per_actor=2,
+                           seq_parallel=2, target_sync_interval=20)
+        learner, actors, run_fn = build_local(cfg, rt, seed=0)
+        assert actors[0].agent is not learner.agent  # dense twin for acting
+        assert actors[0].agent.cfg.attention == "dense"
+        result = run_fn(learner, actors, num_updates=3)
+        assert np.isfinite(result["last_metrics"]["loss"])
+
+    def test_long_context_ring(self):
+        """seq_len=64 over 8 sequence shards trains end to end."""
+        from distributed_reinforcement_learning_tpu.parallel import make_mesh
+
+        mesh = make_mesh(8, seq_parallel=8)
+        cfg = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=64, burn_in=8,
+                            d_model=32, num_heads=2, num_layers=2, attention="ring")
+        agent = XformerAgent(cfg, mesh=mesh)
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch, w = synthetic_xformer_batch(4, 64, (2,), 3, seed=3)
+        state, pri, metrics = agent.learn(state, batch, w)
+        assert np.isfinite(float(metrics["loss"]))
+        assert np.all(np.isfinite(np.asarray(pri)))
