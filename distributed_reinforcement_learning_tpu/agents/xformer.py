@@ -54,8 +54,10 @@ class XformerConfig:
     learning_rate: float = 1e-4
     rescale_eps: float = 1e-3
     dtype: Any = jnp.float32
-    # "dense" on one device; "ring"/"ulysses" shard the sequence over the
-    # mesh's `seq` axis (pass the mesh at construction).
+    # "dense" on one device; "ring" / "ring_zigzag" / "ulysses" shard the
+    # sequence over the mesh's `seq` axis (pass the mesh at
+    # construction). "ring_zigzag" is the balanced-causal ring: the model
+    # holds its residual stream in zigzag layout for the whole forward.
     attention: str = "dense"
 
 
@@ -74,20 +76,35 @@ class XformerAgent(common.SequenceReplayLearnMixin):
         self.cfg = cfg
         self._mesh = mesh
         attention_fn = None
+        sequence_perm = None
         if cfg.attention != "dense":
             if mesh is None:
                 raise ValueError(f"attention={cfg.attention!r} needs a mesh")
             from distributed_reinforcement_learning_tpu.parallel import sequence as sp
-            from distributed_reinforcement_learning_tpu.parallel.mesh import DATA_AXIS
+            from distributed_reinforcement_learning_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
-            fn = {"ring": sp.ring_attention, "ulysses": sp.ulysses_attention}[cfg.attention]
+            fns = {
+                "ring": sp.ring_attention,
+                # pre_permuted: the MODEL holds its stream in zigzag
+                # layout for the whole forward (one reorder, not one per
+                # layer) via sequence_perm below.
+                "ring_zigzag": functools.partial(
+                    sp.ring_attention, schedule="zigzag", pre_permuted=True),
+                "ulysses": sp.ulysses_attention,
+            }
+            if cfg.attention not in fns:
+                raise ValueError(
+                    f"unknown attention {cfg.attention!r}; one of "
+                    f"['dense', {', '.join(map(repr, fns))}]")
             attention_fn = functools.partial(
                 lambda f, q, k, v, segs: f(
                     mesh, q, k, v, causal=True, batch_axis=DATA_AXIS, segment_ids=segs
                 ),
-                fn,
+                fns[cfg.attention],
             )
-        make_model = lambda fn: TransformerQNet(
+            if cfg.attention == "ring_zigzag":
+                sequence_perm = sp.zigzag_permutation(cfg.seq_len, mesh.shape[SEQ_AXIS])
+        make_model = lambda fn, perm=None: TransformerQNet(
             num_actions=cfg.num_actions,
             d_model=cfg.d_model,
             num_heads=cfg.num_heads,
@@ -95,8 +112,9 @@ class XformerAgent(common.SequenceReplayLearnMixin):
             max_len=max(cfg.seq_len, 16),
             dtype=cfg.dtype,
             attention_fn=fn,
+            sequence_perm=perm,
         )
-        self.model = make_model(attention_fn)
+        self.model = make_model(attention_fn, sequence_perm)
         # Dense twin over the SAME params: ingest-time priority scoring
         # runs on whatever ragged batch the queue drained, which need not
         # divide the mesh's data axis the way fixed-size learn batches do.

@@ -45,7 +45,7 @@ def episode_segments(done_seq: jax.Array) -> jax.Array:
     return jnp.concatenate([jnp.zeros_like(d[:, :1]), jnp.cumsum(d, axis=1)[:, :-1]], axis=1)
 
 
-def rope(x: jax.Array, base: float = 10_000.0) -> jax.Array:
+def rope(x: jax.Array, positions: jax.Array | None = None, base: float = 10_000.0) -> jax.Array:
     """Rotary position embedding over the time axis of `[B, T, H, D]`.
 
     RELATIVE positions are the load-bearing choice, not a style one: the
@@ -55,10 +55,15 @@ def rope(x: jax.Array, base: float = 10_000.0) -> jax.Array:
     ever feeds the stop-gradded double-Q argmax), which measurably
     prevented CartPole-POMDP learning; with RoPE, "current step
     attending k back" is the same computation wherever the window sits.
+
+    `positions` overrides the default arange when the stream is held in
+    a permuted layout (zigzag sequence parallelism).
     """
     d2 = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
-    angles = jnp.arange(x.shape[1], dtype=jnp.float32)[:, None] * freqs[None, :]
+    if positions is None:
+        positions = jnp.arange(x.shape[1])
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :d2], x[..., d2:]
@@ -72,14 +77,14 @@ class SelfAttentionBlock(nn.Module):
     attention_fn: AttentionFn | None
 
     @nn.compact
-    def __call__(self, x: jax.Array, segs: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, segs: jax.Array, positions: jax.Array | None = None) -> jax.Array:
         b, t, _ = x.shape
         head_dim = self.d_model // self.num_heads
         y = nn.LayerNorm(dtype=self.dtype)(x)
         qkv = nn.Dense(3 * self.d_model, kernel_init=_glorot, dtype=self.dtype)(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda z: z.reshape(b, t, self.num_heads, head_dim)
-        q, k, v = rope(split(q)), rope(split(k)), split(v)
+        q, k, v = rope(split(q), positions), rope(split(k), positions), split(v)
         if self.attention_fn is not None:
             out = self.attention_fn(q, k, v, segs)
         else:
@@ -110,6 +115,14 @@ class TransformerQNet(nn.Module):
     max_len: int = 512
     dtype: jnp.dtype = jnp.float32
     attention_fn: AttentionFn | None = None
+    # (perm, inverse) int tuples from `parallel.sequence.zigzag_permutation`:
+    # the residual stream is reordered ONCE here (and the output back)
+    # instead of inside every attention call — per-layer permutes of a
+    # sequence-sharded stream would each cost a resharding collective.
+    # RoPE and segment masking use the true global positions throughout;
+    # the zigzag attention body computes its block positions from the
+    # same layout, so `attention_fn` must be a pre_permuted zigzag ring.
+    sequence_perm: tuple | None = None
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, prev_action_seq: jax.Array, done_seq: jax.Array):
@@ -126,13 +139,28 @@ class TransformerQNet(nn.Module):
         # RoPE on (q, k) inside each block — see `rope` for why relative
         # positions are required here.
 
-        segs = episode_segments(done_seq)
+        segs = episode_segments(done_seq)  # chronological, before any reorder
+        positions = None
+        if self.sequence_perm is not None:
+            if self.attention_fn is None:
+                raise ValueError(
+                    "sequence_perm without a layout-aware attention_fn would "
+                    "causally mask in the wrong order")
+            perm, _ = self.sequence_perm
+            if len(perm) != t:
+                raise ValueError(f"sequence_perm is for T={len(perm)}, got T={t}")
+            positions = jnp.asarray(perm)
+            z = jnp.take(z, positions, axis=1)
+            segs = jnp.take(segs, positions, axis=1)
         for _ in range(self.num_layers):
             z = SelfAttentionBlock(
                 self.d_model, self.num_heads, self.dtype, self.attention_fn
-            )(z, segs)
+            )(z, segs, positions)
         z = nn.LayerNorm(dtype=self.dtype)(z)
         h = nn.relu(nn.Dense(128, kernel_init=_glorot, dtype=self.dtype)(z))
         q = nn.Dense(self.num_actions, kernel_init=_glorot, dtype=self.dtype)(h)
         mean = nn.Dense(1, kernel_init=_glorot, dtype=self.dtype)(h)
-        return (q - mean).astype(jnp.float32)
+        q = (q - mean).astype(jnp.float32)
+        if self.sequence_perm is not None:
+            q = jnp.take(q, jnp.asarray(self.sequence_perm[1]), axis=1)
+        return q

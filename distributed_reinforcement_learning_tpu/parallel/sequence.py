@@ -38,6 +38,17 @@ from distributed_reinforcement_learning_tpu.ops import attention as att
 from distributed_reinforcement_learning_tpu.parallel.mesh import SEQ_AXIS
 
 
+def _varying_acc(q, axis_name: str, varying_axes=()):
+    """Online-softmax accumulator typed as varying over every sharded
+    mesh axis: the scan writes shard-dependent values into it, and
+    shard_map's VMA typing rejects an unvarying init against a varying
+    carry. One helper so both ring bodies share the workaround."""
+    return jax.tree.map(
+        lambda x: jax.lax.pcast(x, (axis_name, *varying_axes), to="varying"),
+        att.attention_block_init(q),
+    )
+
+
 def _ring_shard(q, k, v, seg, *, axis_name: str, causal: bool, varying_axes=()):
     """Per-device body: local Q against the rotating KV ring.
 
@@ -81,15 +92,89 @@ def _ring_shard(q, k, v, seg, *, axis_name: str, causal: bool, varying_axes=()):
         k_seg = None if k_seg is None else rotate(k_seg)
         return (k_blk, v_blk, k_seg, acc), None
 
-    # The zero accumulator must be typed as varying over every sharded mesh
-    # axis (the scan writes shard-dependent values into it) — shard_map's
-    # VMA typing rejects an unvarying init against a varying carry.
-    acc0 = jax.tree.map(
-        lambda x: jax.lax.pcast(x, (axis_name, *varying_axes), to="varying"),
-        att.attention_block_init(q),
-    )
+    acc0 = _varying_acc(q, axis_name, varying_axes)
     (_, _, _, acc), _ = jax.lax.scan(step, (k, v, seg, acc0), jnp.arange(n))
     return att.attention_block_finish(acc, q.dtype)
+
+
+def _ring_shard_zigzag(q, k, v, seg, *, axis_name: str, causal: bool, varying_axes=()):
+    """Balanced causal ring: each device owns chunks (i, 2n-1-i).
+
+    The contiguous ring's causal skip trims FLOPs but not latency — the
+    device holding the last shard still attends every block, so every
+    hop waits on it. With the zig-zag placement each device's local
+    sequence is one globally-early chunk `e` (chunk i) and one
+    globally-late chunk `l` (chunk 2n-1-i); of the four quadrant
+    interactions per hop, `e x late` is ALWAYS fully future (skipped
+    statically), `l x early` is always fully past (computed unmasked),
+    and the two same-half quadrants are needed for about half the hops —
+    2n+1 chunk-matmuls per device regardless of i. Work is uniform, so
+    the synchronous ring's critical path drops ~2x at large n.
+    """
+    assert causal, "zigzag schedule is causal-only (guarded in ring_attention)"
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    c = q.shape[1] // 2  # chunk length
+    ar = jnp.arange(c)
+    qe, ql = q[:, :c], q[:, c:]
+    qe_pos, ql_pos = idx * c + ar, (2 * n - 1 - idx) * c + ar
+    seg_e = None if seg is None else seg[:, :c]
+    seg_l = None if seg is None else seg[:, c:]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def quadrant(acc, q_half, k_blk, v_blk, q_pos, k_pos, q_seg, k_seg, masked):
+        return att.attention_block_step(
+            acc, q_half, k_blk, v_blk, causal=masked, q_pos=q_pos, k_pos=k_pos,
+            q_seg=q_seg, k_seg=k_seg,
+        )
+
+    def step(carry, hop):
+        k_blk, v_blk, k_seg, acc_e, acc_l = carry
+        src = (idx - hop) % n
+        ke, kl = k_blk[:, :c], k_blk[:, c:]
+        ve, vl = v_blk[:, :c], v_blk[:, c:]
+        ke_pos, kl_pos = src * c + ar, (2 * n - 1 - src) * c + ar
+        ks_e = None if k_seg is None else k_seg[:, :c]
+        ks_l = None if k_seg is None else k_seg[:, c:]
+
+        # e x early: needed iff src <= idx (diagonal masked inside).
+        acc_e = jax.lax.cond(
+            src > idx, lambda a: a,
+            lambda a: quadrant(a, qe, ke, ve, qe_pos, ke_pos, seg_e, ks_e, True),
+            acc_e)
+        # e x late: always strictly future — statically skipped.
+        # l x early: always strictly past — full attend, no causal mask
+        # (segment mask still applies).
+        acc_l = quadrant(acc_l, ql, ke, ve, ql_pos, ke_pos, seg_l, ks_e, False)
+        # l x late: needed iff src >= idx.
+        acc_l = jax.lax.cond(
+            src < idx, lambda a: a,
+            lambda a: quadrant(a, ql, kl, vl, ql_pos, kl_pos, seg_l, ks_l, True),
+            acc_l)
+
+        rotate = lambda x: jax.lax.ppermute(x, axis_name, perm)
+        k_blk, v_blk = rotate(k_blk), rotate(v_blk)
+        k_seg = None if k_seg is None else rotate(k_seg)
+        return (k_blk, v_blk, k_seg, acc_e, acc_l), None
+
+    init = (k, v, seg, _varying_acc(qe, axis_name, varying_axes),
+            _varying_acc(ql, axis_name, varying_axes))
+    (_, _, _, acc_e, acc_l), _ = jax.lax.scan(step, init, jnp.arange(n))
+    return jnp.concatenate(
+        [att.attention_block_finish(acc_e, q.dtype),
+         att.attention_block_finish(acc_l, q.dtype)], axis=1)
+
+
+def _zigzag_perm(t: int, n: int) -> "jnp.ndarray":
+    """Global time permutation placing chunks (i, 2n-1-i) on device i."""
+    import numpy as np
+
+    c = t // (2 * n)
+    out = []
+    for i in range(n):
+        out.append(np.arange(i * c, (i + 1) * c))
+        out.append(np.arange((2 * n - 1 - i) * c, (2 * n - i) * c))
+    return jnp.asarray(np.concatenate(out))
 
 
 def _ulysses_shard(q, k, v, seg, *, axis_name: str, causal: bool):
@@ -126,7 +211,7 @@ def _sp_attention(
     spec = P(batch_axis, SEQ_AXIS, None, None)
     seg_spec = P(batch_axis, SEQ_AXIS)
     kwargs = dict(axis_name=SEQ_AXIS, causal=causal)
-    if body is _ring_shard and batch_axis is not None:
+    if body in (_ring_shard, _ring_shard_zigzag) and batch_axis is not None:
         kwargs["varying_axes"] = (batch_axis,)
     f = jax.shard_map(
         functools.partial(body, **kwargs),
@@ -146,17 +231,61 @@ def ring_attention(
     causal: bool = True,
     batch_axis: str | None = None,
     segment_ids: jax.Array | None = None,
+    schedule: str = "contiguous",
+    pre_permuted: bool = False,
 ) -> jax.Array:
     """Causal MHA with Q/K/V sharded over `mesh`'s `seq` axis.
 
     Global shapes `[B, T, H, D]`; T must divide by the seq-axis size.
     Optionally also batch-sharded over `batch_axis` (e.g. `data`), and
     episode-confined via `segment_ids` `[B, T]`.
+
+    `schedule="zigzag"` (causal only; needs T % 2n == 0) uses the
+    balanced chunk placement — see `_ring_shard_zigzag` — which halves
+    the ring's critical-path compute at large seq-axis sizes. The inputs
+    are permuted into zigzag layout here (and the output back) unless
+    `pre_permuted=True` — a multi-layer caller should permute its
+    residual stream ONCE with `zigzag_permutation` and pass
+    `pre_permuted` so the resharding gathers don't recur per layer
+    (models/transformer_net.py does this).
     """
     _check(mesh, q, heads_divide=False)
+    if schedule == "zigzag":
+        n = mesh.shape[SEQ_AXIS]
+        t = q.shape[1]
+        if not causal:
+            raise ValueError("zigzag schedule only pays for causal attention")
+        if t % (2 * n) != 0:
+            raise ValueError(f"zigzag needs T ({t}) divisible by 2*seq axis ({2 * n})")
+        if pre_permuted:
+            return _sp_attention(
+                mesh, _ring_shard_zigzag, q, k, v, segment_ids,
+                causal=causal, batch_axis=batch_axis,
+            )
+        perm = _zigzag_perm(t, n)
+        inv = jnp.argsort(perm)
+        take = lambda x: jnp.take(x, perm, axis=1)
+        out = _sp_attention(
+            mesh, _ring_shard_zigzag, take(q), take(k), take(v),
+            None if segment_ids is None else jnp.take(segment_ids, perm, axis=1),
+            causal=causal, batch_axis=batch_axis,
+        )
+        return jnp.take(out, inv, axis=1)
+    if schedule != "contiguous":
+        raise ValueError(f"unknown schedule {schedule!r}")
     return _sp_attention(
         mesh, _ring_shard, q, k, v, segment_ids, causal=causal, batch_axis=batch_axis
     )
+
+
+def zigzag_permutation(t: int, n: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """(perm, inverse) time-axis permutations for the zigzag layout, as
+    hashable int tuples (usable as static flax module fields)."""
+    import numpy as np
+
+    perm = np.asarray(_zigzag_perm(t, n))
+    inv = np.argsort(perm)
+    return tuple(int(i) for i in perm), tuple(int(i) for i in inv)
 
 
 def ulysses_attention(

@@ -62,7 +62,7 @@ def make_agent(algo: str, agent_cfg: Any, rt: RuntimeConfig, mesh=None, actor: b
     """Construct the algorithm's agent.
 
     Only the transformer family needs care: with `attention="ring"` /
-    `"ulysses"` the LEARNER's agent shards the sequence dimension over a
+    `"ring_zigzag"` / `"ulysses"` the LEARNER's agent shards the sequence dimension over a
     mesh (built here over local devices, `seq_parallel` from the config,
     when the caller has none). ACTORS always get a dense-attention twin —
     the attention implementation does not change the parameters, and an

@@ -148,7 +148,7 @@ class TestSequenceParallelTraining:
     """The long-context payoff: the SAME agent math with the sequence
     dimension sharded over the mesh's seq axis."""
 
-    @pytest.mark.parametrize("attention", ["ring", "ulysses"])
+    @pytest.mark.parametrize("attention", ["ring", "ring_zigzag", "ulysses"])
     def test_matches_dense_agent(self, attention):
         from distributed_reinforcement_learning_tpu.parallel import make_mesh
 
