@@ -217,6 +217,84 @@ def bench_e2e(cfg, B: int, updates: int, feeders: int = 3) -> dict:
     return out
 
 
+def bench_r2d2_learn(B: int, iters: int) -> dict:
+    """R2D2 learn-step throughput (env-frames/s) at the reference replay
+    shape — the training hot path that runs the fused Pallas LSTM
+    (fwd + BPTT) twice per step (main + target unrolls)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Config
+    from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_r2d2_batch
+
+    cfg = R2D2Config()  # seq_len 10, lstm 512 (`config.json:2-24`)
+    agent = R2D2Agent(cfg)
+    state = agent.init_state(jax.random.PRNGKey(0))
+    batch, w = synthetic_r2d2_batch(B, cfg.seq_len, cfg.obs_shape, cfg.num_actions,
+                                    cfg.lstm_size)
+    batch = jax.device_put(jax.tree.map(jnp.asarray, batch))
+    w = jax.device_put(jnp.asarray(w))
+
+    def window(state, n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, pri, metrics = agent.learn(state, batch, w)
+        loss = float(metrics["loss"])
+        return state, time.perf_counter() - t0, loss
+
+    state, _, _ = window(state, 1)  # compile
+    state, _, _ = window(state, max(iters // 4, 5))
+    state, t1, _ = window(state, iters)
+    state, t2, loss = window(state, 2 * iters)
+    step_s = max((t2 - t1) / iters, 1e-9)
+    fps = B * cfg.seq_len / step_s
+    print(f"[bench] r2d2 learn B={B}: {1e3*step_s:.3f}ms/step = {fps:,.0f} frames/s "
+          f"(loss {loss:.4f})", file=sys.stderr)
+    return {"B": B, "frames_per_s": round(fps, 1), "step_ms": round(1e3 * step_s, 3)}
+
+
+def bench_long_context(iters: int) -> dict:
+    """Single-chip long-context attention: blockwise online-softmax vs
+    dense at T=8192 (a dense [T,T] logits matrix is 256MB/head in f32 —
+    the blockwise path is what makes this length trainable at all)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_reinforcement_learning_tpu.ops.attention import (
+        blockwise_attention, dense_attention)
+
+    B, T, H, D = 1, 8192, 4, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (0.2 * jax.random.normal(kk, (B, T, H, D), jnp.bfloat16) for kk in ks)
+    out = {}
+    for name, fn in (("dense", dense_attention),
+                     ("blockwise", lambda q, k, v: blockwise_attention(q, k, v, block_size=512))):
+        def loss(q, k, v, _f=fn):
+            return jnp.sum(_f(q, k, v).astype(jnp.float32) ** 2)
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        def window(n, seed0):
+            # seed0 perturbs the inputs so the two windows never replay a
+            # byte-identical computation (the tunnel memoizes those); acc
+            # chains the calls within a window.
+            acc = jnp.float32(seed0)
+            t0 = time.perf_counter()
+            for i in range(n):
+                gs = g(q * (1.0 + 1e-6 * acc), k, v)
+                acc = acc + jnp.sum(gs[0][0, 0, 0]).astype(jnp.float32)
+            float(acc)
+            return time.perf_counter() - t0
+
+        window(2, 0)  # compile + warm
+        t1 = window(iters, 1)
+        t2 = window(2 * iters, 2)
+        us = 1e6 * max(t2 - t1, 0.0) / iters
+        out[f"attn_grad_T{T}_{name}_us"] = round(us, 1)
+    print(f"[bench] long-context: {out}", file=sys.stderr)
+    return out
+
+
 def bench_kernels(cfg, B: int, iters: int) -> dict:
     """Pallas vs XLA-scan timings for the V-trace recursion and the fused
     LSTM at IMPALA shapes — the committed evidence behind the backend
@@ -375,6 +453,23 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["kernel_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] kernels failed: {e}", file=sys.stderr)
+
+    if os.environ.get("BENCH_R2D2", "1") == "1":
+        try:
+            extra["r2d2_learn"] = bench_r2d2_learn(
+                int(os.environ.get("BENCH_R2D2_BATCH", "64")),
+                iters if on_accel else 2)
+        except Exception as e:  # noqa: BLE001
+            extra["r2d2_learn"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] r2d2 failed: {e}", file=sys.stderr)
+
+    if os.environ.get("BENCH_LONG_CONTEXT", "1" if on_accel else "0") == "1":
+        try:
+            extra["long_context"] = bench_long_context(
+                int(os.environ.get("BENCH_LC_ITERS", "10")))
+        except Exception as e:  # noqa: BLE001
+            extra["long_context"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] long-context failed: {e}", file=sys.stderr)
 
     _emit(best["frames_per_s"], extra)
 

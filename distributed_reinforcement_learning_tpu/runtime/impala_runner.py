@@ -226,10 +226,13 @@ class ImpalaLearner(PublishCadenceMixin):
         self.train_steps += 1
         self.frames_learned += self.batch_size * self.agent.cfg.trajectory
         if self.maybe_publish():
-            # The publish was this step's device sync, so "learn" above
-            # measured dispatch, "publish" compute+D2H; the float()
-            # conversion after it is free.
-            metrics = {k: float(v) for k, v in metrics.items()}
+            # Sync publish is this step's device sync (so "learn" above
+            # measured dispatch, "publish" compute+D2H, and the float()
+            # after it is free). With DRL_ASYNC_PUBLISH the publish only
+            # enqueues a device copy, so the float() below becomes the
+            # sync — give it its own stage so the wait is attributed.
+            with self.timer.stage("metrics_sync"):
+                metrics = {k: float(v) for k, v in metrics.items()}
             self.logger.add_scalars(
                 {f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
         # Non-publish steps return the metrics as DEVICE arrays and log

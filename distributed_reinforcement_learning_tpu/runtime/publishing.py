@@ -9,6 +9,16 @@ host class provides `weights`, `state`, `train_steps`,
 
 from __future__ import annotations
 
+import os
+
+
+def _async_publish() -> bool:
+    """DRL_ASYNC_PUBLISH=1: hand the params D2H + store to the weight
+    store's background worker (an on-device copy is the only cost on the
+    learn thread). Off by default — the synchronous publish doubles as
+    the step's device sync, which the deterministic tests rely on."""
+    return os.environ.get("DRL_ASYNC_PUBLISH", "0") == "1"
+
 
 class PublishCadenceMixin:
     def maybe_publish(self) -> bool:
@@ -21,7 +31,10 @@ class PublishCadenceMixin:
         if self.train_steps % self.publish_interval != 0:
             return False
         with self.timer.stage("publish"):
-            self.weights.publish(self.state.params, self.train_steps)
+            if _async_publish():
+                self.weights.publish_async(self.state.params, self.train_steps)
+            else:
+                self.weights.publish(self.state.params, self.train_steps)
         return True
 
     def flush_publish(self) -> None:
@@ -29,3 +42,5 @@ class PublishCadenceMixin:
         the last <K updates would otherwise never reach the store."""
         if self.train_steps > 0 and self.train_steps % self.publish_interval != 0:
             self.weights.publish(self.state.params, self.train_steps)
+        if _async_publish():
+            self.weights.flush_async()

@@ -15,6 +15,7 @@ serves the same object over the wire to remote actors.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 import jax
@@ -26,13 +27,101 @@ class WeightStore:
         self._lock = threading.Lock()
         self._params: Any = None
         self._version: int = -1
+        # Async publication: one worker drains a latest-wins pending slot.
+        # Races between publishes are arbitrated by SUBMISSION order
+        # (`_seq`), not by version number: versions may legitimately go
+        # backward (checkpoint-rollback republish at a restored step),
+        # and the last submit must win either way.
+        self._async_lock = threading.Lock()
+        self._seq = 0
+        self._applied_seq = 0
+        self._pending: tuple[Any, int, int] | None = None
+        self._busy = False
+        self._work = threading.Event()
+        self._worker: threading.Thread | None = None
+        self._closed = False
+
+    def _next_seq(self) -> int:
+        with self._async_lock:
+            self._seq += 1
+            return self._seq
+
+    def _apply(self, host_params: Any, version: int, seq: int) -> None:
+        with self._lock:
+            if seq >= self._applied_seq:
+                self._params = host_params
+                self._version = version
+                self._applied_seq = seq
 
     def publish(self, params: Any, version: int) -> None:
         """Store a host-side snapshot of `params` (device arrays -> numpy)."""
-        host_params = jax.tree.map(np.asarray, params)
-        with self._lock:
-            self._params = host_params
-            self._version = version
+        self._apply(jax.tree.map(np.asarray, params), version, self._next_seq())
+
+    def publish_async(self, params: Any, version: int) -> None:
+        """Versioned publish off the caller's critical path.
+
+        Snapshots `params` with an on-device copy first — the learner
+        donates its TrainState buffers into the next step, so the worker
+        cannot safely read the originals later — then hands the D2H
+        transfer + store to a single background worker. Latest submit
+        wins: under a burst, intermediate versions may never become
+        visible, which is exactly the semantics actors already have
+        (they poll `get_if_newer`, not every version). After close(),
+        falls back to a synchronous publish rather than losing the item.
+        """
+        import jax.numpy as jnp
+
+        snap = jax.tree.map(jnp.copy, params)  # async device-side copy
+        with self._async_lock:
+            if self._closed:
+                closed = True
+            else:
+                closed = False
+                self._seq += 1
+                self._pending = (snap, version, self._seq)
+                if self._worker is None:
+                    self._worker = threading.Thread(
+                        target=self._drain, daemon=True, name="weights-publish")
+                    self._worker.start()
+        if closed:
+            self.publish(params, version)
+            return
+        self._work.set()
+
+    def _drain(self) -> None:
+        while True:
+            self._work.wait(timeout=0.5)
+            with self._async_lock:
+                item, self._pending = self._pending, None
+                self._work.clear()
+                if item is None:
+                    if self._closed:
+                        return
+                    continue
+                self._busy = True
+            try:
+                snap, version, seq = item
+                # np.asarray here = the D2H wait, off the learn thread.
+                self._apply(jax.tree.map(np.asarray, snap), version, seq)
+            finally:
+                with self._async_lock:
+                    self._busy = False
+
+    def flush_async(self, timeout: float = 30.0) -> bool:
+        """Block until every pending async publish has landed."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._async_lock:
+                if self._pending is None and not self._busy:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        self.flush_async()
+        with self._async_lock:
+            self._closed = True
+        self._work.set()
 
     @property
     def version(self) -> int:

@@ -240,3 +240,111 @@ def test_put_trajectory_busy_timeout():
     finally:
         server.stop()
         client.close()
+
+
+class TestAsyncPublish:
+    """publish_async: latest-wins background D2H + store (weights.py)."""
+
+    def test_lands_and_flushes(self):
+        import jax.numpy as jnp
+
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        ws = WeightStore()
+        params = {"w": jnp.arange(4.0)}
+        ws.publish_async(params, 1)
+        assert ws.flush_async(timeout=10.0)
+        got, version = ws.get()
+        assert version == 1
+        np.testing.assert_array_equal(got["w"], np.arange(4.0))
+        ws.close()
+
+    def test_latest_wins_and_monotonic(self):
+        import jax.numpy as jnp
+
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        ws = WeightStore()
+        for v in range(1, 30):
+            ws.publish_async({"w": jnp.full((8,), float(v))}, v)
+        assert ws.flush_async(timeout=10.0)
+        got, version = ws.get()
+        assert version == 29
+        np.testing.assert_array_equal(got["w"], np.full((8,), 29.0))
+        # A LATER submit with a lower version (checkpoint rollback) wins:
+        # arbitration is submission order, not version order.
+        ws.publish({"w": jnp.zeros((8,))}, 3)
+        assert ws.version == 3
+        ws.close()
+
+    def test_snapshot_survives_source_deletion(self):
+        """The on-device copy means later donation/deletion of the source
+        buffer cannot corrupt what actors receive. `delete()` is the real
+        invalidation (what donation does): without the jnp.copy in
+        publish_async, the worker's D2H of a deleted buffer raises and
+        the publish is lost."""
+        import jax.numpy as jnp
+
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        ws = WeightStore()
+        src = jnp.ones((1024,))
+        ws.publish_async({"w": src}, 1)
+        src.delete()  # donation analogue: buffer is gone
+        assert ws.flush_async(timeout=10.0)
+        got, version = ws.get()
+        assert version == 1
+        np.testing.assert_array_equal(got["w"], np.ones((1024,)))
+        ws.close()
+
+    def test_publish_after_close_falls_back_sync(self):
+        import jax.numpy as jnp
+
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        ws = WeightStore()
+        ws.publish_async({"w": jnp.zeros((4,))}, 1)
+        ws.close()
+        ws.publish_async({"w": jnp.ones((4,))}, 2)  # lands synchronously
+        got, version = ws.get()
+        assert version == 2
+        np.testing.assert_array_equal(got["w"], np.ones((4,)))
+
+    def test_rollback_republish_wins(self):
+        """Checkpoint restore republishes at an OLDER step; the store
+        must follow the rollback (last submit wins, not highest version)."""
+        import jax.numpy as jnp
+
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        ws = WeightStore()
+        ws.publish({"w": jnp.full((4,), 100.0)}, 100)
+        ws.publish({"w": jnp.full((4,), 60.0)}, 60)  # restore_checkpoint
+        got, version = ws.get()
+        assert version == 60
+        np.testing.assert_array_equal(got["w"], np.full((4,), 60.0))
+        ws.close()
+
+    def test_learner_async_publish_e2e(self, monkeypatch):
+        """DRL_ASYNC_PUBLISH=1 through a real IMPALA learner loop."""
+        import jax
+
+        from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+        from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+        from distributed_reinforcement_learning_tpu.envs.cartpole import VectorCartPole
+        from distributed_reinforcement_learning_tpu.runtime import impala_runner
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        monkeypatch.setenv("DRL_ASYNC_PUBLISH", "1")
+        cfg = ImpalaConfig(obs_shape=(4,), num_actions=2, trajectory=8, lstm_size=32)
+        agent = ImpalaAgent(cfg)
+        queue = TrajectoryQueue(capacity=64)
+        weights = WeightStore()
+        learner = impala_runner.ImpalaLearner(
+            agent, queue, weights, batch_size=8, rng=jax.random.PRNGKey(0))
+        env = VectorCartPole(num_envs=8, seed=0)
+        actor = impala_runner.ImpalaActor(agent, env, queue, weights, seed=1)
+        result = impala_runner.run_sync(learner, [actor], num_updates=10)
+        assert weights.flush_async(timeout=10.0)
+        assert weights.version == 10
+        assert np.isfinite(result["last_metrics"]["total_loss"])
