@@ -69,3 +69,29 @@ params, version = weights.get()
 assert version == 3
 assert all(np.all(np.isfinite(x)) for x in jax.tree.leaves(params))
 print(f"RESULT {pid} weights_ok {float(jax.tree.leaves(params)[0].ravel()[0]):.6f}", flush=True)
+
+# Sequence parallelism across processes: the ring's ppermute now crosses
+# the process boundary (the DCN analogue). One xformer learn step over a
+# (data=4, seq=2) global mesh; the losses must again agree everywhere.
+from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent, XformerConfig
+from distributed_reinforcement_learning_tpu.parallel import ShardedLearner
+from distributed_reinforcement_learning_tpu.parallel.mesh import place_local_batch, data_sharding
+from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_xformer_batch
+
+xcfg = XformerConfig(obs_shape=(2,), num_actions=2, seq_len=8, burn_in=2,
+                     d_model=32, num_heads=2, num_layers=1, attention="ring")
+sp_mesh = make_mesh(devices=jax.devices(), seq_parallel=2)
+xagent = XformerAgent(xcfg, mesh=sp_mesh)
+xlearner = ShardedLearner(xagent, sp_mesh, num_data_args=2, num_aux_outputs=2)
+xstate = xlearner.init_state(jax.random.PRNGKey(0))
+GLOBAL_XB = 8
+local, w_local = synthetic_xformer_batch(
+    GLOBAL_XB // jax.process_count(), xcfg.seq_len, xcfg.obs_shape,
+    xcfg.num_actions, seed=2000 + pid)
+sharding = data_sharding(sp_mesh)
+batch = place_local_batch(local, sharding)
+w = place_local_batch(np.asarray(w_local), sharding)
+xstate, pri, xm = xlearner.learn(xstate, batch, w)
+jax.block_until_ready(xstate)
+assert np.all(np.isfinite(np.asarray(pri)))
+print(f"RESULT {pid} xformer_sp {float(xm['loss']):.6f}", flush=True)

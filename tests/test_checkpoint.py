@@ -297,3 +297,38 @@ def test_replay_snapshot_disabled_by_env(tmp_path, monkeypatch):
     assert encode_replay_snapshot(replay) is None  # over size cap
     monkeypatch.setenv("DRL_CKPT_REPLAY_MAX_MB", "512")
     assert encode_replay_snapshot(replay) is not None
+
+
+def test_xformer_kill_and_resume_keeps_replay(tmp_path):
+    """The transformer family rides the same checkpoint/replay-snapshot
+    machinery (its learner IS the R2D2 learner); XformerBatch payloads
+    must roundtrip through the snapshot codec."""
+    from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent, XformerConfig
+    from distributed_reinforcement_learning_tpu.runtime import xformer_runner
+    from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+    cfg = XformerConfig(obs_shape=(2,), num_actions=2, seq_len=8, burn_in=2,
+                        d_model=32, num_heads=2, num_layers=1, learning_rate=1e-3)
+    agent = XformerAgent(cfg)
+    queue = TrajectoryQueue(capacity=128)
+    weights = WeightStore()
+    learner = xformer_runner.XformerLearner(
+        agent, queue, weights, batch_size=8, replay_capacity=500,
+        target_sync_interval=50, rng=jax.random.PRNGKey(0))
+    env = VectorCartPole(num_envs=8, seed=0)
+    actor = xformer_runner.XformerActor(
+        agent, env, queue, weights, seed=1, obs_transform=pomdp_project)
+    xformer_runner.run_sync(learner, [actor], num_updates=8)
+    size_before = len(learner.replay)
+    assert size_before >= 16
+
+    learner.save_checkpoint(Checkpointer(tmp_path))
+
+    learner2 = xformer_runner.XformerLearner(
+        XformerAgent(cfg), TrajectoryQueue(capacity=128), WeightStore(), batch_size=8,
+        replay_capacity=500, target_sync_interval=50, rng=jax.random.PRNGKey(9))
+    assert learner2.restore_checkpoint(Checkpointer(tmp_path))
+    assert len(learner2.replay) == size_before
+    assert learner2.train_steps == learner.train_steps
+    m = learner2.train()
+    assert m is not None and np.isfinite(m["loss"])
