@@ -219,71 +219,6 @@ def test_replay_snapshot_roundtrip(backend):
         np.testing.assert_array_equal(a["x"], b["x"])
 
 
-def test_apex_kill_and_resume_keeps_replay(tmp_path):
-    """A restarted Ape-X learner resumes with its replay contents and
-    priorities intact (VERDICT r1 Missing #4): the new learner can train
-    immediately instead of waiting on stale-policy actor re-samples."""
-    from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
-
-    cfg = ApexConfig(obs_shape=(4,), num_actions=2, start_learning_rate=1e-3)
-    agent = ApexAgent(cfg)
-    queue = TrajectoryQueue(capacity=64)
-    weights = WeightStore()
-    learner = apex_runner.ApexLearner(
-        agent, queue, weights, batch_size=16, replay_capacity=1_000,
-        target_sync_interval=50, rng=jax.random.PRNGKey(0))
-    env = VectorCartPole(num_envs=8, seed=0)
-    actor = apex_runner.ApexActor(
-        agent, env, queue, weights, seed=1, unroll_size=16, local_capacity=500)
-    apex_runner.run_sync(learner, [actor], num_updates=12)
-    size_before = len(learner.replay)
-    total_before = learner.replay.tree.total
-    assert size_before > 100
-
-    learner.save_checkpoint(Checkpointer(tmp_path))
-
-    # "Kill": a fresh learner process restores from disk.
-    learner2 = apex_runner.ApexLearner(
-        ApexAgent(cfg), TrajectoryQueue(capacity=64), WeightStore(), batch_size=16,
-        replay_capacity=1_000, target_sync_interval=50, rng=jax.random.PRNGKey(9))
-    assert learner2.restore_checkpoint(Checkpointer(tmp_path))
-    assert len(learner2.replay) == size_before
-    np.testing.assert_allclose(learner2.replay.tree.total, total_before, rtol=1e-9)
-    assert learner2.train_steps == learner.train_steps
-    # Trains immediately from the restored buffer, no re-warm-up.
-    m = learner2.train()
-    assert m is not None and np.isfinite(m["loss"])
-
-
-def test_r2d2_kill_and_resume_keeps_replay(tmp_path):
-    from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
-
-    cfg = R2D2Config(obs_shape=(2,), num_actions=2, seq_len=8, burn_in=2,
-                     lstm_size=32, learning_rate=1e-3)
-    agent = R2D2Agent(cfg)
-    queue = TrajectoryQueue(capacity=128)
-    weights = WeightStore()
-    learner = r2d2_runner.R2D2Learner(
-        agent, queue, weights, batch_size=8, replay_capacity=500,
-        target_sync_interval=50, rng=jax.random.PRNGKey(0))
-    env = VectorCartPole(num_envs=8, seed=0)
-    actor = r2d2_runner.R2D2Actor(
-        agent, env, queue, weights, seed=1, obs_transform=pomdp_project)
-    r2d2_runner.run_sync(learner, [actor], num_updates=8)
-    size_before = len(learner.replay)
-    assert size_before >= 16
-
-    learner.save_checkpoint(Checkpointer(tmp_path))
-
-    learner2 = r2d2_runner.R2D2Learner(
-        R2D2Agent(cfg), TrajectoryQueue(capacity=128), WeightStore(), batch_size=8,
-        replay_capacity=500, target_sync_interval=50, rng=jax.random.PRNGKey(9))
-    assert learner2.restore_checkpoint(Checkpointer(tmp_path))
-    assert len(learner2.replay) == size_before
-    m = learner2.train()
-    assert m is not None and np.isfinite(m["loss"])
-
-
 def test_replay_snapshot_disabled_by_env(tmp_path, monkeypatch):
     from distributed_reinforcement_learning_tpu.data.replay import make_replay
     from distributed_reinforcement_learning_tpu.utils.checkpoint import encode_replay_snapshot
@@ -299,36 +234,64 @@ def test_replay_snapshot_disabled_by_env(tmp_path, monkeypatch):
     assert encode_replay_snapshot(replay) is not None
 
 
-def test_xformer_kill_and_resume_keeps_replay(tmp_path):
-    """The transformer family rides the same checkpoint/replay-snapshot
-    machinery (its learner IS the R2D2 learner); XformerBatch payloads
-    must roundtrip through the snapshot codec."""
+def _replay_family(name):
+    """(make_learner, make_actor, run_sync, updates, min_size) per family."""
+    if name == "apex":
+        cfg = ApexConfig(obs_shape=(4,), num_actions=2, start_learning_rate=1e-3)
+        make_learner = lambda rng: apex_runner.ApexLearner(
+            ApexAgent(cfg), TrajectoryQueue(capacity=64), WeightStore(),
+            batch_size=16, replay_capacity=1_000, target_sync_interval=50, rng=rng)
+        make_actor = lambda lrn: apex_runner.ApexActor(
+            lrn.agent, VectorCartPole(num_envs=8, seed=0), lrn.queue, lrn.weights,
+            seed=1, unroll_size=16, local_capacity=500)
+        return make_learner, make_actor, apex_runner.run_sync, 12, 101
+    if name == "r2d2":
+        cfg = R2D2Config(obs_shape=(2,), num_actions=2, seq_len=8, burn_in=2,
+                         lstm_size=32, learning_rate=1e-3)
+        make_learner = lambda rng: r2d2_runner.R2D2Learner(
+            R2D2Agent(cfg), TrajectoryQueue(capacity=128), WeightStore(),
+            batch_size=8, replay_capacity=500, target_sync_interval=50, rng=rng)
+        make_actor = lambda lrn: r2d2_runner.R2D2Actor(
+            lrn.agent, VectorCartPole(num_envs=8, seed=0), lrn.queue, lrn.weights,
+            seed=1, obs_transform=pomdp_project)
+        return make_learner, make_actor, r2d2_runner.run_sync, 8, 16
     from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent, XformerConfig
     from distributed_reinforcement_learning_tpu.runtime import xformer_runner
-    from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
 
     cfg = XformerConfig(obs_shape=(2,), num_actions=2, seq_len=8, burn_in=2,
                         d_model=32, num_heads=2, num_layers=1, learning_rate=1e-3)
-    agent = XformerAgent(cfg)
-    queue = TrajectoryQueue(capacity=128)
-    weights = WeightStore()
-    learner = xformer_runner.XformerLearner(
-        agent, queue, weights, batch_size=8, replay_capacity=500,
-        target_sync_interval=50, rng=jax.random.PRNGKey(0))
-    env = VectorCartPole(num_envs=8, seed=0)
-    actor = xformer_runner.XformerActor(
-        agent, env, queue, weights, seed=1, obs_transform=pomdp_project)
-    xformer_runner.run_sync(learner, [actor], num_updates=8)
+    make_learner = lambda rng: xformer_runner.XformerLearner(
+        XformerAgent(cfg), TrajectoryQueue(capacity=128), WeightStore(),
+        batch_size=8, replay_capacity=500, target_sync_interval=50, rng=rng)
+    make_actor = lambda lrn: xformer_runner.XformerActor(
+        lrn.agent, VectorCartPole(num_envs=8, seed=0), lrn.queue, lrn.weights,
+        seed=1, obs_transform=pomdp_project)
+    return make_learner, make_actor, xformer_runner.run_sync, 8, 16
+
+
+@pytest.mark.parametrize("family", ["apex", "r2d2", "xformer"])
+def test_kill_and_resume_keeps_replay(family, tmp_path):
+    """A restarted learner of EVERY replay family resumes with its replay
+    contents and priorities intact (VERDICT r1 Missing #4): it can train
+    immediately instead of waiting on stale-policy actor re-samples."""
+    from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+    make_learner, make_actor, run_sync, updates, min_size = _replay_family(family)
+    learner = make_learner(jax.random.PRNGKey(0))
+    actor = make_actor(learner)
+    run_sync(learner, [actor], num_updates=updates)
     size_before = len(learner.replay)
-    assert size_before >= 16
+    total_before = learner.replay.tree.total
+    assert size_before >= min_size
 
     learner.save_checkpoint(Checkpointer(tmp_path))
 
-    learner2 = xformer_runner.XformerLearner(
-        XformerAgent(cfg), TrajectoryQueue(capacity=128), WeightStore(), batch_size=8,
-        replay_capacity=500, target_sync_interval=50, rng=jax.random.PRNGKey(9))
+    # "Kill": a fresh learner process restores from disk.
+    learner2 = make_learner(jax.random.PRNGKey(9))
     assert learner2.restore_checkpoint(Checkpointer(tmp_path))
     assert len(learner2.replay) == size_before
+    np.testing.assert_allclose(learner2.replay.tree.total, total_before, rtol=1e-9)
     assert learner2.train_steps == learner.train_steps
+    # Trains immediately from the restored buffer, no re-warm-up.
     m = learner2.train()
     assert m is not None and np.isfinite(m["loss"])

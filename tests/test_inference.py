@@ -145,6 +145,29 @@ class TestInferenceServer:
         finally:
             server.stop()
 
+    def test_xformer_adapter(self):
+        """Window-shaped rows: the transformer's recurrent state IS the
+        rolling window, so the act request carries [n, W, ...] arrays."""
+        from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent, XformerConfig
+
+        agent = XformerAgent(XformerConfig(obs_shape=(2,), num_actions=2, seq_len=6,
+                                           burn_in=2, d_model=32, num_heads=2,
+                                           num_layers=1))
+        weights = WeightStore()
+        weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+        server = InferenceServer.for_agent("xformer", agent, weights, max_wait_ms=1.0)
+        try:
+            out = server.submit({
+                "obs": np.random.default_rng(3).integers(0, 255, (3, 6, 2)).astype(np.int32),
+                "prev_action": np.zeros((3, 6), np.int32),
+                "done": np.ones((3, 6), bool),
+                "epsilon": np.zeros(3, np.float32),
+            })
+            assert out["action"].shape == (3,)
+            assert out["q"].shape == (3, 2) and np.all(np.isfinite(out["q"]))
+        finally:
+            server.stop()
+
 
 def test_impala_actor_trains_via_remote_act():
     """Full loop over TCP: a remote-act actor (no local weight pulls)
