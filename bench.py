@@ -254,21 +254,23 @@ def bench_r2d2_learn(B: int, iters: int) -> dict:
 
 
 def bench_long_context(iters: int) -> dict:
-    """Single-chip long-context attention: blockwise online-softmax vs
-    dense at T=8192 (a dense [T,T] logits matrix is 256MB/head in f32 —
-    the blockwise path is what makes this length trainable at all)."""
+    """Single-chip long-context attention fwd+bwd at T=8192: dense vs
+    blockwise online-softmax vs the fused Pallas flash kernels — plus
+    flash alone at T=32768, a length whose XLA backward (O(T^2) saved
+    probabilities) does not fit HBM at all."""
     import jax
     import jax.numpy as jnp
 
     from distributed_reinforcement_learning_tpu.ops.attention import (
-        blockwise_attention, dense_attention)
+        blockwise_attention, causal_attention, dense_attention)
 
     B, T, H, D = 1, 8192, 4, 64
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (0.2 * jax.random.normal(kk, (B, T, H, D), jnp.bfloat16) for kk in ks)
     out = {}
     for name, fn in (("dense", dense_attention),
-                     ("blockwise", lambda q, k, v: blockwise_attention(q, k, v, block_size=512))):
+                     ("blockwise", lambda q, k, v: blockwise_attention(q, k, v, block_size=512)),
+                     ("flash", lambda q, k, v: causal_attention(q, k, v, backend="pallas"))):
         def loss(q, k, v, _f=fn):
             return jnp.sum(_f(q, k, v).astype(jnp.float32) ** 2)
 
@@ -291,6 +293,29 @@ def bench_long_context(iters: int) -> dict:
         t2 = window(2 * iters, 2)
         us = 1e6 * max(t2 - t1, 0.0) / iters
         out[f"attn_grad_T{T}_{name}_us"] = round(us, 1)
+
+    # T=32k: flash-only (the XLA paths' backward OOMs HBM here).
+    T2 = 32768
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (0.2 * jax.random.normal(kk, (B, T2, H, D), jnp.bfloat16) for kk in ks)
+    g = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(causal_attention(q, k, v, backend="pallas").astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2)))
+
+    def window32(n, seed0):
+        acc = jnp.float32(seed0)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            gs = g(q * (1.0 + 1e-6 * acc), k, v)
+            acc = acc + jnp.sum(gs[0][0, 0, 0]).astype(jnp.float32)
+        float(acc)
+        return time.perf_counter() - t0
+
+    n32 = max(iters // 2, 3)
+    window32(2, 0)
+    t1 = window32(n32, 1)
+    t2 = window32(2 * n32, 2)
+    out[f"attn_grad_T{T2}_flash_us"] = round(1e6 * max(t2 - t1, 0.0) / n32, 1)
     print(f"[bench] long-context: {out}", file=sys.stderr)
     return out
 

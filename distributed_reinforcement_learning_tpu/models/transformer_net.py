@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_reinforcement_learning_tpu.models.torso import ActionEmbedding
-from distributed_reinforcement_learning_tpu.ops.attention import dense_attention
+from distributed_reinforcement_learning_tpu.ops.attention import causal_attention
 
 _glorot = nn.initializers.xavier_uniform()
 
@@ -88,7 +88,9 @@ class SelfAttentionBlock(nn.Module):
         if self.attention_fn is not None:
             out = self.attention_fn(q, k, v, segs)
         else:
-            out = dense_attention(q, k, v, causal=True, q_seg=segs, k_seg=segs)
+            # Backend-dispatched: Pallas flash kernels on TPU when the
+            # shape qualifies, dense/blockwise XLA otherwise.
+            out = causal_attention(q, k, v, q_seg=segs, k_seg=segs)
         out = out.reshape(b, t, self.d_model).astype(self.dtype)
         x = x + nn.Dense(self.d_model, kernel_init=_glorot, dtype=self.dtype)(out)
 

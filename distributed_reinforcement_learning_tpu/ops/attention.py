@@ -137,6 +137,55 @@ def attention_block_finish(acc, dtype) -> jax.Array:
     return (o / denom.transpose(0, 2, 1)[..., None]).astype(dtype)
 
 
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_seg: jax.Array | None = None,
+    k_seg: jax.Array | None = None,
+    backend: str = "auto",
+) -> jax.Array:
+    """Causal (optionally segment-masked) MHA with backend dispatch.
+
+    `auto` resolves to the fused Pallas flash kernels on TPU
+    (`ops/pallas/attention.py`) when T divides by a >=8 power-of-two
+    block — VMEM holds only per-block operands, so T is HBM-bound —
+    else to plain dense softmax for short sequences or the blockwise
+    online-softmax path for long ones. All paths share the same
+    numerics contract (validated against dense in tests).
+    """
+    from distributed_reinforcement_learning_tpu.ops.pallas import resolve_backend
+    from distributed_reinforcement_learning_tpu.ops.pallas.attention import flash_blocks
+
+    if (q_seg is None) != (k_seg is None):
+        raise ValueError("q_seg and k_seg must be provided together")
+    b, t, h, d = q.shape
+    resolved = resolve_backend(backend)
+    block = flash_blocks(t)
+    if resolved in ("pallas", "pallas_interpret") and block > 0:
+        from distributed_reinforcement_learning_tpu.ops.pallas.attention import (
+            flash_attention_bhtd)
+
+        zeros = jnp.zeros((b, t), jnp.int32)
+        qs = zeros if q_seg is None else q_seg.astype(jnp.int32)
+        ks = zeros if k_seg is None else k_seg.astype(jnp.int32)
+        flat = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        seg_flat = lambda s: jnp.repeat(s, h, axis=0)
+        out = flash_attention_bhtd(
+            flat(q), flat(k), flat(v), seg_flat(qs), seg_flat(ks),
+            block_q=min(block, 128), block_kv=min(block, 128),
+            interpret=(resolved == "pallas_interpret"),
+        )
+        return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    if t <= 1024:
+        return dense_attention(q, k, v, causal=True, q_seg=q_seg, k_seg=k_seg)
+    return blockwise_attention(
+        q, k, v, causal=True, block_size=512,
+        segment_ids=q_seg, kv_segment_ids=k_seg,
+    )
+
+
 def blockwise_attention(
     q: jax.Array,
     k: jax.Array,
@@ -145,13 +194,15 @@ def blockwise_attention(
     causal: bool = True,
     block_size: int = 512,
     segment_ids: jax.Array | None = None,
+    kv_segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Single-device attention computed block-by-block over keys.
 
     Memory is O(T·block) instead of O(T²) — the long-context path when a
     full logits matrix would blow HBM. Same numerics core as ring
     attention; used as its single-device functional test double.
-    `segment_ids` `[B, T]` optionally confines attention within episodes.
+    `segment_ids` `[B, Tq]` optionally confines attention within
+    episodes (`kv_segment_ids` defaults to it for self-attention).
     """
     t_kv = k.shape[1]
     block_size = min(block_size, t_kv)
@@ -161,10 +212,11 @@ def blockwise_attention(
     q_pos = jnp.arange(q.shape[1])
     kb = k.reshape(k.shape[0], n_blocks, block_size, *k.shape[2:])
     vb = v.reshape(v.shape[0], n_blocks, block_size, *v.shape[2:])
+    kv_seg = segment_ids if kv_segment_ids is None else kv_segment_ids
     segb = (
         None
-        if segment_ids is None
-        else segment_ids.reshape(segment_ids.shape[0], n_blocks, block_size)
+        if kv_seg is None
+        else kv_seg.reshape(kv_seg.shape[0], n_blocks, block_size)
     )
 
     def step(acc, blk):

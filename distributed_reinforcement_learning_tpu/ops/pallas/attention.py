@@ -1,0 +1,342 @@
+"""Fused flash-attention TPU kernels: causal, segment-masked MHA.
+
+The transformer family's hot op (models/transformer_net.py). The XLA
+paths in ops/attention.py materialize [Tq, Tkv] probability blocks in
+HBM between the softmax and the PV matmul; these kernels keep the whole
+online-softmax recurrence in VMEM per query block — one launch per
+(batch*head, q-block) instead of a scan of fused-but-HBM-roundtripping
+block steps.
+
+Layout: inputs are flattened to `[BH, T, D]` (batch*heads leading); the
+grid is (BH, q-blocks, kv-blocks) with ONLY one block of each operand
+VMEM-resident per step (online-softmax / gradient accumulators live in
+scratch across the innermost kv/q walk), so T is bounded by HBM, not by
+the 16MB scoped VMEM — a whole-K/V-resident design capped out at T~8k.
+Per-row vectors (segment ids, logsumexp, delta) travel as `[BH, T, 1]`
+so their blocks satisfy the TPU (8, 128)-tiling rule on the last two
+dims. Segment ids confine attention within episodes exactly like the
+XLA paths; "no segments" is the all-zeros id vector (same segment
+everywhere), so one kernel serves both cases.
+
+Backward follows the standard flash decomposition: the forward saves
+only (out, logsumexp); dq and (dk, dv) are two kernels that recompute
+the probabilities from q/k/lse, using the precomputed per-row
+`delta = rowsum(dout * out)` (a cheap XLA reduction outside).
+
+Numerics are validated against `ops/attention.dense_attention` (values
+and grads) in interpret mode on CPU and on TPU by tests/bench.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_reinforcement_learning_tpu.ops.attention import _MASK_VALUE as _NEG
+
+_BLOCK_Q = 128
+_BLOCK_KV = 128
+
+
+def _pos(start, rows, cols, axis):
+    """2-D position grid [rows, cols] counting along `axis` from `start`."""
+    return start + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), axis)
+
+
+def _block_mask(iq_start, jk_start, bq, bkv, qs, ks_row):
+    """[bq, bkv] causal & same-segment mask.
+
+    qs: [bq, 1] query segment ids; ks_row: [1, bkv] key segment ids.
+    """
+    causal = _pos(iq_start, bq, bkv, 0) >= _pos(jk_start, bq, bkv, 1)
+    return causal & (qs == ks_row)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr):
+    """Grid (BH, nq, nkv): kv is a GRID axis (one k/v block VMEM-resident
+    at a time — a full [T, D] K/V residency caps T at ~8k), with the
+    online-softmax state in scratch across the inner kv walk; o/lse
+    blocks revisit and flush on the last contributing step."""
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bkv = k_ref.shape[1]
+    scale = q_ref.shape[2] ** -0.5
+
+    @pl.when(jk == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    last = ((iq + 1) * bq - 1) // bkv  # last kv block this q block attends
+
+    @pl.when(jk <= last)
+    def _():
+        q = q_ref[0]
+        qs = qs_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        ks_row = ks_ref[0].reshape(1, bkv)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        msk = _block_mask(iq * bq, jk * bkv, bq, bkv, qs, ks_row)
+        s = jnp.where(msk, s, _NEG)
+        m = m_scr[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(msk, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jk == pl.num_programs(2) - 1)
+    def _():
+        l_safe = jnp.maximum(l_scr[:], jnp.finfo(jnp.float32).tiny)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l_safe)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, qs_ref, ks_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr):
+    """Grid (BH, nq, nkv), kv walked by the grid; dq accumulates in
+    scratch and flushes on the last step (same shape as _fwd_kernel)."""
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bkv = k_ref.shape[1]
+    scale = q_ref.shape[2] ** -0.5
+
+    @pl.when(jk == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    last = ((iq + 1) * bq - 1) // bkv
+
+    @pl.when(jk <= last)
+    def _():
+        q = q_ref[0]
+        qs = qs_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        ks_row = ks_ref[0].reshape(1, bkv)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        msk = _block_mask(iq * bq, jk * bkv, bq, bkv, qs, ks_row)
+        p = jnp.where(msk, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jk == pl.num_programs(2) - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, qs_ref, ks_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int):
+    """Grid (BH, nk, nq): the q axis is a GRID dimension, not an
+    in-kernel loop, so only one q/do block is VMEM-resident at a time
+    (a full [T, D] q + do residency overflowed scoped VMEM at T=8192).
+    dk/dv accumulate in scratch across the inner q walk — the (b, jk)
+    output blocks revisit — and flush on the last q step."""
+    jk = pl.program_id(1)
+    iq = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    bkv = k_ref.shape[1]
+    scale = k_ref.shape[2] ** -0.5
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # Causal: q blocks strictly before this kv block are fully masked.
+    @pl.when(iq * block_q + block_q > jk * bkv)
+    def _():
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        ks_row = ks_ref[0].reshape(1, bkv)
+        q_i = q_ref[0]
+        do_i = do_ref[0].astype(jnp.float32)
+        lse_i = lse_ref[0]
+        delta_i = delta_ref[0]
+        qs_i = qs_ref[0]
+        s = jax.lax.dot_general(
+            q_i, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        msk = _block_mask(iq * block_q, jk * bkv, block_q, bkv, qs_i, ks_row)
+        p = jnp.where(msk, jnp.exp(s - lse_i), 0.0)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do_i, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_i, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_i) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q_i.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == n_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _qkv_specs(d: int, bq: int, bkv: int):
+    """3-D-grid (b, i_q, j_kv) block specs: q-indexed, kv-indexed rows.
+
+    The kv index is CLAMPED to the last causally-visible block for the
+    current q block: past it the index map repeats the same block, which
+    Pallas recognizes as a revisit and does not re-DMA — the ~half of
+    the rectangular grid that is fully future-masked (compute skipped by
+    pl.when in the kernels) costs no HBM traffic either.
+    """
+
+    def jcap(i, j):
+        return jnp.minimum(j, ((i + 1) * bq - 1) // bkv)
+
+    q3 = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
+    qrow3 = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
+    kv3 = pl.BlockSpec(
+        (1, bkv, d), lambda b, i, j: (b, jcap(i, j), 0), memory_space=pltpu.VMEM)
+    krow3 = pl.BlockSpec(
+        (1, bkv, 1), lambda b, i, j: (b, jcap(i, j), 0), memory_space=pltpu.VMEM)
+    return q3, qrow3, kv3, krow3
+
+
+def _fwd_call(q, k, v, qs, ks, bq, bkv, interpret):
+    bh, t, d = q.shape
+    q3, qrow3, kv3, krow3 = _qkv_specs(d, bq, bkv)
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=(bh, t // bq, t // bkv),
+        in_specs=[q3, kv3, kv3, qrow3, krow3],
+        out_specs=[q3, qrow3],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, qs, ks)
+
+
+def _bwd_call(q, k, v, qs, ks, do, lse, delta, bq, bkv, interpret):
+    bh, t, d = q.shape
+    q3, qrow3, kv3, krow3 = _qkv_specs(d, bq, bkv)
+    dq = pl.pallas_call(
+        _dq_kernel,
+        grid=(bh, t // bq, t // bkv),
+        in_specs=[q3, kv3, kv3, qrow3, krow3, q3, qrow3, qrow3],
+        out_specs=[q3],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, qs, ks, do, lse, delta)[0]
+    # 3-D grid: kv blocks indexed by j (middle), q/do blocks by the
+    # innermost iq axis; dk/dv blocks revisit across iq. The q index is
+    # clamped to the first causally-contributing block for this kv block
+    # (skipped early steps revisit it — no re-DMA, compute pl.when'd off).
+    def icap(j, i):
+        return jnp.maximum(i, (j * bkv) // bq)
+
+    kv3 = pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM)
+    krow3 = pl.BlockSpec((1, bkv, 1), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM)
+    q3 = pl.BlockSpec(
+        (1, bq, d), lambda b, j, i: (b, icap(j, i), 0), memory_space=pltpu.VMEM)
+    qrow3 = pl.BlockSpec(
+        (1, bq, 1), lambda b, j, i: (b, icap(j, i), 0), memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=bq),
+        grid=(bh, t // bkv, t // bq),
+        in_specs=[q3, kv3, kv3, qrow3, krow3, q3, qrow3, qrow3],
+        out_specs=[kv3, kv3],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bkv, d), jnp.float32),
+            pltpu.VMEM((bkv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, qs, ks, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.cache
+def _make_flash(bq: int, bkv: int, interpret: bool):
+    @jax.custom_vjp
+    def f(q, k, v, qs, ks):
+        out, _ = _fwd_call(q, k, v, qs, ks, bq, bkv, interpret)
+        return out
+
+    def f_fwd(q, k, v, qs, ks):
+        out, lse = _fwd_call(q, k, v, qs, ks, bq, bkv, interpret)
+        return out, (q, k, v, qs, ks, out, lse)
+
+    def f_bwd(res, do):
+        q, k, v, qs, ks, out, lse = res
+        delta = jnp.sum(
+            do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
+        dq, dk, dv = _bwd_call(q, k, v, qs, ks, do, lse, delta, bq, bkv, interpret)
+        return dq, dk, dv, None, None
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def flash_attention_bhtd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_seg: jax.Array,
+    k_seg: jax.Array,
+    block_q: int = _BLOCK_Q,
+    block_kv: int = _BLOCK_KV,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal flash attention on `[BH, T, D]` with `[BH, T]` segment ids.
+
+    T must divide by both block sizes (choose blocks via
+    `flash_blocks`); differentiable via the fused dq/dkv kernels.
+    """
+    bh, t, d = q.shape
+    if t % block_q or t % block_kv:
+        raise ValueError(f"T={t} not divisible by blocks ({block_q}, {block_kv})")
+    f = _make_flash(block_q, block_kv, interpret)
+    return f(q, k, v,
+             q_seg.astype(jnp.int32).reshape(bh, t, 1),
+             k_seg.astype(jnp.int32).reshape(bh, t, 1))
+
+
+def flash_blocks(t: int, cap: int = _BLOCK_Q) -> int:
+    """Largest power-of-two block <= cap dividing t (>= 8), or 0 if none."""
+    b = cap
+    while b >= 8:
+        if t % b == 0:
+            return b
+        b //= 2
+    return 0

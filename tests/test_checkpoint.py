@@ -255,6 +255,8 @@ def _replay_family(name):
             lrn.agent, VectorCartPole(num_envs=8, seed=0), lrn.queue, lrn.weights,
             seed=1, obs_transform=pomdp_project)
         return make_learner, make_actor, r2d2_runner.run_sync, 8, 16
+    if name != "xformer":
+        raise ValueError(f"unknown replay family {name!r}")
     from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent, XformerConfig
     from distributed_reinforcement_learning_tpu.runtime import xformer_runner
 
