@@ -253,6 +253,41 @@ def bench_r2d2_learn(B: int, iters: int) -> dict:
     return {"B": B, "frames_per_s": round(fps, 1), "step_ms": round(1e3 * step_s, 3)}
 
 
+def bench_apex_learn(B: int, iters: int) -> dict:
+    """Ape-X learn-step throughput (transitions/s) at the reference's
+    Breakout conv workload (`config.json:68-106`): double-DQN fwd x3
+    (main s, main s', target s') + backward on the dueling conv net."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexConfig
+    from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_apex_batch
+
+    cfg = ApexConfig()
+    agent = ApexAgent(cfg)
+    state = agent.init_state(jax.random.PRNGKey(0))
+    batch, w = synthetic_apex_batch(B, cfg.obs_shape, cfg.num_actions)
+    batch = jax.device_put(jax.tree.map(jnp.asarray, batch))
+    w = jax.device_put(jnp.asarray(w))
+
+    def window(state, n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, td, metrics = agent.learn(state, batch, w)
+        loss = float(metrics["loss"])
+        return state, time.perf_counter() - t0, loss
+
+    state, _, _ = window(state, 1)  # compile
+    state, _, _ = window(state, max(iters // 4, 5))
+    state, t1, _ = window(state, iters)
+    state, t2, loss = window(state, 2 * iters)
+    step_s = max((t2 - t1) / iters, 1e-9)
+    tps = B / step_s
+    print(f"[bench] apex learn B={B}: {1e3*step_s:.3f}ms/step = {tps:,.0f} transitions/s "
+          f"(loss {loss:.4f})", file=sys.stderr)
+    return {"B": B, "transitions_per_s": round(tps, 1), "step_ms": round(1e3 * step_s, 3)}
+
+
 def bench_long_context(iters: int) -> dict:
     """Single-chip long-context attention fwd+bwd at T=8192: dense vs
     blockwise online-softmax vs the fused Pallas flash kernels — plus
@@ -487,6 +522,15 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["r2d2_learn"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] r2d2 failed: {e}", file=sys.stderr)
+
+    if os.environ.get("BENCH_APEX", "1") == "1":
+        try:
+            extra["apex_learn"] = bench_apex_learn(
+                int(os.environ.get("BENCH_APEX_BATCH", "256")),
+                iters if on_accel else 2)
+        except Exception as e:  # noqa: BLE001
+            extra["apex_learn"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] apex failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_LONG_CONTEXT", "1" if on_accel else "0") == "1":
         try:

@@ -299,6 +299,13 @@ class TestFlashAttention:
         for a, b in zip(g_ref, g_fl):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
+    def test_rejects_lone_segment_arg(self):
+        from distributed_reinforcement_learning_tpu.ops.attention import causal_attention
+
+        q, k, v = _qkv(44)
+        with pytest.raises(ValueError, match="together"):
+            causal_attention(q, k, v, q_seg=jnp.zeros((B, T), jnp.int32))
+
     def test_causal_attention_dispatcher_cpu(self):
         """On CPU auto resolves to the XLA paths; numerics match dense."""
         from distributed_reinforcement_learning_tpu.ops.attention import causal_attention
