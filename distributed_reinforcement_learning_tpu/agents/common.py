@@ -185,19 +185,23 @@ class SequenceReplayLearnMixin:
     """td_error/loss/learn shared by the sequence-replay agents.
 
     Host class provides `_sequence_td(params, target_params, batch)`
-    -> (target_value, sav) and `self.tx`. Loss = IS-weighted mean over
-    time of squared TD (`agent/r2d2.py:88-89`); priority = |mean TD| per
+    -> (target_value, sav) — optionally with a third scalar model aux
+    loss (e.g. the MoE router's load-balancing term), added to the TD
+    loss as-is — and `self.tx`. Loss = IS-weighted mean over time of
+    squared TD (`agent/r2d2.py:88-89`); priority = |mean TD| per
     sequence (`agent/r2d2.py:151-153`).
     """
 
     def _td_error(self, state, batch):
-        tv, sav = self._sequence_td(state.params, state.target_params, batch)
+        tv, sav = self._sequence_td(state.params, state.target_params, batch)[:2]
         return jnp.abs(jnp.mean(tv - sav, axis=1))
 
     def _loss(self, params, target_params, batch, is_weight):
-        tv, sav = self._sequence_td(params, target_params, batch)
+        out = self._sequence_td(params, target_params, batch)
+        tv, sav = out[:2]
+        aux = out[2] if len(out) > 2 else 0.0
         per_seq = jnp.mean(jnp.square(tv - sav), axis=1)
-        loss = jnp.mean(per_seq * is_weight)
+        loss = jnp.mean(per_seq * is_weight) + aux
         priorities = jnp.abs(jnp.mean(tv - sav, axis=1))
         return loss, priorities
 

@@ -59,6 +59,26 @@ class XformerConfig:
     # construction). "ring_zigzag" is the balanced-causal ring: the model
     # holds its residual stream in zigzag layout for the whole forward.
     attention: str = "dense"
+    # Mixture-of-experts MLPs: num_experts > 0 swaps every block's dense
+    # MLP for a routed MoE (`ops/moe.py`); with a mesh whose `expert`
+    # axis > 1 the experts run expert-parallel. The router's
+    # load-balancing loss enters the TD loss scaled by moe_aux_weight.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 1e-2
+    # Pipeline parallelism: with a mesh whose `pipe` axis equals
+    # num_layers, the learn step runs the blocks as GPipe stages
+    # (`parallel/pipeline.py`), one layer per device, splitting each
+    # batch into this many microbatches. Uses the stacked-param body
+    # (dense attention; exclusive with ring/ulysses and MoE).
+    pipeline: bool = False
+    pipeline_microbatches: int = 2
+    # Stacked [num_layers, ...] param layout WITHOUT the pipeline
+    # schedule (plain scan over layers). pipeline=True implies it; set
+    # it alone on actor twins so they share a pipelined learner's
+    # checkpoint/weight layout.
+    stacked: bool = False
 
 
 class XformerBatch(NamedTuple):
@@ -104,7 +124,21 @@ class XformerAgent(common.SequenceReplayLearnMixin):
             )
             if cfg.attention == "ring_zigzag":
                 sequence_perm = sp.zigzag_permutation(cfg.seq_len, mesh.shape[SEQ_AXIS])
-        make_model = lambda fn, perm=None: TransformerQNet(
+        moe_mesh = None
+        if cfg.num_experts and mesh is not None:
+            from distributed_reinforcement_learning_tpu.parallel.mesh import EXPERT_AXIS
+
+            if mesh.shape.get(EXPERT_AXIS, 1) > 1:
+                moe_mesh = mesh
+        pipeline_mesh = None
+        if cfg.pipeline:
+            if mesh is None:
+                raise ValueError("pipeline=True needs a mesh with a 'pipe' axis")
+            if cfg.attention != "dense" or cfg.num_experts:
+                raise ValueError(
+                    "pipeline is exclusive with sequence-parallel attention and MoE")
+            pipeline_mesh = mesh
+        make_model = lambda fn, perm=None, pipe=None, moe_mesh=moe_mesh: TransformerQNet(
             num_actions=cfg.num_actions,
             d_model=cfg.d_model,
             num_heads=cfg.num_heads,
@@ -113,12 +147,26 @@ class XformerAgent(common.SequenceReplayLearnMixin):
             dtype=cfg.dtype,
             attention_fn=fn,
             sequence_perm=perm,
+            num_experts=cfg.num_experts,
+            moe_top_k=cfg.moe_top_k,
+            moe_capacity_factor=cfg.moe_capacity_factor,
+            moe_mesh=moe_mesh,
+            stack_layers=cfg.pipeline or cfg.stacked,
+            pipeline_mesh=pipe,
+            pipeline_microbatches=cfg.pipeline_microbatches,
         )
-        self.model = make_model(attention_fn, sequence_perm)
+        self.model = make_model(attention_fn, sequence_perm, pipeline_mesh)
         # Dense twin over the SAME params: ingest-time priority scoring
         # runs on whatever ragged batch the queue drained, which need not
         # divide the mesh's data axis the way fixed-size learn batches do.
-        self._dense_model = make_model(None) if attention_fn is not None else self.model
+        # (For the pipelined model the twin keeps stack_layers — same
+        # param layout — but applies the stages with the plain scan; for
+        # expert-parallel MoE it drops the sharding constraints.)
+        self._dense_model = (
+            make_model(None, moe_mesh=None)
+            if (attention_fn is not None or pipeline_mesh is not None or moe_mesh is not None)
+            else self.model
+        )
         self.tx = common.adam_with_clip(cfg.learning_rate, clip_norm=None)
         self.act = jax.jit(self._act)
         self.td_error = jax.jit(self._td_error)
@@ -128,12 +176,20 @@ class XformerAgent(common.SequenceReplayLearnMixin):
     def init_state(self, rng: jax.Array) -> common.TargetTrainState:
         t = self.cfg.seq_len
         # With sequence-parallel attention the init forward runs through
-        # shard_map too, so the dummy batch must cover the data axis.
+        # shard_map too, so the dummy batch must cover the data axis —
+        # and the pipelined forward additionally needs each device's
+        # share to split into microbatches.
         b = 1 if self._mesh is None else self._mesh.shape.get("data", 1)
+        if self.cfg.pipeline:
+            b *= self.cfg.pipeline_microbatches
         obs = jnp.zeros((b, t, *self.cfg.obs_shape), jnp.float32)
         pa = jnp.zeros((b, t), jnp.int32)
         done = jnp.zeros((b, t), bool)
-        params = self.model.init(rng, obs, pa, done)
+        variables = self.model.init(rng, obs, pa, done)
+        # Keep only trainables: a MoE forward also sows its aux losses
+        # into a `losses` collection during init, which must not leak
+        # into the optimizer's pytree.
+        params = {"params": variables["params"]}
         return common.TargetTrainState.create(params, self.tx)
 
     # -- act ---------------------------------------------------------------
@@ -143,8 +199,13 @@ class XformerAgent(common.SequenceReplayLearnMixin):
         `obs_win [N, W, *obs]`: the actor's recent history, a window the
         actor maintains host-side — the transformer counterpart of
         carrying (h, c) between steps.
+
+        Acting always runs the plain-apply twin: a rolling window is
+        small and host-local, where the learn step's collective
+        schedules (ring/pipeline shard_maps) are wrong or impossible —
+        same params, same math, no mesh.
         """
-        q_seq = self.model.apply(
+        q_seq = self._dense_model.apply(
             params, common.normalize_obs(obs_win), prev_action_win, done_win)
         q = q_seq[:, -1]
         action = common.epsilon_greedy(q, epsilon, self.cfg.num_actions, rng)
@@ -161,11 +222,22 @@ class XformerAgent(common.SequenceReplayLearnMixin):
         obs = common.normalize_obs(batch.state)
         forward = lambda p: model.apply(p, obs, batch.previous_action, batch.done)
         discounts = (~batch.done).astype(jnp.float32) * cfg.discount_factor
+        if cfg.num_experts:
+            # The online forward collects the MoE routers' sown
+            # load-balancing terms; the target forward doesn't need them.
+            main_q, sown = model.apply(
+                params, obs, batch.previous_action, batch.done, mutable=["losses"])
+            aux = cfg.moe_aux_weight * sum(
+                jnp.asarray(x) for x in jax.tree.leaves(sown.get("losses", {})))
+            tv, sav = common.sequence_double_q_td(
+                main_q, forward(target_params), batch.action, batch.reward,
+                discounts, burn_in=cfg.burn_in, rescale_eps=cfg.rescale_eps)
+            return tv, sav, aux
         return common.sequence_double_q_td(
             forward(params), forward(target_params), batch.action, batch.reward,
             discounts, burn_in=cfg.burn_in, rescale_eps=cfg.rescale_eps)
 
     def _td_error(self, state: common.TargetTrainState, batch: XformerBatch):
         tv, sav = self._sequence_td(
-            state.params, state.target_params, batch, model=self._dense_model)
+            state.params, state.target_params, batch, model=self._dense_model)[:2]
         return jnp.abs(jnp.mean(tv - sav, axis=1))

@@ -71,10 +71,19 @@ def rope(x: jax.Array, positions: jax.Array | None = None, base: float = 10_000.
 
 
 class SelfAttentionBlock(nn.Module):
+    """Pre-LN attention + MLP block; `num_experts > 0` swaps the dense
+    MLP for a mixture-of-experts layer (`ops/moe.py`), with the router's
+    load-balancing aux loss sown into the `losses` collection (a no-op
+    on act paths that don't mark it mutable)."""
+
     d_model: int
     num_heads: int
     dtype: jnp.dtype
     attention_fn: AttentionFn | None
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_mesh: object = None
 
     @nn.compact
     def __call__(self, x: jax.Array, segs: jax.Array, positions: jax.Array | None = None) -> jax.Array:
@@ -95,9 +104,93 @@ class SelfAttentionBlock(nn.Module):
         x = x + nn.Dense(self.d_model, kernel_init=_glorot, dtype=self.dtype)(out)
 
         y = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.num_experts:
+            from distributed_reinforcement_learning_tpu.ops import moe as moe_ops
+
+            # One pytree param via ops/moe.py's own init: shapes and
+            # initializers live in one place; the nested moe_* keys are
+            # what learner.py's expert-sharding path rule matches.
+            p = self.param(
+                "moe",
+                lambda rng: moe_ops.init_moe_params(
+                    rng, self.d_model, 4 * self.d_model, self.num_experts
+                ),
+            )
+            y, aux = moe_ops.moe_mlp(
+                y,
+                p,
+                top_k=self.moe_top_k,
+                capacity_factor=self.moe_capacity_factor,
+                mesh=self.moe_mesh,
+            )
+            self.sow("losses", "moe_aux", aux)
+            return x + y.astype(self.dtype)
         y = nn.Dense(4 * self.d_model, kernel_init=_glorot, dtype=self.dtype)(y)
         y = nn.relu(y)
         return x + nn.Dense(self.d_model, kernel_init=_glorot, dtype=self.dtype)(y)
+
+
+def _stacked_block_init(rng: jax.Array, num_layers: int, d_model: int) -> dict:
+    """[L, ...]-stacked parameters for `_stacked_block_apply`.
+
+    One pytree whose leaves carry a leading layer dimension — the layout
+    `lax.scan`-over-layers and the pipeline schedule both want (and the
+    layout `parallel/learner.py` shards over the `pipe` axis). Stacked
+    with `parallel.pipeline.stack_stage_params` so the init follows the
+    same per-stage rng convention as every other pipelined stack.
+    """
+    d, h = d_model, 4 * d_model
+    glorot = jax.nn.initializers.glorot_uniform()
+
+    def one(rng):
+        ks = jax.random.split(rng, 4)
+        return {
+            "ln1_scale": jnp.ones((d,)),
+            "ln1_bias": jnp.zeros((d,)),
+            "qkv_kernel": glorot(ks[0], (d, 3 * d)),
+            "qkv_bias": jnp.zeros((3 * d,)),
+            "proj_kernel": glorot(ks[1], (d, d)),
+            "proj_bias": jnp.zeros((d,)),
+            "ln2_scale": jnp.ones((d,)),
+            "ln2_bias": jnp.zeros((d,)),
+            "mlp1_kernel": glorot(ks[2], (d, h)),
+            "mlp1_bias": jnp.zeros((h,)),
+            "mlp2_kernel": glorot(ks[3], (h, d)),
+            "mlp2_bias": jnp.zeros((d,)),
+        }
+
+    from distributed_reinforcement_learning_tpu.parallel.pipeline import stack_stage_params
+
+    return stack_stage_params(one, rng, num_layers)
+
+
+def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + 1e-6) * scale + bias
+
+
+def _stacked_block_apply(
+    p: dict, x: jax.Array, segs: jax.Array, *, num_heads: int, dtype
+) -> jax.Array:
+    """One pre-LN transformer block as a pure function of one stage's
+    params — the same math as `SelfAttentionBlock`'s dense path, but
+    with explicit parameters so the pipeline schedule can hold exactly
+    one layer's weights per device."""
+    b, t, d = x.shape
+    head_dim = d // num_heads
+    cast = lambda a: a.astype(dtype)
+    y = _layer_norm(x, cast(p["ln1_scale"]), cast(p["ln1_bias"]))
+    qkv = y @ cast(p["qkv_kernel"]) + cast(p["qkv_bias"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda z: z.reshape(b, t, num_heads, head_dim)
+    q, k, v = rope(split(q)), rope(split(k)), split(v)
+    out = causal_attention(q, k, v, q_seg=segs, k_seg=segs)
+    out = out.reshape(b, t, d).astype(dtype)
+    x = x + out @ cast(p["proj_kernel"]) + cast(p["proj_bias"])
+    y = _layer_norm(x, cast(p["ln2_scale"]), cast(p["ln2_bias"]))
+    y = nn.relu(y @ cast(p["mlp1_kernel"]) + cast(p["mlp1_bias"]))
+    return x + y @ cast(p["mlp2_kernel"]) + cast(p["mlp2_bias"])
 
 
 class TransformerQNet(nn.Module):
@@ -125,6 +218,21 @@ class TransformerQNet(nn.Module):
     # the zigzag attention body computes its block positions from the
     # same layout, so `attention_fn` must be a pre_permuted zigzag ring.
     sequence_perm: tuple | None = None
+    # Mixture-of-experts MLPs (ops/moe.py) in every block when > 0;
+    # `moe_mesh` with an `expert` axis > 1 runs them expert-parallel.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_mesh: object = None
+    # Pipeline parallelism: `stack_layers` stores the blocks as one
+    # [num_layers, ...]-stacked param pytree ("blocks_stacked" — a
+    # different checkpoint layout, like any scan-over-layers model) and
+    # applies them with lax.scan; `pipeline_mesh` with a `pipe` axis of
+    # size num_layers runs them as GPipe stages instead
+    # (parallel/pipeline.py), one layer per device.
+    stack_layers: bool = False
+    pipeline_mesh: object = None
+    pipeline_microbatches: int = 2
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, prev_action_seq: jax.Array, done_seq: jax.Array):
@@ -154,10 +262,60 @@ class TransformerQNet(nn.Module):
             positions = jnp.asarray(perm)
             z = jnp.take(z, positions, axis=1)
             segs = jnp.take(segs, positions, axis=1)
-        for _ in range(self.num_layers):
-            z = SelfAttentionBlock(
-                self.d_model, self.num_heads, self.dtype, self.attention_fn
-            )(z, segs, positions)
+        if self.stack_layers:
+            if self.attention_fn is not None or self.num_experts:
+                raise ValueError(
+                    "stack_layers uses the dense-attention pure-function block; "
+                    "sequence-parallel attention_fn / MoE need the module body "
+                    "(nesting their shard_maps inside a pipeline stage is "
+                    "unsupported)")
+            blocks = self.param(
+                "blocks_stacked",
+                lambda rng: _stacked_block_init(rng, self.num_layers, self.d_model),
+            )
+            apply = lambda p, zz: _stacked_block_apply(
+                p, zz, segs, num_heads=self.num_heads, dtype=self.dtype)
+            if self.pipeline_mesh is not None:
+                from distributed_reinforcement_learning_tpu.parallel import pipeline as pp
+                from distributed_reinforcement_learning_tpu.parallel.mesh import (
+                    DATA_AXIS, PIPE_AXIS)
+
+                mesh = self.pipeline_mesh
+                if mesh.shape.get(PIPE_AXIS, 1) != self.num_layers:
+                    raise ValueError(
+                        f"pipeline mesh pipe axis {mesh.shape.get(PIPE_AXIS)} != "
+                        f"num_layers {self.num_layers} (one stage per layer)")
+                batch_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+                # Segment ids ride through the activation pytree so each
+                # microbatch attends with ITS rows' episode boundaries.
+                stage = lambda p, act: (
+                    _stacked_block_apply(
+                        p, act[0], act[1], num_heads=self.num_heads, dtype=self.dtype
+                    ),
+                    act[1],
+                )
+                z, _ = pp.pipeline_apply(
+                    mesh,
+                    stage,
+                    blocks,
+                    (z, segs),
+                    num_microbatches=self.pipeline_microbatches,
+                    batch_axis=batch_axis,
+                )
+            else:
+                z = jax.lax.scan(lambda zz, p: (apply(p, zz), None), z, blocks)[0]
+        else:
+            for _ in range(self.num_layers):
+                z = SelfAttentionBlock(
+                    self.d_model,
+                    self.num_heads,
+                    self.dtype,
+                    self.attention_fn,
+                    num_experts=self.num_experts,
+                    moe_top_k=self.moe_top_k,
+                    moe_capacity_factor=self.moe_capacity_factor,
+                    moe_mesh=self.moe_mesh,
+                )(z, segs, positions)
         z = nn.LayerNorm(dtype=self.dtype)(z)
         h = nn.relu(nn.Dense(128, kernel_init=_glorot, dtype=self.dtype)(z))
         q = nn.Dense(self.num_actions, kernel_init=_glorot, dtype=self.dtype)(h)

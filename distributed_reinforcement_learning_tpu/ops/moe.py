@@ -1,0 +1,130 @@
+"""Mixture-of-Experts MLP with expert parallelism over an `expert` mesh axis.
+
+The reference has no MoE (its largest model is a 4-layer conv net); this
+is part of the parallelism toolkit the TPU-native framework adds
+(DP/TP/SP/PP/EP). The formulation is the canonical dense-dispatch one
+from GShard/Switch — top-k routing expressed as one-hot dispatch/combine
+einsums over a fixed per-expert capacity — because that is the shape XLA
+partitions well: static shapes, batched matmuls on the MXU, and when the
+expert-stacked tensors are sharded over the mesh's `expert` axis, GSPMD
+inserts the token all-to-alls automatically. No scatter/gather, no
+ragged buffers.
+
+Routing semantics:
+- `top_k` experts per token, gate weights renormalized over the chosen k.
+- Fixed capacity `ceil(top_k * N * capacity_factor / E)` slots per
+  expert; slots fill in (choice-rank, token-order) priority and
+  overflowing tokens are dropped from that expert (their combine weight
+  is zero — the token's output falls back to the residual stream).
+- Aux load-balancing loss (Switch Transformer eq. 4): `E * sum_e f_e * p_e`
+  with `f_e` the fraction of tokens whose FIRST choice is `e` and `p_e`
+  the mean router probability; 1.0 at perfect balance.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_reinforcement_learning_tpu.parallel.mesh import EXPERT_AXIS
+
+
+def init_moe_params(
+    rng: jax.Array, d_model: int, d_hidden: int, num_experts: int
+) -> dict[str, jax.Array]:
+    """Router + expert-stacked MLP weights; glorot over the matmul dims
+    (leading expert dim is a batch axis for init scaling)."""
+    glorot = jax.nn.initializers.glorot_uniform(in_axis=-2, out_axis=-1, batch_axis=0)
+    kg, k1, k2 = jax.random.split(rng, 3)
+    e = num_experts
+    return {
+        "moe_gate": jax.nn.initializers.glorot_uniform()(kg, (d_model, e)),
+        "moe_w1": glorot(k1, (e, d_model, d_hidden)),
+        "moe_b1": jnp.zeros((e, d_hidden)),
+        "moe_w2": glorot(k2, (e, d_hidden, d_model)),
+        "moe_b2": jnp.zeros((e, d_model)),
+    }
+
+
+def expert_capacity(
+    num_tokens: int, num_experts: int, top_k: int, capacity_factor: float
+) -> int:
+    return max(1, math.ceil(top_k * num_tokens * capacity_factor / num_experts))
+
+
+def _dispatch_combine(probs: jax.Array, top_k: int, capacity: int):
+    """[N, E] router probs -> ([N, E, C] 0/1 dispatch, [N, E, C] combine, aux).
+
+    Slot priority is (choice rank, token order): all first choices claim
+    capacity before any second choice — the GShard ordering, which keeps
+    a token's strongest expert the last to overflow.
+    """
+    n, e = probs.shape
+    vals, idx = jax.lax.top_k(probs, top_k)  # [N, k]
+    gate = vals / (jnp.sum(vals, axis=-1, keepdims=True) + 1e-9)
+    onehot = jax.nn.one_hot(idx, e, dtype=probs.dtype)  # [N, k, E]
+
+    # Rank each (choice, token) within its expert, choice-major ordering.
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e)
+    pos_flat = jnp.sum((jnp.cumsum(flat, axis=0) - 1.0) * flat, axis=-1)
+    pos = pos_flat.reshape(top_k, n).T.astype(jnp.int32)  # [N, k]
+    # Positions >= capacity one-hot to all-zeros: the overflow drop.
+    slot = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)  # [N, k, C]
+
+    dispatch = jnp.einsum("nke,nkc->nec", onehot, slot)
+    combine = jnp.einsum("nk,nke,nkc->nec", gate, onehot, slot)
+
+    # Switch aux: fraction routed (first choice) x mean router prob.
+    frac = jnp.mean(onehot[:, 0, :], axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_mlp(
+    x: jax.Array,
+    params: dict[str, jax.Array],
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 2.0,
+    mesh: Mesh | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """MoE feed-forward over `[..., d_model]` tokens -> (y, aux_loss).
+
+    With `mesh` carrying an `expert` axis > 1, the expert-stacked
+    dispatch buffer and activations are sharding-constrained over it so
+    each device runs only its experts (the weights' sharding comes from
+    the train-state placement, `parallel/learner.py`).
+    """
+    e = params["moe_gate"].shape[-1]
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    xf = x.reshape(-1, d)
+    n = xf.shape[0]
+
+    logits = xf.astype(jnp.float32) @ params["moe_gate"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    cap = expert_capacity(n, e, top_k, capacity_factor)
+    dispatch, combine, aux = _dispatch_combine(probs, top_k, cap)
+
+    constrain = lambda a: a
+    if mesh is not None and mesh.shape.get(EXPERT_AXIS, 1) > 1:
+        constrain = lambda a: jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P(EXPERT_AXIS))
+        )
+
+    expert_in = constrain(jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xf))
+    h = jax.nn.relu(
+        jnp.einsum("ecd,edh->ech", expert_in, params["moe_w1"].astype(x.dtype))
+        + params["moe_b1"][:, None].astype(x.dtype)
+    )
+    expert_out = constrain(
+        jnp.einsum("ech,ehd->ecd", h, params["moe_w2"].astype(x.dtype))
+        + params["moe_b2"][:, None].astype(x.dtype)
+    )
+    y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+    return y.reshape(*lead, d), aux

@@ -1,12 +1,18 @@
 from distributed_reinforcement_learning_tpu.parallel.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
     SEQ_AXIS,
     data_sharding,
     make_mesh,
     model_kernel_sharding,
     place_local_batch,
     replicated,
+)
+from distributed_reinforcement_learning_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
 )
 from distributed_reinforcement_learning_tpu.parallel.learner import (
     ShardedLearner,
@@ -20,8 +26,12 @@ from distributed_reinforcement_learning_tpu.parallel import distributed
 
 __all__ = [
     "DATA_AXIS",
+    "EXPERT_AXIS",
     "MODEL_AXIS",
+    "PIPE_AXIS",
     "SEQ_AXIS",
+    "pipeline_apply",
+    "stack_stage_params",
     "distributed",
     "ShardedLearner",
     "data_sharding",

@@ -24,7 +24,14 @@ from typing import Any
 import jax
 
 from distributed_reinforcement_learning_tpu.parallel import mesh as mesh_lib
-from distributed_reinforcement_learning_tpu.parallel.mesh import MODEL_AXIS, Mesh, NamedSharding
+from distributed_reinforcement_learning_tpu.parallel.mesh import (
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    Mesh,
+    NamedSharding,
+    P,
+)
 
 # Leaves smaller than this stay replicated: splitting a 256-float bias over
 # ICI costs more in collective latency than the shard saves.
@@ -44,8 +51,40 @@ def _leaf_sharding(mesh: Mesh, leaf: jax.ShapeDtypeStruct) -> NamedSharding:
 
 
 def train_state_sharding(mesh: Mesh, abstract_state: Any):
-    """Sharding pytree for a TrainState, from its `jax.eval_shape` skeleton."""
-    return jax.tree.map(lambda x: _leaf_sharding(mesh, x), abstract_state)
+    """Sharding pytree for a TrainState, from its `jax.eval_shape` skeleton.
+
+    Three rules, first match wins, applied to params AND optimizer
+    moments (the moments mirror the params tree, so the same path keys
+    appear):
+    - leaves under a `blocks_stacked` key (the pipelined transformer
+      body) shard their leading layer dim over `pipe`;
+    - expert-stacked MoE leaves (`moe_w*`/`moe_b*`) shard their leading
+      expert dim over `expert`;
+    - any other big 2-D+ kernel shards its output-feature dim over
+      `model` (Megatron column style); the rest replicate.
+    """
+    pipe = mesh.shape.get(PIPE_AXIS, 1)
+    ep = mesh.shape.get(EXPERT_AXIS, 1)
+
+    def rule(path, leaf):
+        keys = [str(k) for k in path]
+        if (
+            pipe > 1
+            and any("blocks_stacked" in k for k in keys)
+            and leaf.ndim >= 1
+            and leaf.shape[0] == pipe
+        ):
+            return NamedSharding(mesh, P(PIPE_AXIS))
+        if (
+            ep > 1
+            and any("moe_" in k and "moe_gate" not in k for k in keys)
+            and leaf.ndim >= 2
+            and leaf.shape[0] % ep == 0
+        ):
+            return NamedSharding(mesh, P(EXPERT_AXIS))
+        return _leaf_sharding(mesh, leaf)
+
+    return jax.tree_util.tree_map_with_path(rule, abstract_state)
 
 
 class ShardedLearner:

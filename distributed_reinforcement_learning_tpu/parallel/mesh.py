@@ -17,6 +17,14 @@ is a learner spanning a `jax.sharding.Mesh` of chips:
   default; sized >1 it sits between `data` and `model` so neighboring
   devices carry adjacent sequence shards and the ring's `ppermute`
   rides nearest ICI links.
+- `pipe` axis: optional pipeline parallelism (`parallel/pipeline.py`) —
+  one stage per device, GPipe microbatch schedule. OUTERMOST: pipeline
+  hops move one activation microbatch per tick, the lightest traffic of
+  any axis, so it can ride the slowest links (incl. DCN on multi-host
+  meshes).
+- `expert` axis: optional expert parallelism for MoE layers
+  (`ops/moe.py`) — expert weights and the dispatched token buffer shard
+  over it; GSPMD inserts the all-to-alls.
 
 Everything here is plain `jax.sharding`; no torch-style process groups.
 """
@@ -27,8 +35,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
 MODEL_AXIS = "model"
 
 
@@ -36,13 +46,17 @@ def make_mesh(
     n_devices: int | None = None,
     model_parallel: int = 1,
     seq_parallel: int = 1,
+    pipe_parallel: int = 1,
+    expert_parallel: int = 1,
     devices: list | None = None,
 ) -> Mesh:
-    """Build a `(data, seq, model)` mesh over the first `n_devices` devices.
+    """Build a `(pipe, data, seq, expert, model)` mesh over the first
+    `n_devices` devices.
 
     `model_parallel` chips are adjacent in device order so the model axis
-    rides the fastest ICI links on real TPU topologies; the `seq` axis is
-    next-innermost for the same reason.
+    rides the fastest ICI links on real TPU topologies; `expert` and
+    `seq` are next-innermost for the same reason, and `pipe` is
+    outermost (lightest traffic on the slowest links).
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
@@ -53,13 +67,17 @@ def make_mesh(
             )
         devices = devices[:n_devices]
     n = len(devices)
-    inner = model_parallel * seq_parallel
-    if n % inner != 0:
+    inner = model_parallel * seq_parallel * expert_parallel
+    if n % (inner * pipe_parallel) != 0:
         raise ValueError(
-            f"{n} devices not divisible by seq_parallel*model_parallel={inner}"
+            f"{n} devices not divisible by pipe*seq*expert*model="
+            f"{inner * pipe_parallel}"
         )
-    arr = np.array(devices).reshape(n // inner, seq_parallel, model_parallel)
-    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+    arr = np.array(devices).reshape(
+        pipe_parallel, n // (inner * pipe_parallel), seq_parallel, expert_parallel,
+        model_parallel,
+    )
+    return Mesh(arr, (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, EXPERT_AXIS, MODEL_AXIS))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -58,26 +58,66 @@ _AGENT_CLS = {"impala": ImpalaAgent, "apex": ApexAgent, "r2d2": R2D2Agent,
               "xformer": XformerAgent}
 
 
+def mesh_axes_for(agent_cfg: Any, rt: RuntimeConfig) -> tuple[int, int, int]:
+    """(seq, pipe, expert) axis sizes the learner mesh should carve for
+    this config — the single source of truth for run_role, make_agent
+    and build_local (the three places that size meshes / pick actor
+    twins must agree or GSPMD errors replace config errors).
+
+    pipeline forces dense attention, so it also forces the seq axis to 1
+    (a leftover seq_parallel would idle devices).
+    """
+    pipelined = getattr(agent_cfg, "pipeline", False)
+    return (
+        1 if pipelined else rt.seq_parallel,
+        getattr(agent_cfg, "num_layers", 1) if pipelined else 1,
+        rt.expert_parallel if getattr(agent_cfg, "num_experts", 0) else 1,
+    )
+
+
+def needs_sharded_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig) -> bool:
+    """True when the learn step is sharded beyond data parallelism (and
+    actors therefore need a plain-apply twin)."""
+    return algo == "xformer" and (
+        agent_cfg.attention != "dense"
+        or agent_cfg.pipeline
+        or (agent_cfg.num_experts > 0 and rt.expert_parallel > 1)
+    )
+
+
 def make_agent(algo: str, agent_cfg: Any, rt: RuntimeConfig, mesh=None, actor: bool = False):
     """Construct the algorithm's agent.
 
-    Only the transformer family needs care: with `attention="ring"` /
-    `"ring_zigzag"` / `"ulysses"` the LEARNER's agent shards the sequence dimension over a
-    mesh (built here over local devices, `seq_parallel` from the config,
-    when the caller has none). ACTORS always get a dense-attention twin —
-    the attention implementation does not change the parameters, and an
-    actor process acts on a small [N, seq_len] window on its own (often
-    single-device) host where a collective mesh is wrong or impossible.
+    Only the transformer family needs care — its learn step can be
+    sharded three ways, each needing a mesh built here (over local
+    devices, axis sizes from the config) when the caller has none:
+
+    - `attention="ring"|"ring_zigzag"|"ulysses"`: sequence dim over a
+      `seq` axis of `rt.seq_parallel` devices;
+    - `pipeline=true`: layers as GPipe stages over a `pipe` axis of
+      `num_layers` devices;
+    - `num_experts>0` with `rt.expert_parallel>1`: MoE experts over an
+      `expert` axis.
+
+    ACTORS always get a plain-apply twin (dense attention, no pipeline
+    schedule — but the SAME param layout, incl. the stacked layout a
+    pipelined learner publishes): an actor acts on a small
+    [N, seq_len] window on its own (often single-device) host where a
+    collective mesh is wrong or impossible.
     """
-    if algo == "xformer" and agent_cfg.attention != "dense":
+    if needs_sharded_learner(algo, agent_cfg, rt):
         import dataclasses
 
         if actor:
-            return XformerAgent(dataclasses.replace(agent_cfg, attention="dense"))
+            return XformerAgent(dataclasses.replace(
+                agent_cfg, attention="dense", pipeline=False,
+                stacked=agent_cfg.pipeline or agent_cfg.stacked))
         if mesh is None:
             from distributed_reinforcement_learning_tpu.parallel import make_mesh
 
-            mesh = make_mesh(seq_parallel=rt.seq_parallel)
+            seq, pipe, expert = mesh_axes_for(agent_cfg, rt)
+            mesh = make_mesh(
+                seq_parallel=seq, pipe_parallel=pipe, expert_parallel=expert)
         return XformerAgent(agent_cfg, mesh=mesh)
     return _AGENT_CLS[algo](agent_cfg)
 
@@ -155,9 +195,10 @@ def build_local(agent_cfg: Any, rt: RuntimeConfig, run_dir: str | None = None, s
     logger = MetricsLogger(run_dir)
     queue = TrajectoryQueue(rt.queue_size)
     weights = WeightStore()
-    sp = algo == "xformer" and agent_cfg.attention != "dense"
-    # One jit cache for all runners — except the sequence-parallel
-    # learner, whose ring/all-to-all attention the actors must not share.
+    sp = needs_sharded_learner(algo, agent_cfg, rt)
+    # One jit cache for all runners — except a sharded (ring/pipeline/
+    # expert-parallel) learner, whose collective schedules the actors
+    # must not share.
     agent = make_agent(algo, agent_cfg, rt)
     actor_agent = make_agent(algo, agent_cfg, rt, actor=True) if sp else agent
     learner = make_learner(algo, agent_cfg, rt, queue, weights,

@@ -490,10 +490,24 @@ def run_role(
             print(f"[learner] multi-host: process {jax.process_index()}/{nproc}, "
                   f"{len(jax.local_devices())} local of {len(devs)} devices, "
                   f"local batch {local_batch}")
-        if len(devs) > 1 and rt.batch_size % len(devs) == 0:
+        # The batch only needs to divide the mesh's DATA axis — with
+        # pipeline/expert/seq axes carved out, that is a fraction of the
+        # device count, not len(devs).
+        seq, pipe, expert = launch.mesh_axes_for(agent_cfg, rt)
+        inner = pipe * expert * seq
+        data_axis = len(devs) // inner if len(devs) % inner == 0 else 0
+        if len(devs) > 1 and data_axis > 0 and rt.batch_size % data_axis == 0:
             from distributed_reinforcement_learning_tpu.parallel import make_mesh
 
-            mesh = make_mesh(devices=devs, seq_parallel=rt.seq_parallel)
+            if pipe > 1:
+                micro = agent_cfg.pipeline_microbatches
+                if (rt.batch_size // data_axis) % micro != 0:
+                    raise ValueError(
+                        f"pipeline needs the per-device batch "
+                        f"({rt.batch_size}/{data_axis}) divisible by "
+                        f"pipeline_microbatches={micro}")
+            mesh = make_mesh(devices=devs, seq_parallel=seq,
+                             pipe_parallel=pipe, expert_parallel=expert)
             print(f"[learner] mesh: {dict(mesh.shape)}")
         elif multihost:
             # Refuse rather than silently run N independent un-psum'd

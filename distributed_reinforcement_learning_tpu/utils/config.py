@@ -38,6 +38,7 @@ class RuntimeConfig:
     train_start_factor: int = 3  # learner trains when queue > factor*batch (`train_impala.py:94`)
     publish_interval: int = 1  # IMPALA weight-publish cadence (1 = reference parity)
     seq_parallel: int = 1  # xformer: devices carving the mesh's `seq` axis
+    expert_parallel: int = 1  # xformer MoE: devices carving the `expert` axis
 
 
 def check_config(rt: RuntimeConfig, num_actions: int) -> None:
@@ -67,6 +68,7 @@ def _runtime_from_section(algo: str, d: dict[str, Any]) -> RuntimeConfig:
         train_start_factor=d.get("train_start_factor", 3),
         publish_interval=d.get("publish_interval", 1),
         seq_parallel=d.get("seq_parallel", 1),
+        expert_parallel=d.get("expert_parallel", 1),
     )
 
 
@@ -131,6 +133,12 @@ def load_config(path: str | Path, section: str):
             discount_factor=d.get("discount_factor", 0.997),
             learning_rate=d.get("start_learning_rate", 1e-4),
             attention=d.get("attention", "dense"),
+            num_experts=d.get("num_experts", 0),
+            moe_top_k=d.get("moe_top_k", 2),
+            moe_capacity_factor=d.get("moe_capacity_factor", 2.0),
+            moe_aux_weight=d.get("moe_aux_weight", 1e-2),
+            pipeline=d.get("pipeline", False),
+            pipeline_microbatches=d.get("pipeline_microbatches", 2),
         )
     else:
         raise ValueError(f"unknown algorithm {algorithm!r}")

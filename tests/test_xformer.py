@@ -51,7 +51,9 @@ def _model_and_params(t=8, obs=(2,), seed=0, **kw):
     obs_seq = jnp.asarray(rng.randn(2, t, *obs).astype(np.float32))
     pa = jnp.asarray(rng.randint(0, 3, (2, t)))
     done = jnp.zeros((2, t), bool)
-    params = model.init(jax.random.PRNGKey(seed), obs_seq, pa, done)
+    # Trainables only — a MoE init also sows its aux losses (the agent
+    # filters identically in init_state).
+    params = {"params": model.init(jax.random.PRNGKey(seed), obs_seq, pa, done)["params"]}
     return model, params, obs_seq, pa, done
 
 
@@ -202,3 +204,180 @@ class TestSequenceParallelTraining:
         state, pri, metrics = agent.learn(state, batch, w)
         assert np.isfinite(float(metrics["loss"]))
         assert np.all(np.isfinite(np.asarray(pri)))
+
+
+class TestMoETransformer:
+    """MoE blocks inside the Q-network: routing preserves the model
+    contracts (causality, episode isolation are per-token so they hold
+    by construction — verified anyway), the router aux loss reaches the
+    training objective, and expert parallelism shards the expert dim."""
+
+    def test_forward_finite_and_causal(self):
+        model, params, obs, pa, done = _model_and_params(num_experts=4)
+        q = model.apply(params, obs, pa, done)
+        assert q.shape == (2, 8, 3) and np.all(np.isfinite(np.asarray(q)))
+        obs2 = obs.at[:, 5:].set(0.0)
+        q2 = model.apply(params, obs2, pa, done)
+        np.testing.assert_allclose(
+            np.asarray(q[:, :5]), np.asarray(q2[:, :5]), atol=1e-5)
+
+    def test_aux_loss_sown_per_layer(self):
+        model, params, obs, pa, done = _model_and_params(num_experts=4)
+        _, sown = model.apply(params, obs, pa, done, mutable=["losses"])
+        leaves = jax.tree.leaves(sown["losses"])
+        assert len(leaves) == 2  # one per layer
+        assert all(float(x) >= 1.0 - 1e-4 for x in leaves)
+
+    def test_agent_learns_with_moe(self):
+        cfg = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                            d_model=32, num_heads=2, num_layers=2, num_experts=4)
+        agent = XformerAgent(cfg)
+        state = agent.init_state(jax.random.PRNGKey(0))
+        assert set(state.params) == {"params"}  # sown collections filtered
+        batch, w = synthetic_xformer_batch(16, 8, (2,), 3)
+        losses = []
+        for _ in range(40):
+            state, pri, metrics = agent.learn(state, batch, w)
+            losses.append(float(metrics["loss"]))
+        assert np.all(np.isfinite(losses))
+        # The router aux term is a ~0.02 floor under the TD loss, so the
+        # descent bound is looser than the dense agent's.
+        assert losses[-1] < 0.6 * losses[0], losses[::10]
+        # The aux term must actually reach the objective.
+        cfg0 = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                             d_model=32, num_heads=2, num_layers=2, num_experts=4,
+                             moe_aux_weight=0.0)
+        agent0 = XformerAgent(cfg0)
+        s0 = agent0.init_state(jax.random.PRNGKey(0))
+        _, _, m0 = agent0.learn(s0, batch, w)
+        s1 = agent.init_state(jax.random.PRNGKey(0))
+        _, _, m1 = agent.learn(s1, batch, w)
+        assert float(m1["loss"]) > float(m0["loss"])
+
+    def test_expert_parallel_learn_matches_single(self):
+        from distributed_reinforcement_learning_tpu.parallel import (
+            EXPERT_AXIS, ShardedLearner, make_mesh)
+
+        mesh = make_mesh(8, expert_parallel=4)  # data=2 x expert=4
+        cfg = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                            d_model=32, num_heads=2, num_layers=2, num_experts=4)
+        plain = XformerAgent(cfg)
+        ep = XformerAgent(cfg, mesh=mesh)
+        learner = ShardedLearner(ep, mesh, num_data_args=2, num_aux_outputs=2)
+        # Expert-stacked weights (and their Adam moments) shard over `expert`.
+        specs = {
+            "/".join(str(k) for k in path): s.spec
+            for path, s in jax.tree_util.tree_flatten_with_path(learner.state_sharding)[0]
+        }
+        moe_specs = [v for k, v in specs.items() if "moe_w1" in k]
+        assert moe_specs and all(tuple(s) == (EXPERT_AXIS,) for s in moe_specs), specs
+
+        state_p = plain.init_state(jax.random.PRNGKey(0))
+        state_s = learner.init_state(jax.random.PRNGKey(0))
+        batch, w = synthetic_xformer_batch(8, 8, (2,), 3, seed=4)
+        _, pri_p, m_p = plain.learn(state_p, batch, w)
+        _, pri_s, m_s = learner.learn(state_s, *learner.shard_batch((batch, w)))
+        np.testing.assert_allclose(np.asarray(pri_p), np.asarray(pri_s), atol=1e-4)
+        assert abs(float(m_p["loss"]) - float(m_s["loss"])) < 1e-4
+
+
+class TestPipelineTransformer:
+    """GPipe pipeline over the stacked-layer body: the pipelined forward
+    is the same function as the sequential scan over the same stacked
+    params, and the agent trains over a (pipe, data) mesh."""
+
+    def test_stacked_forward_matches_pipelined(self):
+        from distributed_reinforcement_learning_tpu.parallel import make_mesh
+
+        mesh = make_mesh(8, pipe_parallel=2)  # pipe=2 x data=4
+        seq = TransformerQNet(num_actions=3, d_model=32, num_heads=2, num_layers=2,
+                              max_len=16, stack_layers=True)
+        pipe = TransformerQNet(num_actions=3, d_model=32, num_heads=2, num_layers=2,
+                               max_len=16, stack_layers=True, pipeline_mesh=mesh,
+                               pipeline_microbatches=2)
+        rng = np.random.RandomState(5)
+        obs = jnp.asarray(rng.randn(8, 8, 2).astype(np.float32))
+        pa = jnp.asarray(rng.randint(0, 3, (8, 8)))
+        done = jnp.zeros((8, 8), bool).at[:, 3].set(True)
+        params = seq.init(jax.random.PRNGKey(0), obs, pa, done)
+        q_seq = seq.apply(params, obs, pa, done)
+        q_pipe = pipe.apply(params, obs, pa, done)
+        np.testing.assert_allclose(np.asarray(q_seq), np.asarray(q_pipe),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_agent_trains_pipelined(self):
+        from distributed_reinforcement_learning_tpu.parallel import (
+            PIPE_AXIS, ShardedLearner, make_mesh)
+
+        mesh = make_mesh(8, pipe_parallel=2)
+        cfg = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                            d_model=32, num_heads=2, num_layers=2, pipeline=True,
+                            pipeline_microbatches=2)
+        agent = XformerAgent(cfg, mesh=mesh)
+        learner = ShardedLearner(agent, mesh, num_data_args=2, num_aux_outputs=2)
+        specs = {
+            "/".join(str(k) for k in path): s.spec
+            for path, s in jax.tree_util.tree_flatten_with_path(learner.state_sharding)[0]
+        }
+        stacked = [v for k, v in specs.items() if "blocks_stacked" in k]
+        assert stacked and all(tuple(s) == (PIPE_AXIS,) for s in stacked), specs
+
+        state = learner.init_state(jax.random.PRNGKey(0))
+        batch, w = synthetic_xformer_batch(16, 8, (2,), 3, seed=6)
+        losses = []
+        for _ in range(40):
+            state, pri, metrics = learner.learn(state, *learner.shard_batch((batch, w)))
+            losses.append(float(metrics["loss"]))
+        assert np.all(np.isfinite(losses))
+        # TD bootstrap against a frozen target oscillates on some seeds;
+        # the trailing mean still has to beat the starting loss clearly.
+        assert np.mean(losses[-5:]) < 0.6 * losses[0], losses[::5]
+        assert np.all(np.isfinite(np.asarray(pri)))
+
+    def test_pipeline_excludes_sp_and_moe(self):
+        from distributed_reinforcement_learning_tpu.parallel import make_mesh
+
+        mesh = make_mesh(8, pipe_parallel=2)
+        with pytest.raises(ValueError, match="exclusive"):
+            XformerAgent(XformerConfig(num_layers=2, pipeline=True, num_experts=4),
+                         mesh=mesh)
+        with pytest.raises(ValueError, match="needs a mesh"):
+            XformerAgent(XformerConfig(num_layers=2, pipeline=True))
+
+
+class TestShardedConfigPaths:
+    """Pipeline / expert parallelism must be reachable through the
+    documented config path (build_local), with actors getting plain-apply
+    twins that share the learner's param layout."""
+
+    def _rt(self, **kw):
+        from distributed_reinforcement_learning_tpu.utils.config import RuntimeConfig
+
+        return RuntimeConfig(algorithm="xformer", num_actors=1,
+                             envs=("CartPole-v0",), available_action=(2,),
+                             batch_size=8, envs_per_actor=2,
+                             target_sync_interval=20, **kw)
+
+    def test_pipeline_reachable_from_config_path(self):
+        from distributed_reinforcement_learning_tpu.runtime.launch import build_local
+
+        cfg = XformerConfig(obs_shape=(2,), num_actions=2, seq_len=8, burn_in=2,
+                            d_model=32, num_heads=2, num_layers=2, pipeline=True,
+                            pipeline_microbatches=2)
+        learner, actors, run_fn = build_local(cfg, self._rt(), seed=0)
+        assert actors[0].agent is not learner.agent
+        # Actor twin: no pipeline schedule, but the stacked layout so the
+        # learner's published weights slot straight in.
+        assert actors[0].agent.cfg.stacked and not actors[0].agent.cfg.pipeline
+        result = run_fn(learner, actors, num_updates=3)
+        assert np.isfinite(result["last_metrics"]["loss"])
+
+    def test_expert_parallel_reachable_from_config_path(self):
+        from distributed_reinforcement_learning_tpu.runtime.launch import build_local
+
+        cfg = XformerConfig(obs_shape=(2,), num_actions=2, seq_len=8, burn_in=2,
+                            d_model=32, num_heads=2, num_layers=1, num_experts=4)
+        learner, actors, run_fn = build_local(cfg, self._rt(expert_parallel=2), seed=0)
+        assert actors[0].agent is not learner.agent
+        result = run_fn(learner, actors, num_updates=3)
+        assert np.isfinite(result["last_metrics"]["loss"])
