@@ -105,20 +105,38 @@ def encode(tree: Any) -> bytearray:
     return buf
 
 
-def decode(blob: bytes | memoryview, copy: bool = False) -> Any:
-    """Unpack a blob; arrays view the blob unless copy=True."""
+def parse_layout(blob: bytes | memoryview) -> tuple[Any, list[dict], int]:
+    """Header of a blob -> (skeleton, array metas, payload_start).
+
+    The header fully determines the layout, so a consumer holding many
+    same-schema blobs (the native queue's batch pop) can parse ONE
+    header and gather every field across blobs — see
+    `data/native.py` `NativeTrajectoryQueue.get_batch`.
+    """
     view = memoryview(blob)
     if int.from_bytes(view[0:4], "little") != _MAGIC:
         raise ValueError("bad magic: not a codec blob")
     header_len = int.from_bytes(view[4:8], "little")
     header = json.loads(bytes(view[8 : 8 + header_len]))
-    payload_start = _align(8 + header_len)
+    return header["skel"], header["arrays"], _align(8 + header_len)
+
+
+def assemble(skel: Any, arrays: list[np.ndarray]) -> Any:
+    """Rebuild the pytree from a skeleton and its (possibly batched)
+    leaf arrays, in `parse_layout` order."""
+    return _unflatten(skel, arrays)
+
+
+def decode(blob: bytes | memoryview, copy: bool = False) -> Any:
+    """Unpack a blob; arrays view the blob unless copy=True."""
+    view = memoryview(blob)
+    skel, metas, payload_start = parse_layout(view)
     arrays = []
-    for meta in header["arrays"]:
+    for meta in metas:
         dtype = np.dtype(meta["dtype"])
         shape = tuple(meta["shape"])
         nbytes = dtype.itemsize * int(np.prod(shape)) if shape else dtype.itemsize
         start = payload_start + meta["offset"]
         arr = np.frombuffer(view[start : start + nbytes], dtype=dtype).reshape(shape)
         arrays.append(arr.copy() if copy else arr)
-    return _unflatten(header["skel"], arrays)
+    return _unflatten(skel, arrays)
