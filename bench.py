@@ -288,6 +288,61 @@ def bench_apex_learn(B: int, iters: int) -> dict:
     return {"B": B, "transitions_per_s": round(tps, 1), "step_ms": round(1e3 * step_s, 3)}
 
 
+def bench_ingest(B: int, iters: int) -> dict:
+    """Host-side batch ingest assembly: native strided pop + C++
+    batch-gather vs per-blob decode + np.stack, on the IMPALA Atari
+    unroll (SURVEY §7 hard part (a) — the host path that feeds the
+    chip). Platform-independent (pure host work)."""
+    import jax
+
+    from distributed_reinforcement_learning_tpu.data import codec, native
+    from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
+
+    if not native.native_available():
+        return {"error": "native library unavailable"}
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.agents.impala import ImpalaConfig
+
+    cfg = ImpalaConfig()
+    one = jax.tree.map(lambda x: np.asarray(x[0]), _make_batch(cfg, 1))
+    q = native.NativeTrajectoryQueue(4 * B)
+
+    def fill():
+        for _ in range(B):
+            q.put(one)
+
+    def timed(f):
+        ts = []
+        for _ in range(iters):
+            fill()
+            t0 = time.perf_counter()
+            f()
+            ts.append(time.perf_counter() - t0)
+        return 1e3 * sorted(ts)[len(ts) // 2]
+
+    for _ in range(2):
+        fill()
+        q.get_batch(B)
+    gather_ms = timed(lambda: q.get_batch(B))
+
+    def per_blob():
+        blobs = q._q.get_batch_blobs(B, q._item_cap)
+        stack_pytrees([codec.decode(b) for b in blobs])
+
+    decode_stack_ms = timed(per_blob)
+    frames = B * cfg.trajectory
+    out = {
+        "B": B,
+        "gather_ms": round(gather_ms, 2),
+        "decode_stack_ms": round(decode_stack_ms, 2),
+        "speedup": round(decode_stack_ms / gather_ms, 2),
+        "gather_frames_per_s": round(frames / (gather_ms / 1e3), 1),
+    }
+    print(f"[bench] ingest: {out}", file=sys.stderr)
+    return out
+
+
 def bench_long_context(iters: int) -> dict:
     """Single-chip long-context attention fwd+bwd at T=8192: dense vs
     blockwise online-softmax vs the fused Pallas flash kernels — plus
@@ -531,6 +586,15 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["apex_learn"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] apex failed: {e}", file=sys.stderr)
+
+    if os.environ.get("BENCH_INGEST", "1") == "1":
+        try:
+            extra["ingest"] = bench_ingest(
+                int(os.environ.get("BENCH_INGEST_BATCH", "32")),
+                int(os.environ.get("BENCH_INGEST_ITERS", "11")))
+        except Exception as e:  # noqa: BLE001
+            extra["ingest"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] ingest failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_LONG_CONTEXT", "1" if on_accel else "0") == "1":
         try:

@@ -121,6 +121,16 @@ def parse_layout(blob: bytes | memoryview) -> tuple[Any, list[dict], int]:
     return header["skel"], header["arrays"], _align(8 + header_len)
 
 
+def meta_layout(meta: dict) -> tuple[np.dtype, tuple[int, ...], int]:
+    """Array meta dict -> (dtype, shape, nbytes): the single
+    interpretation of the header's per-array encoding, shared by
+    `decode` and the native batch-gather."""
+    dtype = np.dtype(meta["dtype"])
+    shape = tuple(meta["shape"])
+    nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64)) if shape else dtype.itemsize
+    return dtype, shape, nbytes
+
+
 def assemble(skel: Any, arrays: list[np.ndarray]) -> Any:
     """Rebuild the pytree from a skeleton and its (possibly batched)
     leaf arrays, in `parse_layout` order."""
@@ -133,9 +143,7 @@ def decode(blob: bytes | memoryview, copy: bool = False) -> Any:
     skel, metas, payload_start = parse_layout(view)
     arrays = []
     for meta in metas:
-        dtype = np.dtype(meta["dtype"])
-        shape = tuple(meta["shape"])
-        nbytes = dtype.itemsize * int(np.prod(shape)) if shape else dtype.itemsize
+        dtype, shape, nbytes = meta_layout(meta)
         start = payload_start + meta["offset"]
         arr = np.frombuffer(view[start : start + nbytes], dtype=dtype).reshape(shape)
         arrays.append(arr.copy() if copy else arr)

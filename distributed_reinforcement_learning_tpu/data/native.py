@@ -350,10 +350,8 @@ class NativeTrajectoryQueue:
             ):
                 arrays = []
                 for meta in metas:
-                    dtype = np.dtype(meta["dtype"])
-                    shape = tuple(meta["shape"])
+                    dtype, shape, nbytes = codec.meta_layout(meta)
                     out = np.empty((batch_size, *shape), dtype)
-                    nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
                     lib.bs_gather(
                         base, stride, batch_size, payload_start + meta["offset"],
                         nbytes,
