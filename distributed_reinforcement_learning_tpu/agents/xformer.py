@@ -67,13 +67,17 @@ class XformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 1e-2
-    # Pipeline parallelism: with a mesh whose `pipe` axis equals
-    # num_layers, the learn step runs the blocks as GPipe stages
-    # (`parallel/pipeline.py`), one layer per device, splitting each
+    # Pipeline parallelism: the learn step runs the blocks as GPipe
+    # stages over the mesh's `pipe` axis (`parallel/pipeline.py`), each
+    # stage owning num_layers/stages contiguous layers, splitting each
     # batch into this many microbatches. Uses the stacked-param body
     # (dense attention; exclusive with ring/ulysses and MoE).
     pipeline: bool = False
     pipeline_microbatches: int = 2
+    # Number of pipeline stages (devices on the `pipe` axis); 0 means
+    # one stage per layer, otherwise >= 2 and it must divide num_layers
+    # (virtual stages).
+    pipeline_stages: int = 0
     # Stacked [num_layers, ...] param layout WITHOUT the pipeline
     # schedule (plain scan over layers). pipeline=True implies it; set
     # it alone on actor twins so they share a pipelined learner's
@@ -137,6 +141,19 @@ class XformerAgent(common.SequenceReplayLearnMixin):
             if cfg.attention != "dense" or cfg.num_experts:
                 raise ValueError(
                     "pipeline is exclusive with sequence-parallel attention and MoE")
+            if cfg.pipeline_stages < 0 or cfg.pipeline_stages == 1:
+                raise ValueError(
+                    f"pipeline_stages must be 0 (one stage per layer) or >= 2, "
+                    f"got {cfg.pipeline_stages}")
+            from distributed_reinforcement_learning_tpu.parallel.mesh import PIPE_AXIS
+
+            want = cfg.pipeline_stages or cfg.num_layers
+            have = mesh.shape.get(PIPE_AXIS, 1)
+            if have != want:
+                raise ValueError(
+                    f"mesh pipe axis is {have} but the config asks for "
+                    f"{want} stages (pipeline_stages={cfg.pipeline_stages}, "
+                    f"num_layers={cfg.num_layers})")
             pipeline_mesh = mesh
         make_model = lambda fn, perm=None, pipe=None, moe_mesh=moe_mesh: TransformerQNet(
             num_actions=cfg.num_actions,

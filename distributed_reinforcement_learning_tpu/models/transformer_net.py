@@ -227,9 +227,10 @@ class TransformerQNet(nn.Module):
     # Pipeline parallelism: `stack_layers` stores the blocks as one
     # [num_layers, ...]-stacked param pytree ("blocks_stacked" — a
     # different checkpoint layout, like any scan-over-layers model) and
-    # applies them with lax.scan; `pipeline_mesh` with a `pipe` axis of
-    # size num_layers runs them as GPipe stages instead
-    # (parallel/pipeline.py), one layer per device.
+    # applies them with lax.scan; `pipeline_mesh` with a `pipe` axis
+    # that divides num_layers runs them as GPipe stages instead
+    # (parallel/pipeline.py), each stage scanning its contiguous
+    # num_layers/pipe layer group locally (virtual stages).
     stack_layers: bool = False
     pipeline_mesh: object = None
     pipeline_microbatches: int = 2
@@ -281,23 +282,38 @@ class TransformerQNet(nn.Module):
                     DATA_AXIS, PIPE_AXIS)
 
                 mesh = self.pipeline_mesh
-                if mesh.shape.get(PIPE_AXIS, 1) != self.num_layers:
+                stages = mesh.shape.get(PIPE_AXIS, 1)
+                if stages < 2 or self.num_layers % stages != 0:
                     raise ValueError(
-                        f"pipeline mesh pipe axis {mesh.shape.get(PIPE_AXIS)} != "
-                        f"num_layers {self.num_layers} (one stage per layer)")
+                        f"pipeline mesh pipe axis {stages} must be >= 2 and "
+                        f"divide num_layers {self.num_layers}")
+                per_stage = self.num_layers // stages
+                # Virtual stages: each device owns a contiguous group of
+                # `per_stage` layers, scanned locally within its tick.
+                staged = jax.tree.map(
+                    lambda a: a.reshape(stages, per_stage, *a.shape[1:]), blocks)
                 batch_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+
                 # Segment ids ride through the activation pytree so each
                 # microbatch attends with ITS rows' episode boundaries.
-                stage = lambda p, act: (
-                    _stacked_block_apply(
-                        p, act[0], act[1], num_heads=self.num_heads, dtype=self.dtype
-                    ),
-                    act[1],
-                )
+                def stage(p, act):
+                    zz, ss = act
+                    zz = jax.lax.scan(
+                        lambda c, pl: (
+                            _stacked_block_apply(
+                                pl, c, ss, num_heads=self.num_heads, dtype=self.dtype
+                            ),
+                            None,
+                        ),
+                        zz,
+                        p,
+                    )[0]
+                    return zz, ss
+
                 z, _ = pp.pipeline_apply(
                     mesh,
                     stage,
-                    blocks,
+                    staged,
                     (z, segs),
                     num_microbatches=self.pipeline_microbatches,
                     batch_axis=batch_axis,

@@ -72,8 +72,11 @@ def train_state_sharding(mesh: Mesh, abstract_state: Any):
             pipe > 1
             and any("blocks_stacked" in k for k in keys)
             and leaf.ndim >= 1
-            and leaf.shape[0] == pipe
+            and leaf.shape[0] % pipe == 0
         ):
+            # % not ==: with virtual stages the stored layout stays
+            # [num_layers, ...] and each pipe shard holds its stage's
+            # contiguous layers-per-stage group.
             return NamedSharding(mesh, P(PIPE_AXIS))
         if (
             ep > 1

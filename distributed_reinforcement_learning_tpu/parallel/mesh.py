@@ -102,8 +102,13 @@ def place_local_batch(tree, sharding: NamedSharding | None):
     (a mesh spanning hosts, after `parallel.distributed.initialize`): each
     process holds only its local rows, so the global array is assembled
     with `jax.make_array_from_process_local_data` — the per-host batch
-    feed of the multi-host learner. Local batch size must be
-    `global_batch / process_count`.
+    feed of the multi-host learner. Local row count follows the sharding:
+    when the batch axis spans processes (the usual data-parallel feed),
+    each process supplies `global_batch / process_count` rows; when the
+    processes sit on an axis the batch is REPLICATED over (e.g. hosts on
+    `pipe`, batch sharded over a within-host `data` axis), each process
+    supplies the full, identical global batch (see the pipeline step in
+    tests/multihost_worker.py).
     """
     if sharding is None:
         return jax.device_put(tree)

@@ -70,7 +70,8 @@ def mesh_axes_for(agent_cfg: Any, rt: RuntimeConfig) -> tuple[int, int, int]:
     pipelined = getattr(agent_cfg, "pipeline", False)
     return (
         1 if pipelined else rt.seq_parallel,
-        getattr(agent_cfg, "num_layers", 1) if pipelined else 1,
+        (getattr(agent_cfg, "pipeline_stages", 0)
+         or getattr(agent_cfg, "num_layers", 1)) if pipelined else 1,
         rt.expert_parallel if getattr(agent_cfg, "num_experts", 0) else 1,
     )
 

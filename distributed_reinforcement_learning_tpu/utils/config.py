@@ -139,6 +139,7 @@ def load_config(path: str | Path, section: str):
             moe_aux_weight=d.get("moe_aux_weight", 1e-2),
             pipeline=d.get("pipeline", False),
             pipeline_microbatches=d.get("pipeline_microbatches", 2),
+            pipeline_stages=d.get("pipeline_stages", 0),
         )
     else:
         raise ValueError(f"unknown algorithm {algorithm!r}")

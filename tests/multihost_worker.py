@@ -95,3 +95,28 @@ xstate, pri, xm = xlearner.learn(xstate, batch, w)
 jax.block_until_ready(xstate)
 assert np.all(np.isfinite(np.asarray(pri)))
 print(f"RESULT {pid} xformer_sp {float(xm['loss']):.6f}", flush=True)
+
+# Pipeline parallelism across processes: the GPipe stage hops (ppermute
+# over the `pipe` axis) now cross the process boundary — the classic
+# "pipeline over DCN" placement, pipe being the lightest-traffic axis.
+# 2 stages x 2 layers each over a (pipe=2, data=4) global mesh.
+pcfg = XformerConfig(obs_shape=(2,), num_actions=2, seq_len=8, burn_in=2,
+                     d_model=32, num_heads=2, num_layers=4, pipeline=True,
+                     pipeline_stages=2, pipeline_microbatches=2)
+pp_mesh = make_mesh(devices=jax.devices(), pipe_parallel=2)
+pagent = XformerAgent(pcfg, mesh=pp_mesh)
+plearner = ShardedLearner(pagent, pp_mesh, num_data_args=2, num_aux_outputs=2)
+pstate = plearner.init_state(jax.random.PRNGKey(0))
+# The pipe axis is what spans the two processes here, and the batch is
+# REPLICATED over pipe (sharded only over data, which lives within each
+# process). So each process supplies the full, identical global batch —
+# same seed, no pid — unlike the data-split feeds above.
+plocal, pw_local = synthetic_xformer_batch(
+    GLOBAL_XB, pcfg.seq_len, pcfg.obs_shape, pcfg.num_actions, seed=3000)
+psharding = data_sharding(pp_mesh)
+pstate, ppri, pm = plearner.learn(
+    pstate, place_local_batch(plocal, psharding),
+    place_local_batch(np.asarray(pw_local), psharding))
+jax.block_until_ready(pstate)
+assert np.all(np.isfinite(np.asarray(ppri)))
+print(f"RESULT {pid} xformer_pp {float(pm['loss']):.6f}", flush=True)

@@ -60,6 +60,6 @@ def test_two_process_learner_agrees():
         return rows
 
     r0, r1 = results(outs[0][1]), results(outs[1][1])
-    assert set(r0) == set(r1) == {"0", "1", "2", "weights_ok", "xformer_sp"}
-    for key in ("0", "1", "2", "weights_ok", "xformer_sp"):
+    assert set(r0) == set(r1) == {"0", "1", "2", "weights_ok", "xformer_sp", "xformer_pp"}
+    for key in ("0", "1", "2", "weights_ok", "xformer_sp", "xformer_pp"):
         assert r0[key] == r1[key], f"step {key}: process losses diverged {r0[key]} vs {r1[key]}"
