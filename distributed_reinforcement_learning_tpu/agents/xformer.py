@@ -148,6 +148,10 @@ class XformerAgent(common.SequenceReplayLearnMixin):
             from distributed_reinforcement_learning_tpu.parallel.mesh import PIPE_AXIS
 
             want = cfg.pipeline_stages or cfg.num_layers
+            if cfg.num_layers % want != 0:
+                raise ValueError(
+                    f"pipeline_stages={cfg.pipeline_stages} must divide "
+                    f"num_layers={cfg.num_layers}")
             have = mesh.shape.get(PIPE_AXIS, 1)
             if have != want:
                 raise ValueError(

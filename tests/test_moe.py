@@ -139,3 +139,37 @@ class TestExpertParallel:
             g1,
             g2,
         )
+
+
+class TestMoESequenceParallelCompose:
+    """MoE experts (expert axis) + ring attention (seq axis) in ONE
+    transformer learn step on a (data=2, seq=2, expert=2) mesh — the
+    router/expert einsums and the ring's shard_map must not interfere."""
+
+    def test_ring_plus_moe_learn_step(self):
+        import jax
+
+        from distributed_reinforcement_learning_tpu.agents.xformer import (
+            XformerAgent, XformerConfig)
+        from distributed_reinforcement_learning_tpu.parallel import ShardedLearner
+        from distributed_reinforcement_learning_tpu.utils.synthetic import (
+            synthetic_xformer_batch)
+
+        mesh = make_mesh(8, seq_parallel=2, expert_parallel=2)
+        cfg = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                            d_model=32, num_heads=2, num_layers=2,
+                            attention="ring", num_experts=4)
+        dense_cfg = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8,
+                                  burn_in=2, d_model=32, num_heads=2,
+                                  num_layers=2, num_experts=4)
+        plain = XformerAgent(dense_cfg)
+        combo = XformerAgent(cfg, mesh=mesh)
+        learner = ShardedLearner(combo, mesh, num_data_args=2, num_aux_outputs=2)
+
+        batch, w = synthetic_xformer_batch(8, 8, (2,), 3, seed=21)
+        ref_state = plain.init_state(jax.random.PRNGKey(2))
+        _, ref_pri, ref_m = plain.learn(ref_state, batch, w)
+        state = learner.init_state(jax.random.PRNGKey(2))
+        _, pri, m = learner.learn(state, *learner.shard_batch((batch, w)))
+        np.testing.assert_allclose(np.asarray(ref_pri), np.asarray(pri), atol=1e-4)
+        assert abs(float(ref_m["loss"]) - float(m["loss"])) < 1e-4
