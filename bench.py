@@ -571,8 +571,11 @@ def main() -> None:
 
     if os.environ.get("BENCH_R2D2", "1") == "1":
         try:
+            # Default B=128: measured 860k frames/s on v5e vs 205-440k
+            # across runs at the old B=64 (the fused LSTM amortizes much
+            # better) — benchmarks/r02_r2d2_b128_probe.json.
             extra["r2d2_learn"] = bench_r2d2_learn(
-                int(os.environ.get("BENCH_R2D2_BATCH", "64")),
+                int(os.environ.get("BENCH_R2D2_BATCH", "128")),
                 iters if on_accel else 2)
         except Exception as e:  # noqa: BLE001
             extra["r2d2_learn"] = {"error": f"{type(e).__name__}: {e}"}
