@@ -78,6 +78,10 @@ class XformerConfig:
     # one stage per layer, otherwise >= 2 and it must divide num_layers
     # (virtual stages).
     pipeline_stages: int = 0
+    # Rematerialize each transformer block in the backward pass
+    # (jax.checkpoint) — activation memory stops growing with
+    # num_layers x seq_len at the cost of ~one extra forward.
+    remat: bool = False
     # Stacked [num_layers, ...] param layout WITHOUT the pipeline
     # schedule (plain scan over layers). pipeline=True implies it; set
     # it alone on actor twins so they share a pipelined learner's
@@ -175,6 +179,7 @@ class XformerAgent(common.SequenceReplayLearnMixin):
             stack_layers=cfg.pipeline or cfg.stacked,
             pipeline_mesh=pipe,
             pipeline_microbatches=cfg.pipeline_microbatches,
+            remat=cfg.remat,
         )
         self.model = make_model(attention_fn, sequence_perm, pipeline_mesh)
         # Dense twin over the SAME params: ingest-time priority scoring

@@ -234,6 +234,10 @@ class TransformerQNet(nn.Module):
     stack_layers: bool = False
     pipeline_mesh: object = None
     pipeline_microbatches: int = 2
+    # Rematerialize each block in the backward pass (jax.checkpoint):
+    # activation memory stops growing with num_layers x seq_len at the
+    # cost of one extra forward — the standard long-context lever.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, prev_action_seq: jax.Array, done_seq: jax.Array):
@@ -274,8 +278,13 @@ class TransformerQNet(nn.Module):
                 "blocks_stacked",
                 lambda rng: _stacked_block_init(rng, self.num_layers, self.d_model),
             )
-            apply = lambda p, zz: _stacked_block_apply(
-                p, zz, segs, num_heads=self.num_heads, dtype=self.dtype)
+            def block(p, zz, ss):
+                return _stacked_block_apply(
+                    p, zz, ss, num_heads=self.num_heads, dtype=self.dtype)
+
+            if self.remat:
+                block = jax.checkpoint(block)
+            apply = lambda p, zz: block(p, zz, segs)
             if self.pipeline_mesh is not None:
                 from distributed_reinforcement_learning_tpu.parallel import pipeline as pp
                 from distributed_reinforcement_learning_tpu.parallel.mesh import (
@@ -299,14 +308,7 @@ class TransformerQNet(nn.Module):
                 def stage(p, act):
                     zz, ss = act
                     zz = jax.lax.scan(
-                        lambda c, pl: (
-                            _stacked_block_apply(
-                                pl, c, ss, num_heads=self.num_heads, dtype=self.dtype
-                            ),
-                            None,
-                        ),
-                        zz,
-                        p,
+                        lambda c, pl: (block(pl, c, ss), None), zz, p
                     )[0]
                     return zz, ss
 
@@ -321,8 +323,9 @@ class TransformerQNet(nn.Module):
             else:
                 z = jax.lax.scan(lambda zz, p: (apply(p, zz), None), z, blocks)[0]
         else:
-            for _ in range(self.num_layers):
-                z = SelfAttentionBlock(
+            block_cls = nn.remat(SelfAttentionBlock) if self.remat else SelfAttentionBlock
+            for i in range(self.num_layers):
+                z = block_cls(
                     self.d_model,
                     self.num_heads,
                     self.dtype,
@@ -331,6 +334,11 @@ class TransformerQNet(nn.Module):
                     moe_top_k=self.moe_top_k,
                     moe_capacity_factor=self.moe_capacity_factor,
                     moe_mesh=self.moe_mesh,
+                    # Explicit name: nn.remat changes the class name and
+                    # with it the auto-name, and the param tree must stay
+                    # identical with remat on/off (checkpoints, actor
+                    # twins).
+                    name=f"SelfAttentionBlock_{i}",
                 )(z, segs, positions)
         z = nn.LayerNorm(dtype=self.dtype)(z)
         h = nn.relu(nn.Dense(128, kernel_init=_glorot, dtype=self.dtype)(z))

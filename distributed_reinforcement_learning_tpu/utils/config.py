@@ -140,6 +140,7 @@ def load_config(path: str | Path, section: str):
             pipeline=d.get("pipeline", False),
             pipeline_microbatches=d.get("pipeline_microbatches", 2),
             pipeline_stages=d.get("pipeline_stages", 0),
+            remat=d.get("remat", False),
         )
     else:
         raise ValueError(f"unknown algorithm {algorithm!r}")

@@ -470,3 +470,97 @@ class TestVirtualPipelineStages:
         done = jnp.zeros((4, 8), bool)
         with pytest.raises(ValueError, match="divide num_layers"):
             bad.init(jax.random.PRNGKey(0), obs, pa, done)
+
+
+class TestRemat:
+    """remat must change memory behavior only — values AND grads stay
+    identical across all three body paths (module, stacked-scan,
+    pipelined)."""
+
+    def _data(self, b=4, t=8):
+        rng = np.random.RandomState(17)
+        return (jnp.asarray(rng.randn(b, t, 2).astype(np.float32)),
+                jnp.asarray(rng.randint(0, 3, (b, t))),
+                jnp.zeros((b, t), bool).at[:, 3].set(True))
+
+    @pytest.mark.parametrize("kw", [
+        {},  # module body
+        {"stack_layers": True},  # stacked scan body
+    ])
+    def test_grads_match_no_remat(self, kw):
+        obs, pa, done = self._data()
+        base = TransformerQNet(num_actions=3, d_model=32, num_heads=2,
+                               num_layers=2, max_len=16, **kw)
+        rem = TransformerQNet(num_actions=3, d_model=32, num_heads=2,
+                              num_layers=2, max_len=16, remat=True, **kw)
+        params = {"params": base.init(jax.random.PRNGKey(3), obs, pa, done)["params"]}
+
+        def loss(model, p):
+            return jnp.sum(model.apply(p, obs, pa, done) ** 2)
+
+        g0 = jax.jit(jax.grad(lambda p: loss(base, p)))(params)
+        g1 = jax.jit(jax.grad(lambda p: loss(rem, p)))(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            g0, g1)
+
+    def test_pipelined_remat_trains(self):
+        from distributed_reinforcement_learning_tpu.parallel import (
+            ShardedLearner, make_mesh)
+
+        mesh = make_mesh(8, pipe_parallel=2)
+        cfg = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                            d_model=32, num_heads=2, num_layers=2, pipeline=True,
+                            pipeline_microbatches=2, remat=True)
+        agent = XformerAgent(cfg, mesh=mesh)
+        learner = ShardedLearner(agent, mesh, num_data_args=2, num_aux_outputs=2)
+        state = learner.init_state(jax.random.PRNGKey(0))
+        batch, w = synthetic_xformer_batch(16, 8, (2,), 3, seed=18)
+        state, pri, metrics = learner.learn(state, *learner.shard_batch((batch, w)))
+        assert np.isfinite(float(metrics["loss"]))
+        assert np.all(np.isfinite(np.asarray(pri)))
+
+
+class TestRematCompositions:
+    """remat over the module body must also compose with the ring
+    shard_map and with MoE's sown aux losses — the combinations a
+    config can legally request."""
+
+    def test_remat_with_ring_attention(self):
+        from distributed_reinforcement_learning_tpu.parallel import make_mesh
+
+        mesh = make_mesh(8, seq_parallel=4)
+        base = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                             d_model=32, num_heads=2, num_layers=2,
+                             attention="ring")
+        rem = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                            d_model=32, num_heads=2, num_layers=2,
+                            attention="ring", remat=True)
+        a0 = XformerAgent(base, mesh=mesh)
+        a1 = XformerAgent(rem, mesh=mesh)
+        batch, w = synthetic_xformer_batch(8, 8, (2,), 3, seed=19)
+        s0 = a0.init_state(jax.random.PRNGKey(4))
+        s1 = a1.init_state(jax.random.PRNGKey(4))
+        _, pri0, m0 = a0.learn(s0, batch, w)
+        _, pri1, m1 = a1.learn(s1, batch, w)
+        np.testing.assert_allclose(np.asarray(pri0), np.asarray(pri1), atol=1e-4)
+        assert abs(float(m0["loss"]) - float(m1["loss"])) < 1e-5
+
+    def test_remat_with_moe_keeps_aux_loss(self):
+        base = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                             d_model=32, num_heads=2, num_layers=2, num_experts=4)
+        rem = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                            d_model=32, num_heads=2, num_layers=2, num_experts=4,
+                            remat=True)
+        a0 = XformerAgent(base)
+        a1 = XformerAgent(rem)
+        batch, w = synthetic_xformer_batch(8, 8, (2,), 3, seed=20)
+        s0 = a0.init_state(jax.random.PRNGKey(5))
+        s1 = a1.init_state(jax.random.PRNGKey(5))
+        _, _, m0 = a0.learn(s0, batch, w)
+        _, _, m1 = a1.learn(s1, batch, w)
+        # Identical params + batch: the losses (incl. the sown router aux
+        # term) must agree — a remat that silently dropped the 'losses'
+        # collection would make m1 strictly smaller.
+        assert abs(float(m0["loss"]) - float(m1["loss"])) < 1e-5
