@@ -283,7 +283,12 @@ class TransformerQNet(nn.Module):
                     p, zz, ss, num_heads=self.num_heads, dtype=self.dtype)
 
             if self.remat:
-                block = jax.checkpoint(block)
+                # prevent_cse=False: this block only ever runs under
+                # lax.scan (layer scan / pipeline stage scan), whose loop
+                # structure already provides the guarantee prevent_cse's
+                # optimization barriers exist for — keeping them would
+                # just block XLA fusion inside the remat body.
+                block = jax.checkpoint(block, prevent_cse=False)
             apply = lambda p, zz: block(p, zz, segs)
             if self.pipeline_mesh is not None:
                 from distributed_reinforcement_learning_tpu.parallel import pipeline as pp

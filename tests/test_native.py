@@ -321,3 +321,27 @@ class TestConcurrentBatchConsumers:
                 np.testing.assert_allclose(batch["payload"][j], float(tag))
                 seen.append(tag)
         assert sorted(seen) == list(range(n_batches * B))
+
+
+class TestThreadSanitizer:
+    """Build the C++ stress workload under -fsanitize=thread and run it:
+    any data race in the ring queue or SumTree fails the test via
+    TSAN's nonzero exit (the reference has no race detection at all —
+    SURVEY §5.2)."""
+
+    def test_stress_under_tsan(self):
+        import os
+        import subprocess
+
+        cpp = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "distributed_reinforcement_learning_tpu", "cpp")
+        build = subprocess.run(["make", "tsan"], cwd=cpp, capture_output=True,
+                               text=True, timeout=120)
+        if build.returncode != 0:
+            pytest.skip(f"tsan build unavailable: {build.stderr[-200:]}")
+        run = subprocess.run([os.path.join(cpp, "build", "stress_tsan")],
+                             capture_output=True, text=True, timeout=300)
+        assert run.returncode == 0, (run.stdout, run.stderr[-2000:])
+        assert "ThreadSanitizer" not in run.stderr, run.stderr[-2000:]
+        assert "stress ok" in run.stdout
