@@ -72,6 +72,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _marginal_step_s(window, iters: int) -> float:
+    """Per-step seconds from two pipelined dispatch windows.
+
+    `window(n)` dispatches n steps and returns elapsed seconds, forcing
+    completion only by materializing one final host float (see
+    bench_learn_step's methodology note). The marginal rate between the
+    `iters` and `2*iters` windows strips the constant overhead (dispatch
+    ramp + the single materialization round trip). Shared by every
+    learn-step benchmark section.
+    """
+    window(max(iters // 4, 5))  # warm the dispatch path
+    t1 = window(iters)
+    t2 = window(2 * iters)
+    return max((t2 - t1) / iters, 1e-9)
+
+
 def _make_batch(cfg, B: int):
     from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_impala_batch
 
@@ -106,21 +122,21 @@ def bench_learn_step(cfg, B: int, iters: int) -> dict:
     loss0 = float(metrics["total_loss"])
     compile_s = time.perf_counter() - t0
 
-    def window(state, n):
+    box = {"state": state, "loss": loss0}
+
+    def window(n):
         t0 = time.perf_counter()
+        state = box["state"]
         for _ in range(n):
             state, metrics = agent.learn(state, batch)
-        loss = float(metrics["total_loss"])  # the only completion barrier
-        return state, time.perf_counter() - t0, loss
+        box["loss"] = float(metrics["total_loss"])  # the only completion barrier
+        box["state"] = state
+        return time.perf_counter() - t0
 
-    state, _, _ = window(state, max(iters // 4, 5))  # warm the dispatch path
-    state, t1, _ = window(state, iters)
-    state, t2, loss = window(state, 2 * iters)
-    step_s = max((t2 - t1) / iters, 1e-9)
+    step_s = _marginal_step_s(window, iters)
     fps = B * cfg.trajectory / step_s
-    print(f"[bench] learn B={B}: windows {t1:.3f}s/{t2:.3f}s over {iters}/{2*iters} "
-          f"steps = {1e3*step_s:.3f}ms/step = {fps:,.0f} frames/s "
-          f"(compile {compile_s:.1f}s, loss {loss0:.1f}->{loss:.1f})",
+    print(f"[bench] learn B={B}: {1e3*step_s:.3f}ms/step = {fps:,.0f} frames/s "
+          f"(compile {compile_s:.1f}s, loss {loss0:.1f}->{box['loss']:.1f})",
           file=sys.stderr)
     return {"B": B, "frames_per_s": round(fps, 1), "step_ms": round(1e3 * step_s, 3),
             "compile_s": round(compile_s, 1)}
@@ -235,21 +251,22 @@ def bench_r2d2_learn(B: int, iters: int) -> dict:
     batch = jax.device_put(jax.tree.map(jnp.asarray, batch))
     w = jax.device_put(jnp.asarray(w))
 
-    def window(state, n):
+    box = {"state": state, "loss": float("nan")}
+
+    def window(n):
         t0 = time.perf_counter()
+        state = box["state"]
         for _ in range(n):
             state, pri, metrics = agent.learn(state, batch, w)
-        loss = float(metrics["loss"])
-        return state, time.perf_counter() - t0, loss
+        box["loss"] = float(metrics["loss"])
+        box["state"] = state
+        return time.perf_counter() - t0
 
-    state, _, _ = window(state, 1)  # compile
-    state, _, _ = window(state, max(iters // 4, 5))
-    state, t1, _ = window(state, iters)
-    state, t2, loss = window(state, 2 * iters)
-    step_s = max((t2 - t1) / iters, 1e-9)
+    window(1)  # compile
+    step_s = _marginal_step_s(window, iters)
     fps = B * cfg.seq_len / step_s
     print(f"[bench] r2d2 learn B={B}: {1e3*step_s:.3f}ms/step = {fps:,.0f} frames/s "
-          f"(loss {loss:.4f})", file=sys.stderr)
+          f"(loss {box['loss']:.4f})", file=sys.stderr)
     return {"B": B, "frames_per_s": round(fps, 1), "step_ms": round(1e3 * step_s, 3)}
 
 
@@ -270,21 +287,22 @@ def bench_apex_learn(B: int, iters: int) -> dict:
     batch = jax.device_put(jax.tree.map(jnp.asarray, batch))
     w = jax.device_put(jnp.asarray(w))
 
-    def window(state, n):
+    box = {"state": state, "loss": float("nan")}
+
+    def window(n):
         t0 = time.perf_counter()
+        state = box["state"]
         for _ in range(n):
             state, td, metrics = agent.learn(state, batch, w)
-        loss = float(metrics["loss"])
-        return state, time.perf_counter() - t0, loss
+        box["loss"] = float(metrics["loss"])
+        box["state"] = state
+        return time.perf_counter() - t0
 
-    state, _, _ = window(state, 1)  # compile
-    state, _, _ = window(state, max(iters // 4, 5))
-    state, t1, _ = window(state, iters)
-    state, t2, loss = window(state, 2 * iters)
-    step_s = max((t2 - t1) / iters, 1e-9)
+    window(1)  # compile
+    step_s = _marginal_step_s(window, iters)
     tps = B / step_s
     print(f"[bench] apex learn B={B}: {1e3*step_s:.3f}ms/step = {tps:,.0f} transitions/s "
-          f"(loss {loss:.4f})", file=sys.stderr)
+          f"(loss {box['loss']:.4f})", file=sys.stderr)
     return {"B": B, "transitions_per_s": round(tps, 1), "step_ms": round(1e3 * step_s, 3)}
 
 

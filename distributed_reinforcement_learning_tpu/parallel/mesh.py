@@ -80,6 +80,17 @@ def make_mesh(
     return Mesh(arr, (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, EXPERT_AXIS, MODEL_AXIS))
 
 
+def pcast_varying(x, axes: tuple[str, ...]):
+    """`lax.pcast(..., to="varying")` over exactly the axes `x` is not
+    already varying on (pcast rejects already-varying axes). The shared
+    idiom for typing shard_map carries whose loop bodies write
+    shard-dependent values into an invarying init — used by the ring
+    attention accumulators and the pipeline schedule."""
+    have = set(getattr(jax.typeof(x), "vma", ()))
+    need = tuple(a for a in axes if a not in have)
+    return jax.lax.pcast(x, need, to="varying") if need else x
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

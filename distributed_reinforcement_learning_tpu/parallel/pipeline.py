@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from distributed_reinforcement_learning_tpu.parallel.mesh import PIPE_AXIS
+from distributed_reinforcement_learning_tpu.parallel.mesh import PIPE_AXIS, pcast_varying
 
 
 def stack_stage_params(init_fn: Callable[[jax.Array], Any], rng: jax.Array, n_stages: int):
@@ -68,16 +68,7 @@ def _pipeline_shard(
     # ppermute fills unsourced entries (stage 0's receive) with zeros;
     # they are dead — stage 0 always selects the fresh microbatch.
     shift = [(i, i + 1) for i in range(n_stages - 1)]
-
-    def varying(x):
-        """pcast to varying over the pipe (+batch) axes, skipping any the
-        value already varies over (pcast rejects those) — batch-sharded
-        activations arrive varying over the batch axis, fresh zeros
-        don't."""
-        have = set(getattr(jax.typeof(x), "vma", ()))
-        need = tuple(a for a in (axis_name, *varying_axes) if a not in have)
-        return jax.lax.pcast(x, need, to="varying") if need else x
-
+    varying = lambda x: pcast_varying(x, (axis_name, *varying_axes))
     zero_mb = jax.tree.map(lambda a: varying(jnp.zeros_like(a[0])), mb)
 
     def tick(carry, t):

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_reinforcement_learning_tpu.ops import attention as att
-from distributed_reinforcement_learning_tpu.parallel.mesh import SEQ_AXIS
+from distributed_reinforcement_learning_tpu.parallel.mesh import SEQ_AXIS, pcast_varying
 
 
 def _varying_acc(q, axis_name: str, varying_axes=()):
@@ -44,7 +44,7 @@ def _varying_acc(q, axis_name: str, varying_axes=()):
     shard_map's VMA typing rejects an unvarying init against a varying
     carry. One helper so both ring bodies share the workaround."""
     return jax.tree.map(
-        lambda x: jax.lax.pcast(x, (axis_name, *varying_axes), to="varying"),
+        lambda x: pcast_varying(x, (axis_name, *varying_axes)),
         att.attention_block_init(q),
     )
 

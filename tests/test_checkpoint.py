@@ -297,3 +297,38 @@ def test_kill_and_resume_keeps_replay(family, tmp_path):
     # Trains immediately from the restored buffer, no re-warm-up.
     m = learner2.train()
     assert m is not None and np.isfinite(m["loss"])
+
+
+@pytest.mark.parametrize("variant", ["moe", "stacked"])
+def test_new_param_layouts_roundtrip(variant, tmp_path):
+    """MoE (nested 'moe' subtree) and stacked ([L, ...] 'blocks_stacked')
+    param layouts must survive a checkpoint save/restore bit-exactly —
+    they are new pytree shapes the generic serializer must not mangle."""
+    import jax
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.agents.xformer import (
+        XformerAgent, XformerConfig)
+    from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+    kw = {"num_experts": 4} if variant == "moe" else {"stacked": True}
+    cfg = XformerConfig(obs_shape=(2,), num_actions=3, seq_len=8, burn_in=2,
+                        d_model=32, num_heads=2, num_layers=2, **kw)
+    agent = XformerAgent(cfg)
+    state = agent.init_state(jax.random.PRNGKey(7))
+    from distributed_reinforcement_learning_tpu.utils.synthetic import (
+        synthetic_xformer_batch)
+
+    batch, w = synthetic_xformer_batch(8, 8, (2,), 3, seed=30)
+    state, _, _ = agent.learn(state, batch, w)
+
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(1, state)
+    restored, extra, step = ckpt.restore(state)
+    assert step == 1
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state, restored)
+    # Restored state must keep training.
+    state2, _, m = agent.learn(restored, batch, w)
+    assert np.isfinite(float(m["loss"]))
