@@ -99,100 +99,115 @@ class XformerBatch(NamedTuple):
     done: jax.Array  # [B, T] bool
 
 
+def build_transformer_models(cfg, mesh, *, seq_len: int, head: str = "dueling_q"):
+    """(model, plain_apply_twin) for any transformer-family config.
+
+    Shared by the Transformer-R2D2 and Transformer-IMPALA agents: `cfg`
+    supplies the body knobs (attention / num_experts+moe_* / pipeline* /
+    stacked / remat / d_model / num_heads / num_layers / num_actions /
+    dtype); `head` picks the output head. The twin applies the SAME
+    params without collective schedules or sharding constraints — for
+    acting on rolling windows and for scoring ragged ingest batches —
+    and is the model itself when no sharded feature is on.
+    """
+    attention_fn = None
+    sequence_perm = None
+    if cfg.attention != "dense":
+        if mesh is None:
+            raise ValueError(f"attention={cfg.attention!r} needs a mesh")
+        from distributed_reinforcement_learning_tpu.parallel import sequence as sp
+        from distributed_reinforcement_learning_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+        fns = {
+            "ring": sp.ring_attention,
+            # pre_permuted: the MODEL holds its stream in zigzag
+            # layout for the whole forward (one reorder, not one per
+            # layer) via sequence_perm below.
+            "ring_zigzag": functools.partial(
+                sp.ring_attention, schedule="zigzag", pre_permuted=True),
+            "ulysses": sp.ulysses_attention,
+        }
+        if cfg.attention not in fns:
+            raise ValueError(
+                f"unknown attention {cfg.attention!r}; one of "
+                f"['dense', {', '.join(map(repr, fns))}]")
+        attention_fn = functools.partial(
+            lambda f, q, k, v, segs: f(
+                mesh, q, k, v, causal=True, batch_axis=DATA_AXIS, segment_ids=segs
+            ),
+            fns[cfg.attention],
+        )
+        if cfg.attention == "ring_zigzag":
+            sequence_perm = sp.zigzag_permutation(seq_len, mesh.shape[SEQ_AXIS])
+    moe_mesh = None
+    if cfg.num_experts and mesh is not None:
+        from distributed_reinforcement_learning_tpu.parallel.mesh import EXPERT_AXIS
+
+        if mesh.shape.get(EXPERT_AXIS, 1) > 1:
+            moe_mesh = mesh
+    pipeline_mesh = None
+    if cfg.pipeline:
+        if mesh is None:
+            raise ValueError("pipeline=True needs a mesh with a 'pipe' axis")
+        if cfg.attention != "dense" or cfg.num_experts:
+            raise ValueError(
+                "pipeline is exclusive with sequence-parallel attention and MoE")
+        if cfg.pipeline_stages < 0 or cfg.pipeline_stages == 1:
+            raise ValueError(
+                f"pipeline_stages must be 0 (one stage per layer) or >= 2, "
+                f"got {cfg.pipeline_stages}")
+        from distributed_reinforcement_learning_tpu.parallel.mesh import PIPE_AXIS
+
+        want = cfg.pipeline_stages or cfg.num_layers
+        if cfg.num_layers % want != 0:
+            raise ValueError(
+                f"pipeline_stages={cfg.pipeline_stages} must divide "
+                f"num_layers={cfg.num_layers}")
+        have = mesh.shape.get(PIPE_AXIS, 1)
+        if have != want:
+            raise ValueError(
+                f"mesh pipe axis is {have} but the config asks for "
+                f"{want} stages (pipeline_stages={cfg.pipeline_stages}, "
+                f"num_layers={cfg.num_layers})")
+        pipeline_mesh = mesh
+    make_model = lambda fn, perm=None, pipe=None, moe_mesh=moe_mesh: TransformerQNet(
+        num_actions=cfg.num_actions,
+        d_model=cfg.d_model,
+        num_heads=cfg.num_heads,
+        num_layers=cfg.num_layers,
+        max_len=max(seq_len, 16),
+        dtype=cfg.dtype,
+        attention_fn=fn,
+        sequence_perm=perm,
+        num_experts=cfg.num_experts,
+        moe_top_k=cfg.moe_top_k,
+        moe_capacity_factor=cfg.moe_capacity_factor,
+        moe_mesh=moe_mesh,
+        stack_layers=cfg.pipeline or cfg.stacked,
+        pipeline_mesh=pipe,
+        pipeline_microbatches=cfg.pipeline_microbatches,
+        remat=cfg.remat,
+        head=head,
+    )
+    model = make_model(attention_fn, sequence_perm, pipeline_mesh)
+    # Plain-apply twin over the SAME params — see docstring. (For the
+    # pipelined model the twin keeps stack_layers — same param layout —
+    # but applies the stages with the plain scan; for expert-parallel
+    # MoE it drops the sharding constraints.)
+    twin = (
+        make_model(None, moe_mesh=None)
+        if (attention_fn is not None or pipeline_mesh is not None or moe_mesh is not None)
+        else model
+    )
+    return model, twin
+
+
 class XformerAgent(common.SequenceReplayLearnMixin):
     def __init__(self, cfg: XformerConfig, mesh=None):
         self.cfg = cfg
         self._mesh = mesh
-        attention_fn = None
-        sequence_perm = None
-        if cfg.attention != "dense":
-            if mesh is None:
-                raise ValueError(f"attention={cfg.attention!r} needs a mesh")
-            from distributed_reinforcement_learning_tpu.parallel import sequence as sp
-            from distributed_reinforcement_learning_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
-
-            fns = {
-                "ring": sp.ring_attention,
-                # pre_permuted: the MODEL holds its stream in zigzag
-                # layout for the whole forward (one reorder, not one per
-                # layer) via sequence_perm below.
-                "ring_zigzag": functools.partial(
-                    sp.ring_attention, schedule="zigzag", pre_permuted=True),
-                "ulysses": sp.ulysses_attention,
-            }
-            if cfg.attention not in fns:
-                raise ValueError(
-                    f"unknown attention {cfg.attention!r}; one of "
-                    f"['dense', {', '.join(map(repr, fns))}]")
-            attention_fn = functools.partial(
-                lambda f, q, k, v, segs: f(
-                    mesh, q, k, v, causal=True, batch_axis=DATA_AXIS, segment_ids=segs
-                ),
-                fns[cfg.attention],
-            )
-            if cfg.attention == "ring_zigzag":
-                sequence_perm = sp.zigzag_permutation(cfg.seq_len, mesh.shape[SEQ_AXIS])
-        moe_mesh = None
-        if cfg.num_experts and mesh is not None:
-            from distributed_reinforcement_learning_tpu.parallel.mesh import EXPERT_AXIS
-
-            if mesh.shape.get(EXPERT_AXIS, 1) > 1:
-                moe_mesh = mesh
-        pipeline_mesh = None
-        if cfg.pipeline:
-            if mesh is None:
-                raise ValueError("pipeline=True needs a mesh with a 'pipe' axis")
-            if cfg.attention != "dense" or cfg.num_experts:
-                raise ValueError(
-                    "pipeline is exclusive with sequence-parallel attention and MoE")
-            if cfg.pipeline_stages < 0 or cfg.pipeline_stages == 1:
-                raise ValueError(
-                    f"pipeline_stages must be 0 (one stage per layer) or >= 2, "
-                    f"got {cfg.pipeline_stages}")
-            from distributed_reinforcement_learning_tpu.parallel.mesh import PIPE_AXIS
-
-            want = cfg.pipeline_stages or cfg.num_layers
-            if cfg.num_layers % want != 0:
-                raise ValueError(
-                    f"pipeline_stages={cfg.pipeline_stages} must divide "
-                    f"num_layers={cfg.num_layers}")
-            have = mesh.shape.get(PIPE_AXIS, 1)
-            if have != want:
-                raise ValueError(
-                    f"mesh pipe axis is {have} but the config asks for "
-                    f"{want} stages (pipeline_stages={cfg.pipeline_stages}, "
-                    f"num_layers={cfg.num_layers})")
-            pipeline_mesh = mesh
-        make_model = lambda fn, perm=None, pipe=None, moe_mesh=moe_mesh: TransformerQNet(
-            num_actions=cfg.num_actions,
-            d_model=cfg.d_model,
-            num_heads=cfg.num_heads,
-            num_layers=cfg.num_layers,
-            max_len=max(cfg.seq_len, 16),
-            dtype=cfg.dtype,
-            attention_fn=fn,
-            sequence_perm=perm,
-            num_experts=cfg.num_experts,
-            moe_top_k=cfg.moe_top_k,
-            moe_capacity_factor=cfg.moe_capacity_factor,
-            moe_mesh=moe_mesh,
-            stack_layers=cfg.pipeline or cfg.stacked,
-            pipeline_mesh=pipe,
-            pipeline_microbatches=cfg.pipeline_microbatches,
-            remat=cfg.remat,
-        )
-        self.model = make_model(attention_fn, sequence_perm, pipeline_mesh)
-        # Dense twin over the SAME params: ingest-time priority scoring
-        # runs on whatever ragged batch the queue drained, which need not
-        # divide the mesh's data axis the way fixed-size learn batches do.
-        # (For the pipelined model the twin keeps stack_layers — same
-        # param layout — but applies the stages with the plain scan; for
-        # expert-parallel MoE it drops the sharding constraints.)
-        self._dense_model = (
-            make_model(None, moe_mesh=None)
-            if (attention_fn is not None or pipeline_mesh is not None or moe_mesh is not None)
-            else self.model
-        )
+        self.model, self._dense_model = build_transformer_models(
+            cfg, mesh, seq_len=cfg.seq_len)
         self.tx = common.adam_with_clip(cfg.learning_rate, clip_norm=None)
         self.act = jax.jit(self._act)
         self.td_error = jax.jit(self._td_error)

@@ -1,0 +1,210 @@
+"""Transformer-IMPALA agent: V-trace actor-critic on the causal
+transformer trunk.
+
+Fifth algorithm family, composing the framework's two halves: IMPALA's
+off-policy-corrected actor-critic math (`agents/impala.py`, traced to
+`/root/reference/agent/impala.py:63-100`) over the transformer trunk of
+`models/transformer_net.py` (`head="actor_critic"`). What changes vs the
+conv-LSTM IMPALA:
+
+- No stored state at all. The conv-LSTM learner re-seeds every timestep
+  from actor-recorded (h, c) (`model/impala_actor_critic.py:73-114`);
+  here the unroll IS the context — one `[B, T]` forward with episode-
+  segment masking standing in for done-masked state resets, and the
+  queue payload drops the two `[B, T, H]` state tensors.
+- The actor acts on a rolling window of its recent history (exactly the
+  Transformer-R2D2 actor's mechanism) and records the window-final
+  softmax as the behavior policy V-trace corrects against.
+- Every transformer body feature applies: ring/zigzag/Ulysses sequence
+  parallelism (V-trace over a sequence-sharded forward — a combination
+  no recurrent IMPALA can express), MoE experts, GPipe pipelining,
+  activation remat.
+
+Loss math parity with `agents/impala.py:_loss` (same double V-trace over
+first/middle views, pg advantage, sum-reduced losses, RMSProp + poly LR
++ global-norm clip); only the forward differs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.agents import common
+from distributed_reinforcement_learning_tpu.agents.xformer import build_transformer_models
+from distributed_reinforcement_learning_tpu.ops import vtrace
+
+
+@dataclasses.dataclass(frozen=True)
+class XImpalaConfig:
+    """IMPALA hyperparameters + transformer body knobs.
+
+    Field names deliberately mirror `ImpalaConfig` (loss/optimizer side)
+    and `XformerConfig` (body side) so config sections and
+    `build_transformer_models` serve all of them.
+    """
+
+    obs_shape: tuple[int, ...] = (2,)
+    num_actions: int = 2
+    trajectory: int = 20  # unroll length == acting window
+    d_model: int = 256
+    num_heads: int = 4
+    num_layers: int = 2
+    discount_factor: float = 0.99
+    baseline_loss_coef: float = 1.0
+    entropy_coef: float = 0.05
+    gradient_clip_norm: float = 40.0
+    reward_clipping: str = "abs_one"
+    start_learning_rate: float = 6e-4
+    end_learning_rate: float = 0.0
+    learning_frame: int = 1_000_000_000
+    dtype: Any = jnp.float32
+    # Body knobs consumed by build_transformer_models:
+    attention: str = "dense"
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 1e-2
+    pipeline: bool = False
+    pipeline_microbatches: int = 2
+    pipeline_stages: int = 0
+    stacked: bool = False
+    remat: bool = False
+
+
+class XImpalaBatch(NamedTuple):
+    """One learner batch: `[B, T, ...]` unrolls — the IMPALA queue
+    payload (`agents/impala.py` ImpalaBatch) minus the stored (h, c).
+
+    `done` carries the RECORDED flags (life-loss shaping may set it
+    where the episode continues, `train_impala.py:149-154`) and gates
+    the V-trace discounts; `env_done` carries the true episode ends and
+    gates the attention segments — the conv-LSTM parity point: its
+    actor-recorded (h, c) only reset at env done, so the transformer's
+    context must also span life losses, else the learn-time policy sees
+    truncated context the behavior policy never saw."""
+
+    state: jax.Array  # [B, T, *obs]
+    reward: jax.Array  # [B, T] f32 raw rewards
+    action: jax.Array  # [B, T] i32
+    done: jax.Array  # [B, T] bool recorded (shaped) flags -> discounts
+    env_done: jax.Array  # [B, T] bool true episode ends -> attention segments
+    behavior_policy: jax.Array  # [B, T, A] f32 softmax at act time
+    previous_action: jax.Array  # [B, T] i32
+
+
+class XImpalaActOutput(NamedTuple):
+    action: jax.Array  # [N]
+    policy: jax.Array  # [N, A] window-final softmax (the behavior policy)
+
+
+class XImpalaAgent:
+    """Thin wrapper binding config + transformer model to jitted pure
+    functions; learn signature matches ImpalaAgent's, so the IMPALA
+    learner runner and ShardedLearner defaults apply unchanged."""
+
+    def __init__(self, cfg: XImpalaConfig, mesh=None):
+        self.cfg = cfg
+        self._mesh = mesh
+        self.model, self._dense_model = build_transformer_models(
+            cfg, mesh, seq_len=cfg.trajectory, head="actor_critic")
+        self._schedule = common.polynomial_lr(
+            cfg.start_learning_rate, cfg.end_learning_rate, cfg.learning_frame)
+        self.tx = common.rmsprop_with_clip(self._schedule, cfg.gradient_clip_norm)
+        self.act = jax.jit(self._act)
+        self.learn = jax.jit(self._learn, donate_argnums=(0,))
+
+    # -- init ------------------------------------------------------------
+    def init_state(self, rng: jax.Array) -> common.TrainState:
+        t = self.cfg.trajectory
+        # Sharded forwards (ring shard_map / pipeline) run at init too,
+        # so the dummy batch must cover the data axis and microbatching.
+        b = 1 if self._mesh is None else self._mesh.shape.get("data", 1)
+        if self.cfg.pipeline:
+            b *= self.cfg.pipeline_microbatches
+        obs = jnp.zeros((b, t, *self.cfg.obs_shape), jnp.float32)
+        pa = jnp.zeros((b, t), jnp.int32)
+        done = jnp.zeros((b, t), bool)
+        variables = self.model.init(rng, obs, pa, done)
+        params = {"params": variables["params"]}  # drop sown collections
+        return common.TrainState.create(params, self.tx)
+
+    # -- act -------------------------------------------------------------
+    def _act(self, params, obs_win, prev_action_win, done_win, rng) -> XImpalaActOutput:
+        """Sample from the window-final softmax policy.
+
+        Same sampling parity as the conv-LSTM agent
+        (`agents/impala.py:_act` <- `agent/impala.py:118-130`), with the
+        rolling window as the recurrent state; always runs the
+        plain-apply twin (collective schedules are wrong on an actor
+        host).
+        """
+        policy, _ = self._dense_model.apply(
+            params, common.normalize_obs(obs_win), prev_action_win, done_win)
+        policy = policy[:, -1]
+        action = jax.random.categorical(rng, jnp.log(policy + 1e-20), axis=-1)
+        return XImpalaActOutput(action, policy)
+
+    # -- learn -----------------------------------------------------------
+    def _forward(self, params, batch: XImpalaBatch):
+        obs = common.normalize_obs(batch.state)
+        # env_done, not the shaped done: attention context follows true
+        # episode boundaries (see XImpalaBatch).
+        if self.cfg.num_experts:
+            (policy, value), sown = self.model.apply(
+                params, obs, batch.previous_action, batch.env_done,
+                mutable=["losses"])
+            aux = self.cfg.moe_aux_weight * sum(
+                jnp.asarray(x) for x in jax.tree.leaves(sown.get("losses", {})))
+            return policy, value, aux
+        policy, value = self.model.apply(
+            params, obs, batch.previous_action, batch.env_done)
+        return policy, value, 0.0
+
+    def _loss(self, params, batch: XImpalaBatch):
+        cfg = self.cfg
+        policy, value, aux = self._forward(params, batch)
+
+        clipped_r = common.clip_rewards(batch.reward, cfg.reward_clipping)
+        discounts = (~batch.done).astype(jnp.float32) * cfg.discount_factor
+
+        first_p, middle_p, _ = vtrace.split_data(policy)
+        first_v, middle_v, last_v = vtrace.split_data(value)
+        first_a, middle_a, _ = vtrace.split_data(batch.action)
+        first_r, middle_r, _ = vtrace.split_data(clipped_r)
+        first_d, middle_d, _ = vtrace.split_data(discounts)
+        first_b, middle_b, _ = vtrace.split_data(batch.behavior_policy)
+
+        vs, rho = vtrace.from_softmax(
+            behavior_policy=first_b, target_policy=first_p, actions=first_a,
+            discounts=first_d, rewards=first_r, values=first_v, next_values=middle_v)
+        vs_plus_1, _ = vtrace.from_softmax(
+            behavior_policy=middle_b, target_policy=middle_p, actions=middle_a,
+            discounts=middle_d, rewards=middle_r, values=middle_v, next_values=last_v)
+
+        pg_adv = jax.lax.stop_gradient(rho * (first_r + first_d * vs_plus_1 - first_v))
+
+        pi_loss = vtrace.policy_gradient_loss(first_p, first_a, pg_adv)
+        v_loss = vtrace.baseline_loss(vs, first_v)
+        ent_loss = vtrace.entropy_loss(first_p)
+        total = (pi_loss + cfg.baseline_loss_coef * v_loss
+                 + cfg.entropy_coef * ent_loss + aux)
+        metrics = {
+            "pi_loss": pi_loss,
+            "baseline_loss": v_loss,
+            "entropy": ent_loss,
+            "total_loss": total,
+        }
+        return total, metrics
+
+    def _learn(self, state: common.TrainState, batch: XImpalaBatch):
+        grads, metrics = jax.grad(self._loss, has_aux=True)(state.params, batch)
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        metrics["grad_norm"] = common.global_norm(grads)
+        metrics["learning_rate"] = self._schedule(state.step)
+        new_state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
+        return new_state, metrics

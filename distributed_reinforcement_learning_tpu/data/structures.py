@@ -16,9 +16,10 @@ from distributed_reinforcement_learning_tpu.agents.impala import ImpalaBatch
 from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Batch
 
 
-class ImpalaTrajectoryAccumulator:
-    """Collects T steps of a `[N]`-env actor, emits N `ImpalaBatch`-shaped
-    pytrees with leading `[T]` axis (no batch dim — the queue stacks them)."""
+class _StackedUnrollAccumulator:
+    """Shared stack-and-split machinery: collect T steps of `[N, ...]`
+    fields, emit one `[T, ...]` batch pytree per env slot (the queue
+    stacks them into `[B, T, ...]`). Subclasses name the batch class."""
 
     def __init__(self):
         self.reset()
@@ -32,14 +33,25 @@ class ImpalaTrajectoryAccumulator:
     def __len__(self) -> int:
         return len(self._steps)
 
-    def extract(self) -> list[ImpalaBatch]:
-        """-> one `[T, ...]` ImpalaBatch per env slot."""
+    def _batch_cls(self):
+        raise NotImplementedError
+
+    def extract(self) -> list:
+        cls = self._batch_cls()
         fields = {
             k: np.stack([s[k] for s in self._steps], axis=1)  # [N, T, ...]
             for k in self._steps[0]
         }
         n = next(iter(fields.values())).shape[0]
-        return [ImpalaBatch(**{k: v[i] for k, v in fields.items()}) for i in range(n)]
+        return [cls(**{k: v[i] for k, v in fields.items()}) for i in range(n)]
+
+
+class ImpalaTrajectoryAccumulator(_StackedUnrollAccumulator):
+    """Collects T steps of a `[N]`-env actor, emits N `ImpalaBatch`-shaped
+    pytrees with leading `[T]` axis (no batch dim — the queue stacks them)."""
+
+    def _batch_cls(self):
+        return ImpalaBatch
 
 
 class R2D2SequenceAccumulator:
@@ -108,7 +120,7 @@ def transitions_from_unroll(
     ]
 
 
-class XformerSequenceAccumulator:
+class XformerSequenceAccumulator(_StackedUnrollAccumulator):
     """Collects seq_len steps per env for the transformer family.
 
     Same queue payload as the R2D2 accumulator minus the stored LSTM
@@ -116,20 +128,18 @@ class XformerSequenceAccumulator:
     sequence is its own state (agents/xformer.py).
     """
 
-    def __init__(self):
-        self._steps: list[dict] = []
-
-    def append(self, **step_fields: np.ndarray) -> None:
-        self._steps.append(step_fields)
-
-    def __len__(self) -> int:
-        return len(self._steps)
-
-    def extract(self) -> list:
+    def _batch_cls(self):
         from distributed_reinforcement_learning_tpu.agents.xformer import XformerBatch
 
-        fields = {
-            k: np.stack([s[k] for s in self._steps], axis=1) for k in self._steps[0]
-        }
-        n = next(iter(fields.values())).shape[0]
-        return [XformerBatch(**{k: v[i] for k, v in fields.items()}) for i in range(n)]
+        return XformerBatch
+
+
+class XImpalaTrajectoryAccumulator(_StackedUnrollAccumulator):
+    """Collects T steps per env for the Transformer-IMPALA family: the
+    IMPALA unroll payload minus the stored (h, c) — the transformer
+    re-attends over the unroll, so the sequence is its own state."""
+
+    def _batch_cls(self):
+        from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaBatch
+
+        return XImpalaBatch

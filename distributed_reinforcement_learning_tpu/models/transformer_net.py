@@ -194,13 +194,24 @@ def _stacked_block_apply(
 
 
 class TransformerQNet(nn.Module):
-    """MLP torso + action embed -> causal transformer -> dueling head.
+    """MLP torso + action embed -> causal transformer -> output head.
 
     One signature: `(obs_seq [B,T,...], prev_action_seq [B,T],
-    done_seq [B,T]) -> q [B,T,A]`. Acting uses the same forward over a
-    rolling window (the actor's "recurrent state" is the window itself);
-    training unrolls the stored sequence exactly like the recurrent nets,
-    so burn-in/double-Q logic is model-agnostic.
+    done_seq [B,T])`. Two heads over the same trunk (every body feature
+    — ring/zigzag/ulysses attention, MoE, stacked layers, pipeline,
+    remat — serves both):
+
+    - `head="dueling_q"` (default): `q [B,T,A]` via the reference's
+      nonstandard dueling `value - learned-mean` form — the
+      Transformer-R2D2 family.
+    - `head="actor_critic"`: `(policy [B,T,A] softmax, value [B,T])` —
+      the Transformer-IMPALA family (V-trace consumes softmax policies,
+      `ops/vtrace.py`).
+
+    Acting uses the same forward over a rolling window (the actor's
+    "recurrent state" is the window itself); training unrolls the stored
+    sequence exactly like the recurrent nets, so the loss-side logic is
+    model-agnostic.
     """
 
     num_actions: int
@@ -238,6 +249,8 @@ class TransformerQNet(nn.Module):
     # activation memory stops growing with num_layers x seq_len at the
     # cost of one extra forward — the standard long-context lever.
     remat: bool = False
+    # "dueling_q" | "actor_critic" — see the class docstring.
+    head: str = "dueling_q"
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, prev_action_seq: jax.Array, done_seq: jax.Array):
@@ -347,9 +360,20 @@ class TransformerQNet(nn.Module):
                 )(z, segs, positions)
         z = nn.LayerNorm(dtype=self.dtype)(z)
         h = nn.relu(nn.Dense(128, kernel_init=_glorot, dtype=self.dtype)(z))
+        unperm = (
+            (lambda x: x)
+            if self.sequence_perm is None
+            else (lambda x: jnp.take(x, jnp.asarray(self.sequence_perm[1]), axis=1))
+        )
+        if self.head == "actor_critic":
+            logits = nn.Dense(
+                self.num_actions, kernel_init=_glorot, dtype=self.dtype
+            )(h).astype(jnp.float32)
+            value = nn.Dense(1, kernel_init=_glorot, dtype=self.dtype)(h)
+            policy = jax.nn.softmax(unperm(logits), axis=-1)
+            return policy, unperm(value.astype(jnp.float32)[..., 0])
+        if self.head != "dueling_q":
+            raise ValueError(f"unknown head {self.head!r}")
         q = nn.Dense(self.num_actions, kernel_init=_glorot, dtype=self.dtype)(h)
         mean = nn.Dense(1, kernel_init=_glorot, dtype=self.dtype)(h)
-        q = (q - mean).astype(jnp.float32)
-        if self.sequence_perm is not None:
-            q = jnp.take(q, jnp.asarray(self.sequence_perm[1]), axis=1)
-        return q
+        return unperm((q - mean).astype(jnp.float32))

@@ -88,6 +88,15 @@ def make_act_adapter(algo: str, agent) -> Callable:
             return {"action": action, "q": q}
         xformer_fn.expected_keys = frozenset({"obs", "prev_action", "done", "epsilon"})
         return xformer_fn
+    if algo == "ximpala":
+        # Transformer-IMPALA: rolling-window rows, softmax-sampled
+        # actions + the behavior policy the actor must record.
+        def ximpala_fn(params, rows, rng):
+            out = agent.act(params, rows["obs"], rows["prev_action"],
+                            rows["done"], rng)
+            return {"action": out.action, "policy": out.policy}
+        ximpala_fn.expected_keys = frozenset({"obs", "prev_action", "done"})
+        return ximpala_fn
     raise ValueError(f"unknown algorithm {algo!r}")
 
 

@@ -19,11 +19,18 @@ from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexCo
 from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
 from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Config
 from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent, XformerConfig
+from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaAgent, XImpalaConfig
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
 from distributed_reinforcement_learning_tpu.envs.batched import BatchedEnv
 from distributed_reinforcement_learning_tpu.envs.cartpole import pomdp_project
 from distributed_reinforcement_learning_tpu.envs.registry import make_env
-from distributed_reinforcement_learning_tpu.runtime import apex_runner, impala_runner, r2d2_runner, xformer_runner
+from distributed_reinforcement_learning_tpu.runtime import (
+    apex_runner,
+    impala_runner,
+    r2d2_runner,
+    xformer_runner,
+    ximpala_runner,
+)
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.config import RuntimeConfig, load_config
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
@@ -51,11 +58,17 @@ def _algo_of(agent_cfg: Any) -> str:
         return "r2d2"
     if isinstance(agent_cfg, XformerConfig):
         return "xformer"
+    if isinstance(agent_cfg, XImpalaConfig):
+        return "ximpala"
     raise TypeError(f"unknown agent config {type(agent_cfg)}")
 
 
 _AGENT_CLS = {"impala": ImpalaAgent, "apex": ApexAgent, "r2d2": R2D2Agent,
-              "xformer": XformerAgent}
+              "xformer": XformerAgent, "ximpala": XImpalaAgent}
+
+# Families whose learn step can shard beyond data parallelism (ring/
+# pipeline/expert) and whose actors therefore need plain-apply twins.
+_TRANSFORMER_ALGOS = ("xformer", "ximpala")
 
 
 def mesh_axes_for(agent_cfg: Any, rt: RuntimeConfig) -> tuple[int, int, int]:
@@ -79,7 +92,7 @@ def mesh_axes_for(agent_cfg: Any, rt: RuntimeConfig) -> tuple[int, int, int]:
 def needs_sharded_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig) -> bool:
     """True when the learn step is sharded beyond data parallelism (and
     actors therefore need a plain-apply twin)."""
-    return algo == "xformer" and (
+    return algo in _TRANSFORMER_ALGOS and (
         agent_cfg.attention != "dense"
         or agent_cfg.pipeline
         or (agent_cfg.num_experts > 0 and rt.expert_parallel > 1)
@@ -109,8 +122,9 @@ def make_agent(algo: str, agent_cfg: Any, rt: RuntimeConfig, mesh=None, actor: b
     if needs_sharded_learner(algo, agent_cfg, rt):
         import dataclasses
 
+        cls = _AGENT_CLS[algo]
         if actor:
-            return XformerAgent(dataclasses.replace(
+            return cls(dataclasses.replace(
                 agent_cfg, attention="dense", pipeline=False,
                 stacked=agent_cfg.pipeline or agent_cfg.stacked))
         if mesh is None:
@@ -119,7 +133,7 @@ def make_agent(algo: str, agent_cfg: Any, rt: RuntimeConfig, mesh=None, actor: b
             seq, pipe, expert = mesh_axes_for(agent_cfg, rt)
             mesh = make_mesh(
                 seq_parallel=seq, pipe_parallel=pipe, expert_parallel=expert)
-        return XformerAgent(agent_cfg, mesh=mesh)
+        return cls(agent_cfg, mesh=mesh)
     return _AGENT_CLS[algo](agent_cfg)
 
 
@@ -131,8 +145,10 @@ def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
     `mesh`: optional `jax.sharding.Mesh` — the learn step is pjit-sharded
     over it (batch on the data axis) instead of running single-device."""
     agent = agent or make_agent(algo, agent_cfg, rt, mesh=mesh)
-    if algo == "impala":
-        return impala_runner.ImpalaLearner(
+    if algo in ("impala", "ximpala"):
+        cls = (ximpala_runner.XImpalaLearner if algo == "ximpala"
+               else impala_runner.ImpalaLearner)
+        return cls(
             agent, queue, weights, rt.batch_size, logger=logger, rng=rng,
             prefetch=prefetch, mesh=mesh, publish_interval=rt.publish_interval)
     if algo == "apex":
@@ -173,6 +189,12 @@ def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, w
             agent, env, queue, weights, seed=seed, life_loss_shaping=atari,
             remote_act=remote_act)
     transform = pomdp_project if agent_cfg.obs_shape == (2,) else None
+    if algo == "ximpala":
+        return ximpala_runner.XImpalaActor(
+            agent, env, queue, weights, seed=seed,
+            available_action=rt.available_action[task % len(rt.available_action)],
+            life_loss_shaping=atari, obs_transform=transform,
+            remote_act=remote_act)
     if algo == "xformer":
         return xformer_runner.XformerActor(
             agent, env, queue, weights, seed=seed, obs_transform=transform,
@@ -187,6 +209,7 @@ _RUN_SYNC = {
     "apex": apex_runner.run_sync,
     "r2d2": r2d2_runner.run_sync,
     "xformer": xformer_runner.run_sync,
+    "ximpala": ximpala_runner.run_sync,
 }
 
 

@@ -529,7 +529,7 @@ def run_role(
             algo, agent_cfg, rt, queue, weights, logger=logger,
             rng=jax.random.PRNGKey(seed),
             # Free-running learner: overlap H2D of batch k+1 with step k.
-            prefetch=(algo == "impala"),
+            prefetch=(algo in ("impala", "ximpala")),
             mesh=mesh,
         )
         ckpt = None
@@ -616,7 +616,7 @@ def _learner_loop(
             learner.save_checkpoint(ckpt)
             last_saved = learner.train_steps
 
-    if algo == "impala":
+    if algo in ("impala", "ximpala"):  # same FIFO learner loop
         while learner.train_steps < num_updates:
             learner.step(timeout=5.0)
             maybe_checkpoint()

@@ -1,0 +1,142 @@
+"""Transformer-IMPALA actor/learner loops.
+
+Fifth algorithm family (see agents/ximpala.py): IMPALA's N-actor /
+1-learner FIFO topology (`/root/reference/train_impala.py:89-194`) with
+the conv-LSTM swapped for the causal transformer. The learner is
+EXACTLY the IMPALA learner — it only touches `agent.{learn,init_state}`,
+`cfg.trajectory`, and stacked unroll pytrees from the queue, all of
+which the transformer agent reproduces — so it is reused wholesale
+(`XImpalaLearner`), as are `run_sync`/`run_async` (topology-only).
+
+Only the actor differs from `ImpalaActor`: instead of carrying (h, c)
+it maintains a rolling window of the last `trajectory` steps (the
+Transformer-R2D2 actor's mechanism, `runtime/xformer_runner.py`) and
+records the window-final softmax as the behavior policy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaAgent
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+from distributed_reinforcement_learning_tpu.data.structures import XImpalaTrajectoryAccumulator
+from distributed_reinforcement_learning_tpu.runtime.impala_runner import (
+    ImpalaLearner,
+    run_async,  # noqa: F401  (re-exported: topology-only)
+    run_sync,  # noqa: F401
+)
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+
+class XImpalaLearner(ImpalaLearner):
+    """ImpalaLearner bound to an XImpalaAgent; see module docstring."""
+
+
+class XImpalaActor:
+    def __init__(
+        self,
+        agent: XImpalaAgent,
+        env,  # VectorEnv-like
+        queue: TrajectoryQueue,
+        weights: WeightStore,
+        seed: int = 0,
+        available_action: int | None = None,
+        life_loss_shaping: bool = False,
+        obs_transform=None,  # e.g. envs.cartpole.pomdp_project
+        remote_act=None,  # SEED-style: RemoteInference; no weight pulls
+    ):
+        self.agent = agent
+        self.env = env
+        self.queue = queue
+        self.weights = weights
+        self.available_action = available_action
+        self.life_loss_shaping = life_loss_shaping
+        self.obs_transform = obs_transform or (lambda x: x)
+        self.remote_act = remote_act
+
+        self._rng = jax.random.PRNGKey(seed)
+        self._obs = self.obs_transform(env.reset())
+        n = self._obs.shape[0]
+        w = agent.cfg.trajectory
+        # Rolling window, oldest first; padding slots marked done so
+        # segment masking isolates them (runtime/xformer_runner.py).
+        self._win_obs = np.zeros((n, w, *self._obs.shape[1:]), self._obs.dtype)
+        self._win_pa = np.zeros((n, w), np.int32)
+        self._win_done = np.ones((n, w), bool)
+        self._prev_action = np.zeros(n, np.int32)
+        self._params = None
+        self._version = -1
+        self._lives = np.full(n, -1)
+        self.episode_returns: list[float] = []
+
+    def _sync_params(self) -> None:
+        """Per-unroll weight pull (`train_impala.py:135`)."""
+        got = self.weights.get_if_newer(self._version)
+        if got is not None:
+            self._params, self._version = got
+
+    def _push_window(self, obs, prev_action) -> None:
+        for arr, val in ((self._win_obs, obs), (self._win_pa, prev_action),
+                         (self._win_done, False)):
+            arr[:, :-1] = arr[:, 1:]
+            arr[:, -1] = val
+
+    def run_unroll(self) -> int:
+        """Collect one T-step unroll from all N envs; enqueue N trajectories."""
+        cfg = self.agent.cfg
+        if self.remote_act is None:
+            self._sync_params()
+            if self._params is None:
+                raise RuntimeError("no weights published yet")
+        acc = XImpalaTrajectoryAccumulator()
+        n = self._obs.shape[0]
+
+        for _ in range(cfg.trajectory):
+            self._push_window(self._obs, self._prev_action)
+            if self.remote_act is not None:
+                r = self.remote_act({
+                    "obs": self._win_obs, "prev_action": self._win_pa,
+                    "done": self._win_done})
+                action, policy = np.asarray(r["action"]), np.asarray(r["policy"])
+            else:
+                self._rng, sub = jax.random.split(self._rng)
+                out = self.agent.act(
+                    self._params, self._win_obs, self._win_pa, self._win_done, sub)
+                action, policy = np.asarray(out.action), np.asarray(out.policy)
+            env_actions = (
+                action % self.available_action if self.available_action else action)
+            next_obs_raw, reward, done, infos = self.env.step(env_actions)
+            next_obs = self.obs_transform(next_obs_raw)
+
+            # Life-loss shaping (`train_impala.py:149-154`).
+            rec_reward, rec_done = reward.astype(np.float32), done.copy()
+            if self.life_loss_shaping:
+                lives = infos.get("lives")
+                lost = (lives != self._lives) & (self._lives >= 0) & ~done
+                rec_reward = np.where(lost, -1.0, rec_reward)
+                rec_done = rec_done | lost
+                self._lives = np.where(done, -1, lives)
+
+            acc.append(
+                state=self._obs,
+                reward=rec_reward,
+                action=action,
+                done=rec_done,  # shaped -> V-trace discounts
+                env_done=done,  # true episode ends -> attention segments
+                behavior_policy=policy,
+                previous_action=self._prev_action,
+            )
+
+            self._win_done[:, -1] = done  # now known; future windows see it
+            self._prev_action = np.where(done, 0, action).astype(np.int32)
+            self._obs = next_obs
+            for ret in infos.get("episode_return", [])[done]:
+                if ret > 0:
+                    self.episode_returns.append(float(ret))
+
+        for traj in acc.extract():
+            self.queue.put(traj)
+        return n * cfg.trajectory

@@ -142,6 +142,34 @@ def load_config(path: str | Path, section: str):
             pipeline_stages=d.get("pipeline_stages", 0),
             remat=d.get("remat", False),
         )
+    elif algorithm == "ximpala":
+        from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaConfig
+
+        agent_cfg = XImpalaConfig(
+            obs_shape=tuple(d["model_input"]),
+            num_actions=d["model_output"],
+            trajectory=d.get("trajectory", 20),
+            d_model=d.get("d_model", 128),
+            num_heads=d.get("num_heads", 4),
+            num_layers=d.get("num_layers", 2),
+            discount_factor=d.get("discount_factor", 0.99),
+            baseline_loss_coef=d.get("baseline_loss_coef", 1.0),
+            entropy_coef=d.get("entropy_coef", 0.05),
+            gradient_clip_norm=d.get("gradient_clip_norm", 40.0),
+            reward_clipping=d.get("reward_clipping", "abs_one"),
+            start_learning_rate=d.get("start_learning_rate", 6e-4),
+            end_learning_rate=d.get("end_learning_rate", 0.0),
+            learning_frame=int(d.get("learning_frame", 1e9)),
+            attention=d.get("attention", "dense"),
+            num_experts=d.get("num_experts", 0),
+            moe_top_k=d.get("moe_top_k", 2),
+            moe_capacity_factor=d.get("moe_capacity_factor", 2.0),
+            moe_aux_weight=d.get("moe_aux_weight", 1e-2),
+            pipeline=d.get("pipeline", False),
+            pipeline_microbatches=d.get("pipeline_microbatches", 2),
+            pipeline_stages=d.get("pipeline_stages", 0),
+            remat=d.get("remat", False),
+        )
     else:
         raise ValueError(f"unknown algorithm {algorithm!r}")
 

@@ -114,3 +114,33 @@ def synthetic_xformer_batch(
         done=rng.random((B, T)) < 0.1,
     )
     return batch, rng.random((B,), dtype=np.float32)
+
+
+def synthetic_ximpala_batch(
+    B: int,
+    T: int,
+    obs_shape: tuple[int, ...],
+    num_actions: int,
+    seed: int = 0,
+    uniform_behavior: bool = True,
+):
+    """Random XImpalaBatch (IMPALA unrolls, no stored state)."""
+    from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaBatch
+
+    rng = np.random.default_rng(seed)
+    logits = rng.random((B, T, num_actions)).astype(np.float32)
+    behavior = (
+        np.full((B, T, num_actions), 1.0 / num_actions, np.float32)
+        if uniform_behavior
+        else np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    )
+    done = rng.random((B, T)) < 0.1
+    return XImpalaBatch(
+        state=rng.random((B, T, *obs_shape), dtype=np.float32),
+        reward=rng.random((B, T), dtype=np.float32),
+        action=rng.integers(0, num_actions, (B, T)).astype(np.int32),
+        done=done,
+        env_done=done.copy(),  # no shaping in synthetic data
+        behavior_policy=behavior,
+        previous_action=rng.integers(0, num_actions, (B, T)).astype(np.int32),
+    )

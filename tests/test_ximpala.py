@@ -1,0 +1,154 @@
+"""Transformer-IMPALA family: actor-critic head semantics, V-trace learn,
+sequence-parallel training, config-path reachability, and e2e learning.
+
+The fifth family composes IMPALA's loss math (`agents/impala.py`) with
+the transformer trunk; these tests pin the composition points nothing
+else covers: the actor-critic head's contract, the windowed actor's
+behavior-policy recording feeding V-trace, and ring-SP parity.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.agents.ximpala import (
+    XImpalaAgent,
+    XImpalaConfig,
+)
+from distributed_reinforcement_learning_tpu.models.transformer_net import TransformerQNet
+from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_ximpala_batch
+
+
+class TestActorCriticHead:
+    def test_shapes_and_simplex(self):
+        model = TransformerQNet(num_actions=3, d_model=32, num_heads=2,
+                                num_layers=2, max_len=16, head="actor_critic")
+        rng = np.random.RandomState(0)
+        obs = jnp.asarray(rng.randn(2, 8, 4).astype(np.float32))
+        pa = jnp.asarray(rng.randint(0, 3, (2, 8)))
+        done = jnp.zeros((2, 8), bool)
+        params = {"params": model.init(jax.random.PRNGKey(0), obs, pa, done)["params"]}
+        policy, value = model.apply(params, obs, pa, done)
+        assert policy.shape == (2, 8, 3) and value.shape == (2, 8)
+        assert policy.dtype == jnp.float32 and value.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(policy.sum(-1)), 1.0, atol=1e-5)
+        assert np.all(np.asarray(policy) >= 0)
+
+    def test_causal(self):
+        model = TransformerQNet(num_actions=3, d_model=32, num_heads=2,
+                                num_layers=2, max_len=16, head="actor_critic")
+        rng = np.random.RandomState(1)
+        obs = jnp.asarray(rng.randn(2, 8, 4).astype(np.float32))
+        pa = jnp.zeros((2, 8), jnp.int32)
+        done = jnp.zeros((2, 8), bool)
+        params = {"params": model.init(jax.random.PRNGKey(1), obs, pa, done)["params"]}
+        p1, v1 = model.apply(params, obs, pa, done)
+        p2, v2 = model.apply(params, obs.at[:, 5:].set(0.0), pa, done)
+        np.testing.assert_allclose(np.asarray(p1[:, :5]), np.asarray(p2[:, :5]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(v1[:, :5]), np.asarray(v2[:, :5]), atol=1e-5)
+
+    def test_unknown_head_rejected(self):
+        model = TransformerQNet(num_actions=3, d_model=32, num_heads=2,
+                                num_layers=1, max_len=16, head="nope")
+        with pytest.raises(ValueError, match="unknown head"):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 2)),
+                       jnp.zeros((1, 4), jnp.int32), jnp.zeros((1, 4), bool))
+
+
+class TestXImpalaAgent:
+    def test_learn_fits_learnable_values(self):
+        """Baseline loss must descend on a LEARNABLE batch — rewards a
+        visible function of the observation, no dones. (On fully random
+        data the loss converges to an irreducible noise floor instead:
+        random dones are unpredictable from random obs, so the value at
+        pre-done positions cannot be learned — the conv-LSTM merely
+        reaches that floor slower.)"""
+        from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaBatch
+
+        agent = XImpalaAgent(XImpalaConfig(
+            obs_shape=(4,), num_actions=3, trajectory=8, d_model=32,
+            num_heads=2, num_layers=2, entropy_coef=0.0,
+            start_learning_rate=3e-3, end_learning_rate=3e-3))
+        state = agent.init_state(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        B, T, A = 16, 8, 3
+        obs = rng.random((B, T, 4), dtype=np.float32)
+        batch = XImpalaBatch(
+            state=obs,
+            reward=obs[..., 0].copy(),  # visible -> learnable targets
+            action=rng.integers(0, A, (B, T)).astype(np.int32),
+            done=np.zeros((B, T), bool),
+            env_done=np.zeros((B, T), bool),
+            behavior_policy=np.full((B, T, A), 1.0 / A, np.float32),
+            previous_action=rng.integers(0, A, (B, T)).astype(np.int32),
+        )
+        baselines = []
+        for _ in range(60):
+            state, m = agent.learn(state, batch)
+            baselines.append(float(m["baseline_loss"]))
+        assert np.all(np.isfinite(baselines))
+        # Measured: ~91 -> ~1 by step 60 at this lr.
+        assert baselines[-1] < 0.1 * baselines[0], baselines[::10]
+
+    def test_act_contract(self):
+        agent = XImpalaAgent(XImpalaConfig(
+            obs_shape=(4,), num_actions=3, trajectory=8, d_model=32,
+            num_heads=2, num_layers=2))
+        state = agent.init_state(jax.random.PRNGKey(0))
+        obs = jnp.zeros((5, 8, 4))
+        pa = jnp.zeros((5, 8), jnp.int32)
+        done = jnp.zeros((5, 8), bool)
+        out = agent.act(state.params, obs, pa, done, jax.random.PRNGKey(2))
+        assert out.action.shape == (5,) and out.policy.shape == (5, 3)
+        assert np.all((np.asarray(out.action) >= 0) & (np.asarray(out.action) < 3))
+        np.testing.assert_allclose(np.asarray(out.policy.sum(-1)), 1.0, atol=1e-5)
+
+    def test_ring_sp_matches_dense(self):
+        from distributed_reinforcement_learning_tpu.parallel import (
+            ShardedLearner, make_mesh)
+
+        mesh = make_mesh(8, seq_parallel=4)
+        cfg = XImpalaConfig(obs_shape=(4,), num_actions=3, trajectory=8,
+                            d_model=32, num_heads=2, num_layers=2,
+                            attention="ring")
+        dense_cfg = XImpalaConfig(obs_shape=(4,), num_actions=3, trajectory=8,
+                                  d_model=32, num_heads=2, num_layers=2)
+        dense = XImpalaAgent(dense_cfg)
+        sp = XImpalaAgent(cfg, mesh=mesh)
+        learner = ShardedLearner(sp, mesh)
+        batch = synthetic_ximpala_batch(8, 8, (4,), 3, seed=2)
+        s0 = dense.init_state(jax.random.PRNGKey(1))
+        _, m0 = dense.learn(s0, batch)
+        s1 = learner.init_state(jax.random.PRNGKey(1))
+        _, m1 = learner.learn(s1, learner.shard_batch(batch))
+        assert abs(float(m0["total_loss"]) - float(m1["total_loss"])) < 1e-4
+
+
+class TestConfigPathAndE2E:
+    def test_config_section_loads(self):
+        from distributed_reinforcement_learning_tpu.utils.config import load_config
+
+        cfg, rt = load_config("config.json", "ximpala")
+        assert rt.algorithm == "ximpala"
+        assert cfg.trajectory == 16 and cfg.num_actions == 2
+
+    def test_trains_cartpole(self):
+        """End-to-end learning through build_local: late-training mean
+        return must clearly beat the ~20 of a random CartPole policy —
+        the same bar the conv-LSTM IMPALA e2e test clears."""
+        from distributed_reinforcement_learning_tpu.runtime.launch import (
+            build_local, train_local)
+
+        result = train_local("config.json", "ximpala", num_updates=400, seed=1)
+        returns = result["episode_returns"]
+        assert len(returns) > 40, "too few episodes finished"
+        late = float(np.mean(returns[-20:]))
+        best = max(
+            float(np.mean(returns[i:i + 20])) for i in range(0, len(returns) - 20, 10))
+        # Measured at this seed under the 8-virtual-device test env:
+        # late-20 mean 79.5, best 20-episode window 148.5 (random ~20).
+        # The run is deterministic given the pinned seed + device count.
+        assert late > 55.0, (late, returns[-20:])
+        assert best > 90.0, best

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Transformer-IMPALA launcher: IMPALA's actor/learner FIFO topology
+(`/root/reference/train_impala.py`) with the causal transformer
+actor-critic (agents/ximpala.py) — no reference counterpart; this family
+composes V-trace with the framework's long-context machinery (ring
+sequence parallelism, MoE, pipelining, remat all apply).
+
+    python train_ximpala.py --section ximpala --updates 300
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="config.json")
+    p.add_argument("--section", default="ximpala")
+    p.add_argument("--mode", default="local", choices=["local", "learner", "actor"])
+    p.add_argument("--task", type=int, default=-1)
+    p.add_argument("--updates", type=int, default=1000)
+    p.add_argument("--run_dir", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint_dir", default=None,
+                   help="learner mode: save/resume TrainState checkpoints here")
+    p.add_argument("--checkpoint_interval", type=int, default=500)
+    p.add_argument("--actor_grace", type=float, default=120.0,
+                   help="actor mode: seconds to ride out a learner outage before exiting")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. 'cpu'); actors default to cpu "
+                        "so they never grab the TPU chip")
+    p.add_argument("--serve_inference", action="store_true",
+                   help="learner mode: serve SEED-style centralized inference")
+    p.add_argument("--remote_act", action="store_true",
+                   help="actor mode: offload act() to the learner's inference service")
+    args = p.parse_args()
+
+    platform = args.platform or ("cpu" if args.mode == "actor" else None)
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+    if args.mode == "local":
+        from distributed_reinforcement_learning_tpu.runtime.launch import train_local
+
+        result = train_local(args.config, args.section, args.updates,
+                             run_dir=args.run_dir, seed=args.seed)
+        print({k: v for k, v in result.items() if k != "episode_returns"})
+    else:
+        from distributed_reinforcement_learning_tpu.runtime.transport import run_role
+
+        run_role("ximpala", args.config, args.section, args.mode, args.task,
+                 num_updates=args.updates, run_dir=args.run_dir, seed=args.seed,
+                 checkpoint_dir=args.checkpoint_dir,
+                 checkpoint_interval=args.checkpoint_interval,
+                 actor_grace=args.actor_grace,
+                 serve_inference=args.serve_inference,
+                 remote_act=args.remote_act)
+
+
+if __name__ == "__main__":
+    main()
