@@ -306,6 +306,45 @@ def bench_apex_learn(B: int, iters: int) -> dict:
     return {"B": B, "transitions_per_s": round(tps, 1), "step_ms": round(1e3 * step_s, 3)}
 
 
+def bench_ximpala_learn(B: int, iters: int) -> dict:
+    """Transformer-IMPALA learn-step throughput (env-frames/s): V-trace
+    over a [B, T] causal-transformer forward+backward — the fifth
+    family's hot path (one forward, no stored state)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaAgent, XImpalaConfig
+    from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_ximpala_batch
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    cfg = XImpalaConfig(obs_shape=(64,), num_actions=18, trajectory=32,
+                        d_model=256, num_heads=4, num_layers=4,
+                        dtype=jnp.bfloat16 if on_accel else jnp.float32)
+    agent = XImpalaAgent(cfg)
+    state = agent.init_state(jax.random.PRNGKey(0))
+    batch = jax.device_put(jax.tree.map(
+        jnp.asarray,
+        synthetic_ximpala_batch(B, cfg.trajectory, cfg.obs_shape, cfg.num_actions)))
+
+    box = {"state": state, "loss": float("nan")}
+
+    def window(n):
+        t0 = time.perf_counter()
+        state = box["state"]
+        for _ in range(n):
+            state, metrics = agent.learn(state, batch)
+        box["loss"] = float(metrics["total_loss"])
+        box["state"] = state
+        return time.perf_counter() - t0
+
+    window(1)  # compile
+    step_s = _marginal_step_s(window, iters)
+    fps = B * cfg.trajectory / step_s
+    print(f"[bench] ximpala learn B={B}: {1e3*step_s:.3f}ms/step = {fps:,.0f} frames/s "
+          f"(loss {box['loss']:.2f})", file=sys.stderr)
+    return {"B": B, "frames_per_s": round(fps, 1), "step_ms": round(1e3 * step_s, 3)}
+
+
 def bench_ingest(B: int, iters: int) -> dict:
     """Host-side batch ingest assembly: native strided pop + C++
     batch-gather vs per-blob decode + np.stack, on the IMPALA Atari
@@ -622,6 +661,15 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["apex_learn"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] apex failed: {e}", file=sys.stderr)
+
+    if os.environ.get("BENCH_XIMPALA", "1") == "1":
+        try:
+            extra["ximpala_learn"] = bench_ximpala_learn(
+                int(os.environ.get("BENCH_XIMPALA_BATCH", "64")),
+                iters if on_accel else 2)
+        except Exception as e:  # noqa: BLE001
+            extra["ximpala_learn"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] ximpala failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_INGEST", "1") == "1":
         try:

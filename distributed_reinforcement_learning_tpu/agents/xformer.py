@@ -202,6 +202,25 @@ def build_transformer_models(cfg, mesh, *, seq_len: int, head: str = "dueling_q"
     return model, twin
 
 
+def init_transformer_params(model, cfg, mesh, *, seq_len: int, rng):
+    """Trainable params for any transformer-family model.
+
+    The dummy init batch must cover the mesh's data axis (sharded
+    forwards run through shard_map at init too) and, when pipelined,
+    split into microbatches; sown collections (MoE aux losses) are
+    dropped so only trainables reach the optimizer. Shared by both
+    transformer agents so the sizing rule cannot drift.
+    """
+    b = 1 if mesh is None else mesh.shape.get("data", 1)
+    if cfg.pipeline:
+        b *= cfg.pipeline_microbatches
+    obs = jnp.zeros((b, seq_len, *cfg.obs_shape), jnp.float32)
+    pa = jnp.zeros((b, seq_len), jnp.int32)
+    done = jnp.zeros((b, seq_len), bool)
+    variables = model.init(rng, obs, pa, done)
+    return {"params": variables["params"]}
+
+
 class XformerAgent(common.SequenceReplayLearnMixin):
     def __init__(self, cfg: XformerConfig, mesh=None):
         self.cfg = cfg
@@ -215,22 +234,8 @@ class XformerAgent(common.SequenceReplayLearnMixin):
         self.sync_target = jax.jit(lambda s: s.sync_target())
 
     def init_state(self, rng: jax.Array) -> common.TargetTrainState:
-        t = self.cfg.seq_len
-        # With sequence-parallel attention the init forward runs through
-        # shard_map too, so the dummy batch must cover the data axis —
-        # and the pipelined forward additionally needs each device's
-        # share to split into microbatches.
-        b = 1 if self._mesh is None else self._mesh.shape.get("data", 1)
-        if self.cfg.pipeline:
-            b *= self.cfg.pipeline_microbatches
-        obs = jnp.zeros((b, t, *self.cfg.obs_shape), jnp.float32)
-        pa = jnp.zeros((b, t), jnp.int32)
-        done = jnp.zeros((b, t), bool)
-        variables = self.model.init(rng, obs, pa, done)
-        # Keep only trainables: a MoE forward also sows its aux losses
-        # into a `losses` collection during init, which must not leak
-        # into the optimizer's pytree.
-        params = {"params": variables["params"]}
+        params = init_transformer_params(
+            self.model, self.cfg, self._mesh, seq_len=self.cfg.seq_len, rng=rng)
         return common.TargetTrainState.create(params, self.tx)
 
     # -- act ---------------------------------------------------------------

@@ -34,7 +34,10 @@ import jax
 import jax.numpy as jnp
 
 from distributed_reinforcement_learning_tpu.agents import common
-from distributed_reinforcement_learning_tpu.agents.xformer import build_transformer_models
+from distributed_reinforcement_learning_tpu.agents.xformer import (
+    build_transformer_models,
+    init_transformer_params,
+)
 from distributed_reinforcement_learning_tpu.ops import vtrace
 
 
@@ -119,17 +122,8 @@ class XImpalaAgent:
 
     # -- init ------------------------------------------------------------
     def init_state(self, rng: jax.Array) -> common.TrainState:
-        t = self.cfg.trajectory
-        # Sharded forwards (ring shard_map / pipeline) run at init too,
-        # so the dummy batch must cover the data axis and microbatching.
-        b = 1 if self._mesh is None else self._mesh.shape.get("data", 1)
-        if self.cfg.pipeline:
-            b *= self.cfg.pipeline_microbatches
-        obs = jnp.zeros((b, t, *self.cfg.obs_shape), jnp.float32)
-        pa = jnp.zeros((b, t), jnp.int32)
-        done = jnp.zeros((b, t), bool)
-        variables = self.model.init(rng, obs, pa, done)
-        params = {"params": variables["params"]}  # drop sown collections
+        params = init_transformer_params(
+            self.model, self.cfg, self._mesh, seq_len=self.cfg.trajectory, rng=rng)
         return common.TrainState.create(params, self.tx)
 
     # -- act -------------------------------------------------------------

@@ -85,7 +85,17 @@ class XImpalaActor:
             arr[:, -1] = val
 
     def run_unroll(self) -> int:
-        """Collect one T-step unroll from all N envs; enqueue N trajectories."""
+        """Collect one T-step unroll from all N envs; enqueue N trajectories.
+
+        The window RESETS at each unroll start (pad slots marked done):
+        the behavior policy at unroll position t is then computed from
+        exactly steps 0..t of the current unroll — the same context the
+        learner's forward sees — so V-trace's rho compares policies
+        under identical conditioning (the role the conv-LSTM's
+        actor-recorded (h, c) re-seeding plays). The cost is no
+        cross-unroll memory while acting, the transformer analogue of
+        R2D2's zero-state unroll starts.
+        """
         cfg = self.agent.cfg
         if self.remote_act is None:
             self._sync_params()
@@ -93,6 +103,9 @@ class XImpalaActor:
                 raise RuntimeError("no weights published yet")
         acc = XImpalaTrajectoryAccumulator()
         n = self._obs.shape[0]
+        self._win_obs[:] = 0
+        self._win_pa[:] = 0
+        self._win_done[:] = True
 
         for _ in range(cfg.trajectory):
             self._push_window(self._obs, self._prev_action)
