@@ -12,9 +12,11 @@ conv-LSTM IMPALA:
   here the unroll IS the context — one `[B, T]` forward with episode-
   segment masking standing in for done-masked state resets, and the
   queue payload drops the two `[B, T, H]` state tensors.
-- The actor acts on a rolling window of its recent history (exactly the
-  Transformer-R2D2 actor's mechanism) and records the window-final
-  softmax as the behavior policy V-trace corrects against.
+- The actor acts on a window of the CURRENT unroll's steps (reset at
+  each unroll start, unlike the Transformer-R2D2 actor's persistent
+  rolling window) and records the window-final softmax as the behavior
+  policy — so V-trace's rho compares policies computed from identical
+  context (`runtime/ximpala_runner.py`).
 - Every transformer body feature applies: ring/zigzag/Ulysses sequence
   parallelism (V-trace over a sequence-sharded forward — a combination
   no recurrent IMPALA can express), MoE experts, GPipe pipelining,

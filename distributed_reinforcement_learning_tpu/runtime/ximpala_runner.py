@@ -9,9 +9,12 @@ which the transformer agent reproduces — so it is reused wholesale
 (`XImpalaLearner`), as are `run_sync`/`run_async` (topology-only).
 
 Only the actor differs from `ImpalaActor`: instead of carrying (h, c)
-it maintains a rolling window of the last `trajectory` steps (the
-Transformer-R2D2 actor's mechanism, `runtime/xformer_runner.py`) and
-records the window-final softmax as the behavior policy.
+it maintains a window of the current unroll's steps and records the
+window-final softmax as the behavior policy. Unlike the
+Transformer-R2D2 actor's window (`runtime/xformer_runner.py`), which
+PERSISTS across unrolls, this one RESETS at each unroll start so the
+behavior policy's context exactly matches the learner's `[B, T]`
+forward — see `XImpalaActor.run_unroll`.
 """
 
 from __future__ import annotations

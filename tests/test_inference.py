@@ -347,3 +347,28 @@ def test_mismatched_request_fails_alone():
         assert out["action"].shape == (2,)
     finally:
         server.stop()
+
+
+def test_ximpala_adapter():
+    """Fifth family: window-shaped rows, softmax-sampled actions plus the
+    behavior policy the actor must record for V-trace."""
+    from distributed_reinforcement_learning_tpu.agents.ximpala import (
+        XImpalaAgent, XImpalaConfig)
+
+    agent = XImpalaAgent(XImpalaConfig(obs_shape=(4,), num_actions=3, trajectory=6,
+                                       d_model=32, num_heads=2, num_layers=1))
+    weights = WeightStore()
+    weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+    server = InferenceServer.for_agent("ximpala", agent, weights, max_wait_ms=1.0)
+    try:
+        out = server.submit({
+            "obs": np.random.default_rng(4).random((3, 6, 4)).astype(np.float32),
+            "prev_action": np.zeros((3, 6), np.int32),
+            "done": np.ones((3, 6), bool),
+        })
+        assert out["action"].shape == (3,)
+        assert np.all((out["action"] >= 0) & (out["action"] < 3))
+        assert out["policy"].shape == (3, 3)
+        np.testing.assert_allclose(out["policy"].sum(-1), 1.0, atol=1e-5)
+    finally:
+        server.stop()

@@ -1,0 +1,61 @@
+"""Launcher glue: mesh-axis derivation and sharded-learner detection.
+
+These two helpers are the single source of truth three call sites rely
+on (build_local, make_agent, transport.run_role); pin their contract so
+a drift shows up here, not as an opaque GSPMD error.
+"""
+
+import pytest
+
+from distributed_reinforcement_learning_tpu.agents.impala import ImpalaConfig
+from distributed_reinforcement_learning_tpu.agents.xformer import XformerConfig
+from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaConfig
+from distributed_reinforcement_learning_tpu.runtime.launch import (
+    mesh_axes_for,
+    needs_sharded_learner,
+)
+from distributed_reinforcement_learning_tpu.utils.config import RuntimeConfig
+
+
+def _rt(**kw):
+    return RuntimeConfig(algorithm="xformer", **kw)
+
+
+class TestMeshAxesFor:
+    def test_defaults_are_all_one(self):
+        assert mesh_axes_for(XformerConfig(), _rt()) == (1, 1, 1)
+        assert mesh_axes_for(ImpalaConfig(), _rt()) == (1, 1, 1)
+
+    def test_seq_parallel_flows(self):
+        assert mesh_axes_for(XformerConfig(attention="ring"),
+                             _rt(seq_parallel=4)) == (4, 1, 1)
+
+    def test_pipeline_forces_seq_one_and_sizes_pipe(self):
+        cfg = XformerConfig(num_layers=4, pipeline=True)
+        assert mesh_axes_for(cfg, _rt(seq_parallel=4)) == (1, 4, 1)
+        cfg = XformerConfig(num_layers=4, pipeline=True, pipeline_stages=2)
+        assert mesh_axes_for(cfg, _rt()) == (1, 2, 1)
+
+    def test_expert_axis_only_with_experts(self):
+        assert mesh_axes_for(XformerConfig(num_experts=4),
+                             _rt(expert_parallel=2)) == (1, 1, 2)
+        assert mesh_axes_for(XformerConfig(), _rt(expert_parallel=2)) == (1, 1, 1)
+
+    def test_ximpala_mirrors_xformer(self):
+        cfg = XImpalaConfig(num_layers=4, pipeline=True, pipeline_stages=2)
+        assert mesh_axes_for(cfg, _rt(seq_parallel=8)) == (1, 2, 1)
+
+
+class TestNeedsShardedLearner:
+    @pytest.mark.parametrize("algo", ["xformer", "ximpala"])
+    def test_transformer_families(self, algo):
+        assert needs_sharded_learner(algo, XformerConfig(attention="ring"), _rt())
+        assert needs_sharded_learner(algo, XformerConfig(num_layers=2, pipeline=True), _rt())
+        assert needs_sharded_learner(
+            algo, XformerConfig(num_experts=4), _rt(expert_parallel=2))
+        assert not needs_sharded_learner(algo, XformerConfig(), _rt())
+        assert not needs_sharded_learner(
+            algo, XformerConfig(num_experts=4), _rt())  # EP off at axis 1
+
+    def test_recurrent_families_never(self):
+        assert not needs_sharded_learner("impala", ImpalaConfig(), _rt())
