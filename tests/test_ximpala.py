@@ -149,8 +149,11 @@ class TestConfigPathAndE2E:
             float(np.mean(returns[i:i + 20])) for i in range(0, len(returns) - 20, 10))
         # Measured at this seed under the 8-virtual-device test env:
         # late-20 mean 79.5, best 20-episode window 148.5 (random ~20).
-        # The run is deterministic given the pinned seed + device count.
-        assert late > 55.0, (late, returns[-20:])
+        # Deterministic given seed + device count on one machine, but FP
+        # codegen differences across hosts can shift the trajectory —
+        # bars sit well under the seed-1/2/3 spread (late 50-86, best
+        # 108-149) so a hardware change doesn't read as a regression.
+        assert late > 40.0, (late, returns[-20:])
         assert best > 90.0, best
 
 
