@@ -18,6 +18,19 @@ import numpy as np
 from distributed_reinforcement_learning_tpu.envs.base import Env
 
 
+def completed_returns(infos: dict, done: np.ndarray) -> np.ndarray:
+    """Returns of the episodes that just finished, `[sum(done)]`.
+
+    Shared by every actor runner: tolerates envs whose infos carry no
+    `episode_return` (a bare list default would raise TypeError when
+    indexed with the boolean done mask).
+    """
+    rets = infos.get("episode_return")
+    if rets is None:
+        return np.zeros(0)  # no known returns — do not fabricate 0.0 entries
+    return np.asarray(rets)[done]
+
+
 class BatchedEnv:
     def __init__(self, env_fns: Sequence[Callable[[], Env]]):
         self.envs = [fn() for fn in env_fns]

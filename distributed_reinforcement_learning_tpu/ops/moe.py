@@ -69,9 +69,11 @@ def _dispatch_combine(probs: jax.Array, top_k: int, capacity: int):
     onehot = jax.nn.one_hot(idx, e, dtype=probs.dtype)  # [N, k, E]
 
     # Rank each (choice, token) within its expert, choice-major ordering.
-    flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e)
-    pos_flat = jnp.sum((jnp.cumsum(flat, axis=0) - 1.0) * flat, axis=-1)
-    pos = pos_flat.reshape(top_k, n).T.astype(jnp.int32)  # [N, k]
+    # Integer cumsum: float32 ranks go inexact past ~2^24 routed slots
+    # per expert, silently double-booking capacity on huge B*T batches.
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e).astype(jnp.int32)
+    pos_flat = jnp.sum((jnp.cumsum(flat, axis=0) - 1) * flat, axis=-1)
+    pos = pos_flat.reshape(top_k, n).T  # [N, k]
     # Positions >= capacity one-hot to all-zeros: the overflow drop.
     slot = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)  # [N, k, C]
 

@@ -23,6 +23,7 @@ import jax
 from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexBatch
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
 from distributed_reinforcement_learning_tpu.data.replay import UniformBuffer, make_replay
+from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
@@ -122,7 +123,7 @@ class ApexActor:
                 )
 
             self._episodes += done
-            for ret in infos.get("episode_return", [])[done]:
+            for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
             self._prev_action = np.where(done, 0, actions).astype(np.int32)
             self._obs = next_obs
@@ -269,6 +270,7 @@ def run_sync(learner: ApexLearner, actors: list[ApexActor], num_updates: int,
              actor_steps_per_round: int = 8) -> dict:
     """Interleaved stepping for tests/single-host training."""
     metrics: dict = {}
+    learner.sync_publish = True  # deterministic staleness in the sync loop
     try:
         while learner.train_steps < num_updates:
             for actor in actors:

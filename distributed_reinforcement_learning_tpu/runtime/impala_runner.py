@@ -25,6 +25,7 @@ import numpy as np
 from distributed_reinforcement_learning_tpu.agents.impala import ActOutput, ImpalaAgent, ImpalaConfig
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
 from distributed_reinforcement_learning_tpu.data.structures import ImpalaTrajectoryAccumulator
+from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
@@ -122,7 +123,7 @@ class ImpalaActor:
             self._c = np.asarray(out.c) * keep
             self._prev_action = np.where(done, 0, actions).astype(np.int32)
             self._obs = next_obs
-            for ret in infos.get("episode_return", [])[done]:
+            for ret in completed_returns(infos, done):
                 if ret > 0:
                     self.episode_returns.append(float(ret))
 
@@ -266,6 +267,7 @@ def run_sync(
     be able to absorb one full actor round past the batch size, or puts
     would block with no consumer running.
     """
+    learner.sync_publish = True  # deterministic staleness in the sync loop
     production_per_round = sum(a.env.num_envs for a in actors)
     if learner.queue.capacity < learner.batch_size + production_per_round:
         raise ValueError(

@@ -12,15 +12,26 @@ from __future__ import annotations
 import os
 
 
-def _async_publish() -> bool:
-    """DRL_ASYNC_PUBLISH=1: hand the params D2H + store to the weight
-    store's background worker (an on-device copy is the only cost on the
-    learn thread). Off by default — the synchronous publish doubles as
-    the step's device sync, which the deterministic tests rely on."""
-    return os.environ.get("DRL_ASYNC_PUBLISH", "0") == "1"
+def _async_publish(sync_default: bool) -> bool:
+    """Async by default: hand the params D2H + store to the weight
+    store's background worker (an on-device copy is the only cost on
+    the learn thread) — measured 2316ms -> 3.6ms/step at publish
+    interval 1. DRL_ASYNC_PUBLISH=0 restores the synchronous path,
+    whose host snapshot doubles as a per-step device sync (useful when
+    timing individual steps). An explicit env setting always wins;
+    `sync_default` only flips the unset-env default (run_sync loops)."""
+    env = os.environ.get("DRL_ASYNC_PUBLISH")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
+    return not sync_default
 
 
 class PublishCadenceMixin:
+    # Single-threaded run_sync loops set this True: there the learner and
+    # actors interleave on one thread, so async publication buys nothing
+    # and only makes the weight-staleness sequence nondeterministic.
+    sync_publish = False
+
     def maybe_publish(self) -> bool:
         """Publish every `publish_interval`-th train step.
 
@@ -31,8 +42,19 @@ class PublishCadenceMixin:
         if self.train_steps % self.publish_interval != 0:
             return False
         with self.timer.stage("publish"):
-            if _async_publish():
+            if _async_publish(self.sync_publish):
                 self.weights.publish_async(self.state.params, self.train_steps)
+                # Bounded staleness: latest-wins async publication may
+                # drop intermediate versions, but actors must never act
+                # on weights more than ~3 publish intervals old (the
+                # off-policyness V-trace's truncated-IS correction
+                # targets). If the background worker lags past that,
+                # wait for it here — the common case never blocks.
+                if self.train_steps - self.weights.version > 3 * self.publish_interval:
+                    if not self.weights.flush_async(timeout=10.0):
+                        print(f"[publish] WARNING: async weight publication "
+                              f"stalled; actors hold version "
+                              f"{self.weights.version} at step {self.train_steps}")
             else:
                 self.weights.publish(self.state.params, self.train_steps)
         return True
@@ -42,5 +64,5 @@ class PublishCadenceMixin:
         the last <K updates would otherwise never reach the store."""
         if self.train_steps > 0 and self.train_steps % self.publish_interval != 0:
             self.weights.publish(self.state.params, self.train_steps)
-        if _async_publish():
+        if _async_publish(self.sync_publish):
             self.weights.flush_async()

@@ -22,6 +22,7 @@ from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Ba
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
 from distributed_reinforcement_learning_tpu.data.replay import make_replay
 from distributed_reinforcement_learning_tpu.data.structures import R2D2SequenceAccumulator
+from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
@@ -110,7 +111,7 @@ class R2D2Actor:
             self._prev_action = np.where(done, 0, action).astype(np.int32)
             self._obs = next_obs
             self._episodes += done
-            for ret in infos.get("episode_return", [])[done]:
+            for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 
         for seq in acc.extract():
@@ -249,6 +250,7 @@ class R2D2Learner(PublishCadenceMixin):
 
 def run_sync(learner: R2D2Learner, actors: list[R2D2Actor], num_updates: int) -> dict:
     metrics: dict = {}
+    learner.sync_publish = True  # deterministic staleness in the sync loop
     try:
         while learner.train_steps < num_updates:
             for actor in actors:

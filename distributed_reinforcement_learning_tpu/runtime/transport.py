@@ -509,6 +509,25 @@ def run_role(
             mesh = make_mesh(devices=devs, seq_parallel=seq,
                              pipe_parallel=pipe, expert_parallel=expert)
             print(f"[learner] mesh: {dict(mesh.shape)}")
+        elif inner > 1 and launch.needs_sharded_learner(algo, agent_cfg, rt):
+            # The learn step requires sharding (ring/pipeline/expert) over
+            # multi-device axes but no valid mesh fits here. Without this
+            # refusal, make_agent would size the same mesh internally —
+            # bypassing the divisibility checks above — and the mismatch
+            # would surface as an opaque GSPMD/shard_map shape error
+            # instead of a config error. (A dense config with leftover
+            # seq_parallel>1 stays on the old unsharded fallback.)
+            if len(devs) % inner != 0:
+                why = (f"device count {len(devs)} is not divisible by the "
+                       f"inner axes product {inner} — adjust "
+                       f"seq_parallel/pipeline_stages/expert_parallel")
+            else:
+                why = (f"batch_size {rt.batch_size} is not divisible by the "
+                       f"data axis ({len(devs)}//{inner} = {len(devs) // inner})")
+            raise ValueError(
+                f"config requires a sharded learner "
+                f"(seq={seq}, pipe={pipe}, expert={expert}) but no valid mesh "
+                f"fits on {len(devs)} devices: {why}")
         elif multihost:
             # Refuse rather than silently run N independent un-psum'd
             # learners whose weight copies would diverge.

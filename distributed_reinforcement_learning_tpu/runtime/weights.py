@@ -103,6 +103,11 @@ class WeightStore:
                 snap, version, seq = item
                 # np.asarray here = the D2H wait, off the learn thread.
                 self._apply(jax.tree.map(np.asarray, snap), version, seq)
+            except Exception as e:  # drop the item, keep the worker alive —
+                # a dead worker would freeze actor weights forever while
+                # training silently continues.
+                print(f"[weights] WARNING: async publish of version "
+                      f"{item[1]} failed: {e!r}")
             finally:
                 with self._async_lock:
                     self._busy = False

@@ -25,6 +25,7 @@ import jax
 from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
 from distributed_reinforcement_learning_tpu.data.structures import XformerSequenceAccumulator
+from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.r2d2_runner import (
     R2D2Learner,
     run_sync,  # noqa: F401  (re-exported: the sync loop is topology-only)
@@ -138,7 +139,7 @@ class XformerActor:
             self._prev_action = np.where(done, 0, action).astype(np.int32)
             self._obs = next_obs
             self._episodes += done
-            for ret in infos.get("episode_return", [])[done]:
+            for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 
         for seq in acc.extract():

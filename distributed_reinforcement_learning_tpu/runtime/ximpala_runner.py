@@ -26,6 +26,7 @@ import jax
 from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaAgent
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
 from distributed_reinforcement_learning_tpu.data.structures import XImpalaTrajectoryAccumulator
+from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.impala_runner import (
     ImpalaLearner,
     run_async,  # noqa: F401  (re-exported: topology-only)
@@ -149,7 +150,7 @@ class XImpalaActor:
             self._win_done[:, -1] = done  # now known; future windows see it
             self._prev_action = np.where(done, 0, action).astype(np.int32)
             self._obs = next_obs
-            for ret in infos.get("episode_return", [])[done]:
+            for ret in completed_returns(infos, done):
                 if ret > 0:
                     self.episode_returns.append(float(ret))
 

@@ -65,6 +65,7 @@ for step in range(3):
     print(f"RESULT {pid} {step} {m['total_loss']:.6f}", flush=True)
 
 # Weight publication must work from the global (replicated) params.
+weights.flush_async()  # async-by-default publication lands in background
 params, version = weights.get()
 assert version == 3
 assert all(np.all(np.isfinite(x)) for x in jax.tree.leaves(params))
