@@ -30,6 +30,23 @@ def stack_pytrees(items: list[Any]) -> Any:
     return jax.tree.map(lambda *xs: np.stack(xs), *items)
 
 
+def put_round(queue: Any, items: list[Any]) -> None:
+    """Ship one actor round (the N trajectories of an `extract()`) to a
+    queue, batched when the queue supports it.
+
+    Over the socket data plane, `put_many` is ONE round trip for the
+    whole round (OP_PUT_TRAJ_N) instead of N request/replies — the
+    actor-side fix for the reference's per-item-RPC anti-pattern
+    (`buffer_queue.py:416-435`). In-process queues just loop.
+    """
+    put_many = getattr(queue, "put_many", None)
+    if put_many is not None:
+        put_many(items)
+    else:
+        for item in items:
+            queue.put(item)
+
+
 class TrajectoryQueue:
     """Bounded MPMC queue of trajectory pytrees.
 
@@ -79,6 +96,21 @@ class TrajectoryQueue:
             self._items.append(item)
             self._not_empty.notify()
             return True
+
+    def put_many(self, items: list[Any], timeout: float | None = None) -> int:
+        """Enqueue a list of items; returns how many were accepted.
+
+        Blocks per item under backpressure like put(). Stops at the first
+        timeout — the remainder is NOT enqueued (callers may retry it).
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        accepted = 0
+        for item in items:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if not self.put(item, timeout=remaining):
+                break
+            accepted += 1
+        return accepted
 
     def get(self, timeout: float | None = None) -> Any | None:
         with self._not_empty:

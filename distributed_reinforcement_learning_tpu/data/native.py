@@ -135,6 +135,8 @@ def native_available() -> bool:
 
 
 def _as_u8p(buf) -> Any:
+    if isinstance(buf, memoryview):
+        buf = np.frombuffer(buf, np.uint8)  # zero-copy
     if isinstance(buf, np.ndarray):
         return buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     return (ctypes.c_uint8 * len(buf)).from_buffer(buf) if isinstance(buf, bytearray) else \
@@ -264,6 +266,12 @@ class NativeTrajectoryQueue:
     `buffer_queue.py:40-50`).
     """
 
+    supports_pooled_get = True  # DevicePrefetcher keys pooled dequeue on this
+    # How many pooled output sets get_batch(pooled=True) rotates through.
+    # A consumer that confirms the previous transfer completed before its
+    # next pooled call (DevicePrefetcher does) needs only 2.
+    POOL_SETS = 2
+
     def __init__(self, capacity: int):
         self._q = NativeByteQueue(capacity)
         self.capacity = capacity
@@ -275,6 +283,13 @@ class NativeTrajectoryQueue:
         # of sharing the buffer) — the queue itself stays MPMC.
         self._scratch = np.empty(0, np.uint8)
         self._scratch_lock = threading.Lock()
+        # Pooled field outputs (get_batch(pooled=True)): the decoded batch
+        # arrays themselves are reused across calls, killing the
+        # ~batch-sized np.empty + page-fault cost per dequeue. Rotates
+        # POOL_SETS sets; callers own the safety contract (see get_batch).
+        self._pool: list[list[np.ndarray] | None] = [None] * self.POOL_SETS
+        self._pool_sig: tuple | None = None
+        self._pool_idx = 0
 
     def __len__(self) -> int:
         return len(self._q)
@@ -297,11 +312,53 @@ class NativeTrajectoryQueue:
             self._item_cap = len(blob)
         return self._q.put(blob, timeout)
 
+    def put_many(self, items: list[Any], timeout: float | None = None) -> int:
+        return self.put_bytes_many([codec.encode(i) for i in items], timeout)
+
+    def put_bytes_many(self, blobs: list[bytes], timeout: float | None = None) -> int:
+        """Enqueue encoded blobs; returns how many were accepted (stops at
+        the first refusal — the rest is NOT enqueued, callers may retry)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        accepted = 0
+        for blob in blobs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if not self.put_bytes(blob, remaining):
+                break
+            accepted += 1
+        return accepted
+
     def get(self, timeout: float | None = None) -> Any | None:
         blob = self._q.get(timeout)
         return None if blob is None else codec.decode(blob, copy=True)
 
-    def get_batch(self, batch_size: int, timeout: float | None = None) -> Any | None:
+    def _pooled_outputs(self, batch_size: int, metas: list[dict]) -> list[np.ndarray] | None:
+        """Next rotation of reusable gather destinations, or None if the
+        schema changed mid-stream (fall back to fresh allocations)."""
+        sig = (batch_size, tuple((m["dtype"], tuple(m["shape"])) for m in metas))
+        if sig != self._pool_sig:
+            self._pool = [None] * self.POOL_SETS
+            self._pool_sig = sig
+        self._pool_idx = (self._pool_idx + 1) % self.POOL_SETS
+        if self._pool[self._pool_idx] is None:
+            self._pool[self._pool_idx] = [
+                np.empty((batch_size, *codec.meta_layout(m)[1]), codec.meta_layout(m)[0])
+                for m in metas
+            ]
+        return self._pool[self._pool_idx]
+
+    def get_batch(self, batch_size: int, timeout: float | None = None,
+                  pooled: bool = False) -> Any | None:
+        """Pop + assemble a `[B, ...]` batch (see class docstring).
+
+        pooled=True returns arrays from a rotating pool of POOL_SETS
+        reusable buffer sets instead of fresh allocations. Safety
+        contract: the caller must be the queue's only pooled consumer
+        and must be done with set k's memory (e.g. confirmed its H2D
+        transfer completed) before its (k + POOL_SETS)'th call. Never
+        use pooled batches with a backend that may alias host memory
+        (JAX CPU arrays can) — the pool would overwrite live training
+        data. DevicePrefetcher enforces both.
+        """
         deadline = None if timeout is None else time.monotonic() + timeout
         item_cap = self._item_cap
         if item_cap == 0:
@@ -348,10 +405,13 @@ class NativeTrajectoryQueue:
             if batch_size == 1 or lib.bs_all_equal_prefix(
                 base, stride, batch_size, payload_start
             ):
+                outs = (self._pooled_outputs(batch_size, metas)
+                        if pooled and have_scratch else None)
                 arrays = []
-                for meta in metas:
+                for j, meta in enumerate(metas):
                     dtype, shape, nbytes = codec.meta_layout(meta)
-                    out = np.empty((batch_size, *shape), dtype)
+                    out = outs[j] if outs is not None else np.empty(
+                        (batch_size, *shape), dtype)
                     lib.bs_gather(
                         base, stride, batch_size, payload_start + meta["offset"],
                         nbytes,

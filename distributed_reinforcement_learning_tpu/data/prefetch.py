@@ -68,9 +68,22 @@ class DevicePrefetcher:
             self._error = e
 
     def _loop_inner(self) -> None:
+        # Pooled dequeue: the source hands back REUSED host arrays (no
+        # per-batch alloc + page faults). Safe only when (a) the device
+        # backend copies on H2D (TPU/GPU do; JAX CPU may alias numpy
+        # memory — pooling there would overwrite live training data) and
+        # (b) we confirm each transfer completed before the pool can
+        # rotate back onto its buffers — the block_until_ready below,
+        # which waits on THIS background thread, not the learner.
+        pooled = (getattr(self.source, "supports_pooled_get", False)
+                  and jax.default_backend() not in ("cpu",))
         while not self._stop.is_set():
             try:
-                batch = self.source.get_batch(self.batch_size, timeout=0.2)
+                if pooled:
+                    batch = self.source.get_batch(self.batch_size, timeout=0.2,
+                                                  pooled=True)
+                else:
+                    batch = self.source.get_batch(self.batch_size, timeout=0.2)
             except RuntimeError:
                 if getattr(self.source, "closed", False):
                     return  # orderly shutdown
@@ -93,6 +106,13 @@ class DevicePrefetcher:
                 batch = place_local_batch(batch, self.sharding)
             else:
                 batch = jax.device_put(batch)
+            if pooled:
+                # The pool rotation contract: buffers of batch k may be
+                # rewritten at call k + POOL_SETS, so the H2D of k must
+                # have completed by then. Waiting here (background
+                # thread) guarantees it one call early, and the transfer
+                # still overlaps the device's compute on batch k-1.
+                jax.block_until_ready(batch)
             while not self._stop.is_set():
                 try:
                     self._out.put(batch, timeout=0.2)

@@ -23,7 +23,7 @@ import jax
 import numpy as np
 
 from distributed_reinforcement_learning_tpu.agents.impala import ActOutput, ImpalaAgent, ImpalaConfig
-from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round
 from distributed_reinforcement_learning_tpu.data.structures import ImpalaTrajectoryAccumulator
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
@@ -127,8 +127,7 @@ class ImpalaActor:
                 if ret > 0:
                     self.episode_returns.append(float(ret))
 
-        for traj in acc.extract():
-            self.queue.put(traj)
+        put_round(self.queue, acc.extract())
         return n * cfg.trajectory
 
 

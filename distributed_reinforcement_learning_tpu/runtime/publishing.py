@@ -52,9 +52,12 @@ class PublishCadenceMixin:
                 # wait for it here — the common case never blocks.
                 if self.train_steps - self.weights.version > 3 * self.publish_interval:
                     if not self.weights.flush_async(timeout=10.0):
+                        import sys
+
                         print(f"[publish] WARNING: async weight publication "
                               f"stalled; actors hold version "
-                              f"{self.weights.version} at step {self.train_steps}")
+                              f"{self.weights.version} at step {self.train_steps}",
+                              file=sys.stderr)
             else:
                 self.weights.publish(self.state.params, self.train_steps)
         return True

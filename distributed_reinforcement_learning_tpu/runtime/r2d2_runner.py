@@ -19,7 +19,7 @@ import numpy as np
 import jax
 
 from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Batch
-from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees, put_round
 from distributed_reinforcement_learning_tpu.data.replay import make_replay
 from distributed_reinforcement_learning_tpu.data.structures import R2D2SequenceAccumulator
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
@@ -114,8 +114,7 @@ class R2D2Actor:
             for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 
-        for seq in acc.extract():
-            self.queue.put(seq)
+        put_round(self.queue, acc.extract())
         return n * cfg.seq_len
 
 

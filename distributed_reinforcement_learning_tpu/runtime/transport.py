@@ -37,6 +37,7 @@ OP_GET_WEIGHTS = 2
 OP_QUEUE_SIZE = 3
 OP_PING = 4
 OP_ACT = 5  # SEED-style remote inference (runtime/inference.py)
+OP_PUT_TRAJ_N = 6  # K unrolls per round trip (kills the per-unroll RTT)
 
 ST_OK = 0
 ST_ERROR = 1
@@ -46,6 +47,35 @@ ST_UNAVAILABLE = 4  # op permanently not served here (e.g. no --serve_inference)
 
 _HDR = struct.Struct("<BI")  # (op|status, payload_len)
 _I64 = struct.Struct("<q")
+_U32 = struct.Struct("<I")
+
+
+def pack_batch(blobs: list[bytes | bytearray]) -> list[bytes | bytearray]:
+    """OP_PUT_TRAJ_N payload parts: [u32 count][u32 len_i]*count [blobs...].
+
+    Returned as parts for `_send_msg` so the (possibly multi-MB) blobs
+    are never concatenated host-side just to be framed.
+    """
+    head = bytearray(_U32.size * (1 + len(blobs)))
+    _U32.pack_into(head, 0, len(blobs))
+    for i, b in enumerate(blobs):
+        _U32.pack_into(head, _U32.size * (1 + i), len(b))
+    return [head, *blobs]
+
+
+def unpack_batch(payload: bytes) -> list[memoryview]:
+    """Inverse of `pack_batch`: zero-copy views into the payload."""
+    (count,) = _U32.unpack_from(payload, 0)
+    view = memoryview(payload)
+    offset = _U32.size * (1 + count)
+    out = []
+    for i in range(count):
+        (n,) = _U32.unpack_from(payload, _U32.size * (1 + i))
+        out.append(view[offset : offset + n])
+        offset += n
+    if offset != len(payload):
+        raise ValueError(f"batch payload length mismatch: {offset} != {len(payload)}")
+    return out
 
 
 class TransportError(ConnectionError):
@@ -202,6 +232,30 @@ class TransportServer:
                 return True
         return False
 
+    def _enqueue_many(self, payload: bytes, total_wait: float = 30.0) -> int:
+        """Enqueue every blob of an OP_PUT_TRAJ_N payload; returns how many
+        were accepted (stops at the first refusal — the tail is NOT
+        enqueued, so the client may safely resend it)."""
+        deadline = time.monotonic() + total_wait
+        blobs = unpack_batch(payload)
+        raw = hasattr(self.queue, "put_bytes")
+        accepted = 0
+        for blob in blobs:
+            item = blob if raw else codec.decode(blob, copy=True)
+            ok = False
+            while not self._stop.is_set():
+                slice_t = min(0.5, deadline - time.monotonic())
+                if slice_t <= 0:
+                    break
+                ok = self.queue.put_bytes(item, timeout=slice_t) if raw else \
+                    self.queue.put(item, timeout=slice_t)
+                if ok:
+                    break
+            if not ok:
+                break
+            accepted += 1
+        return accepted
+
     def _serve_inner(self, conn: socket.socket) -> None:
         while not self._stop.is_set():
             try:
@@ -215,6 +269,12 @@ class TransportServer:
                     # buffer_queue.py:398-414).
                     ok = self._enqueue(payload)
                     _send_msg(conn, ST_OK if ok else ST_BUSY)
+                elif op == OP_PUT_TRAJ_N:
+                    # The batched PUT: K unrolls in one round trip. The
+                    # reply carries the accepted count; a partial accept
+                    # (bounded queue refused the tail) is the batched
+                    # analogue of ST_BUSY and the client retries the rest.
+                    _send_msg(conn, ST_OK, _I64.pack(self._enqueue_many(payload)))
                 elif op == OP_GET_WEIGHTS:
                     # Versions are snapshot IDENTITIES across the wire,
                     # not an ordering: a restarted learner republishes
@@ -289,16 +349,19 @@ class TransportClient:
                 time.sleep(self.retry_interval)
         raise TransportError(f"cannot reach learner at {self.host}:{self.port}: {last}")
 
-    def _exchange(self, op: int, payload: bytes, retry: bool, resend: bool) -> tuple[int, bytes]:
+    def _exchange(self, op: int, payload, retry: bool, resend: bool) -> tuple[int, bytes]:
         """One request/response; on a dropped connection, reconnect and (for
         idempotent ops) resend. Non-idempotent ops set `resend=False`: the
         server may or may not have acted on the lost request, so resending
-        would give at-least-once delivery (duplicated trajectories)."""
+        would give at-least-once delivery (duplicated trajectories).
+
+        `payload` is bytes or a list of parts (sent without concatenating)."""
+        parts = payload if isinstance(payload, list) else [payload]
         with self._lock:
             if self._sock is None:  # a prior failed reconnect left us down
                 self._connect()
             try:
-                _send_msg(self._sock, op, payload)
+                _send_msg(self._sock, op, *parts)
                 return _recv_msg(self._sock)
             except (TransportError, OSError):
                 if not retry:
@@ -307,7 +370,7 @@ class TransportClient:
                 self._connect()
                 if not resend:
                     raise TransportError("connection lost mid-request") from None
-                _send_msg(self._sock, op, payload)
+                _send_msg(self._sock, op, *parts)
                 return _recv_msg(self._sock)
 
     def _call(self, op: int, payload: bytes = b"", retry: bool = True) -> bytes:
@@ -351,6 +414,51 @@ class TransportClient:
             if status == ST_CLOSED:
                 raise TransportError("learner closed the data plane")
             raise TransportError("put_trajectory failed on the learner side")
+
+    def put_trajectories(self, trees: list[Any]) -> int:
+        """Ship K trajectories in one round trip (OP_PUT_TRAJ_N); returns
+        how many the learner accepted.
+
+        The per-unroll request/reply of put_trajectory is the reference's
+        32-RPC `sample_batch` anti-pattern at one remove
+        (`buffer_queue.py:416-435`) — on a 20ms RTT it caps one actor at
+        50 unrolls/s no matter how fast the envs step. Batching the
+        whole `extract()` round into one exchange removes that cap.
+
+        Semantics match put_trajectory: at-most-once per blob (a dropped
+        connection loses the in-flight batch, returns the count shipped
+        so far), bounded ST-BUSY-equivalent retries of the NOT-enqueued
+        tail on partial acceptance.
+        """
+        blobs = [codec.encode(t) for t in trees]
+        sent = 0
+        busy_since: float | None = None
+        while sent < len(blobs):
+            try:
+                status, resp = self._exchange(
+                    OP_PUT_TRAJ_N, pack_batch(blobs[sent:]), retry=True, resend=False)
+            except TransportError:
+                if self._sock is None:  # reconnect failed: learner is gone
+                    raise
+                return sent  # batch fate unknown: drop, never duplicate
+            if status == ST_CLOSED:
+                raise TransportError("learner closed the data plane")
+            if status != ST_OK:
+                raise TransportError("put_trajectories failed on the learner side")
+            accepted = _I64.unpack(resp)[0]
+            sent += accepted
+            if sent < len(blobs):
+                # Partial acceptance = the bounded queue refused the tail
+                # (the batched ST_BUSY). The tail was not enqueued, so
+                # resending it cannot duplicate.
+                now = time.monotonic()
+                busy_since = busy_since or now
+                if now - busy_since > self.busy_timeout:
+                    raise TransportError(
+                        f"learner queue busy for >{self.busy_timeout:.0f}s")
+                if accepted:
+                    busy_since = now  # progress resets the wedge clock
+        return sent
 
     def get_weights_if_newer(self, have_version: int) -> tuple[Any, int] | None:
         resp = self._call(OP_GET_WEIGHTS, _I64.pack(have_version))
@@ -402,6 +510,9 @@ class RemoteQueue:
 
     def put(self, item: Any, timeout: float | None = None) -> bool:
         return self._client.put_trajectory(item)  # False = dropped (at-most-once)
+
+    def put_many(self, items: list[Any], timeout: float | None = None) -> int:
+        return self._client.put_trajectories(items)
 
     def size(self) -> int:
         return self._client.queue_size()

@@ -105,9 +105,12 @@ class WeightStore:
                 self._apply(jax.tree.map(np.asarray, snap), version, seq)
             except Exception as e:  # drop the item, keep the worker alive —
                 # a dead worker would freeze actor weights forever while
-                # training silently continues.
+                # training silently continues. (stderr: stdout may carry a
+                # machine-read JSON contract, e.g. bench.py's one line.)
+                import sys
+
                 print(f"[weights] WARNING: async publish of version "
-                      f"{item[1]} failed: {e!r}")
+                      f"{item[1]} failed: {e!r}", file=sys.stderr)
             finally:
                 with self._async_lock:
                     self._busy = False

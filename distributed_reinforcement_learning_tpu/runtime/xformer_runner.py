@@ -23,7 +23,7 @@ import numpy as np
 import jax
 
 from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent
-from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round
 from distributed_reinforcement_learning_tpu.data.structures import XformerSequenceAccumulator
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.r2d2_runner import (
@@ -142,6 +142,5 @@ class XformerActor:
             for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 
-        for seq in acc.extract():
-            self.queue.put(seq)
+        put_round(self.queue, acc.extract())
         return n * cfg.seq_len

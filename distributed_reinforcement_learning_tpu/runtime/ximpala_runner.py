@@ -24,7 +24,7 @@ import numpy as np
 import jax
 
 from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaAgent
-from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round
 from distributed_reinforcement_learning_tpu.data.structures import XImpalaTrajectoryAccumulator
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.impala_runner import (
@@ -154,6 +154,5 @@ class XImpalaActor:
                 if ret > 0:
                     self.episode_returns.append(float(ret))
 
-        for traj in acc.extract():
-            self.queue.put(traj)
+        put_round(self.queue, acc.extract())
         return n * cfg.trajectory

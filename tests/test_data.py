@@ -35,6 +35,18 @@ class TestTrajectoryQueue:
         assert batch["x"].shape == (4, 3)
         np.testing.assert_array_equal(batch["x"][:, 0], [0, 1, 2, 3])
 
+    def test_put_many_and_put_round(self):
+        from distributed_reinforcement_learning_tpu.data.fifo import put_round
+
+        q = TrajectoryQueue(capacity=8)
+        assert q.put_many([{"x": np.full((2,), i)} for i in range(3)]) == 3
+        assert q.size() == 3
+        # put_many stops at the first timeout, tail not enqueued.
+        assert q.put_many([{"x": np.zeros(2)}] * 8, timeout=0.05) == 5
+        q2 = TrajectoryQueue(capacity=8)
+        put_round(q2, [{"x": np.full((2,), i)} for i in range(4)])
+        assert q2.size() == 4
+
     def test_put_blocks_when_full_backpressure(self):
         q = TrajectoryQueue(capacity=2)
         q.put(1)

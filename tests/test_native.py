@@ -274,6 +274,38 @@ class TestNativeBatchGather:
         assert batch["obs"].shape == (1, 4, 3)
         assert int(batch["step"][0]) == 7
 
+    def test_put_many(self):
+        q = NativeTrajectoryQueue(16)
+        assert q.put_many([self._tree(i) for i in range(6)]) == 6
+        batch = q.get_batch(6)
+        np.testing.assert_array_equal(batch["step"], np.arange(6))
+
+    def test_put_many_stops_at_capacity(self):
+        q = NativeTrajectoryQueue(4)
+        assert q.put_many([self._tree(i) for i in range(6)], timeout=0.2) == 4
+
+    def test_pooled_get_batch_reuses_buffers_and_stays_correct(self):
+        """pooled=True must (a) produce byte-identical batches to the
+        unpooled path and (b) actually rotate through POOL_SETS reused
+        buffer sets (the whole point: no per-dequeue allocation)."""
+        q = NativeTrajectoryQueue(32)
+        seen_ptrs = []
+        for round_i in range(5):
+            trees = [self._tree(10 * round_i + j) for j in range(4)]
+            for t in trees:
+                q.put(t)
+            batch = q.get_batch(4, pooled=True)
+            from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
+            want = stack_pytrees(trees)
+            np.testing.assert_array_equal(batch["obs"], want["obs"])
+            np.testing.assert_array_equal(batch["nested"]["h"], want["nested"]["h"])
+            np.testing.assert_array_equal(batch["step"], want["step"])
+            seen_ptrs.append(batch["obs"].ctypes.data)
+        # Rotation: call k and k+POOL_SETS share the same destination.
+        sets = NativeTrajectoryQueue.POOL_SETS
+        assert seen_ptrs[0] == seen_ptrs[sets] == seen_ptrs[2 * sets]
+        assert len(set(seen_ptrs[:sets])) == sets
+
 
 class TestConcurrentBatchConsumers:
     """Two threads calling get_batch on ONE wrapper: the scratch

@@ -98,6 +98,50 @@ class TestProtocol:
         assert v == 1
         client.close()
 
+    def test_put_trajectories_batched_roundtrip(self, served):
+        """OP_PUT_TRAJ_N: K unrolls in one exchange, order preserved."""
+        queue, _, port = served
+        client = TransportClient("127.0.0.1", port)
+        trees = [{"obs": np.full((3, 4), i, np.uint8), "r": np.full(3, float(i), np.float32)}
+                 for i in range(5)]
+        assert client.put_trajectories(trees) == 5
+        assert client.queue_size() == 5
+        for i in range(5):
+            got = queue.get(timeout=1.0)
+            np.testing.assert_array_equal(got["obs"], trees[i]["obs"])
+            np.testing.assert_array_equal(got["r"], trees[i]["r"])
+        client.close()
+
+    def test_put_trajectories_partial_accept_retries_tail(self, served):
+        """A full bounded queue accepts part of the batch; the client must
+        deliver the rest (exactly once) as the consumer frees slots."""
+        queue, _, port = served  # capacity 8
+        client = TransportClient("127.0.0.1", port, busy_timeout=30.0)
+        trees = [{"x": np.array([i])} for i in range(12)]  # > capacity
+        got: list[int] = []
+
+        def drain():
+            deadline = time.monotonic() + 20.0
+            while len(got) < 12 and time.monotonic() < deadline:
+                item = queue.get(timeout=0.5)
+                if item is not None:
+                    got.append(int(item["x"][0]))
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        assert client.put_trajectories(trees) == 12
+        t.join(timeout=20.0)
+        assert got == list(range(12))  # exactly once, in order
+        client.close()
+
+    def test_remote_queue_put_many(self, served):
+        queue, _, port = served
+        client = TransportClient("127.0.0.1", port)
+        rq = RemoteQueue(client)
+        assert rq.put_many([{"a": np.ones(2)}, {"a": np.zeros(2)}]) == 2
+        assert queue.size() == 2
+        client.close()
+
     def test_client_reconnects_after_server_restart(self):
         queue, weights = TrajectoryQueue(8), WeightStore()
         port = _free_port()
