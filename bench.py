@@ -720,6 +720,57 @@ def bench_ingest(B: int, iters: int) -> dict:
     return out
 
 
+def bench_apex_ingest(iters: int = 5) -> dict:
+    """Ape-X learner-side ingest rate (VERDICT r2 item 4): K buffered
+    unrolls scored in one [K*32] TD forward + C++ sum-tree batch add,
+    vs the reference's one-unroll-per-sess.run loop
+    (`/root/reference/train_apex.py:98-122`). Target: ingest must keep
+    up with the learn step's transitions/s at B=256."""
+    import jax
+
+    from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexConfig
+    from distributed_reinforcement_learning_tpu.runtime.apex_runner import ApexLearner
+    from distributed_reinforcement_learning_tpu.runtime.transport import _make_queue
+    from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+    from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_apex_batch
+
+    cfg = ApexConfig()
+    agent = ApexAgent(cfg)
+    U, K = 32, 8  # unroll transitions; max unrolls per device call
+    queue = _make_queue(256)
+    learner = ApexLearner(agent, queue, WeightStore(), batch_size=32,
+                          replay_capacity=100_000, rng=jax.random.PRNGKey(0))
+    one, _ = synthetic_apex_batch(U, cfg.obs_shape, cfg.num_actions)
+
+    def fill(n):
+        for _ in range(n):
+            queue.put(one)
+
+    out: dict = {}
+    for mode, kw in (("per_unroll", {"max_unrolls": 1}), ("batched", {"max_unrolls": K})):
+        fill(2 * K)
+        while learner.ingest_many(timeout=0.0, **kw):  # warm/compile
+            pass
+        ts = []
+        for _ in range(iters):
+            fill(2 * K)
+            t0 = time.perf_counter()
+            got = 0
+            while got < 2 * K:
+                got += learner.ingest_many(timeout=1.0, **kw)
+            ts.append((time.perf_counter() - t0) / (2 * K))
+        per_unroll_s = sorted(ts)[len(ts) // 2]
+        out[mode] = {
+            "unrolls_per_s": round(1.0 / per_unroll_s, 1),
+            "transitions_per_s": round(U / per_unroll_s, 1),
+        }
+    queue.close()
+    out["speedup"] = round(out["batched"]["transitions_per_s"]
+                           / out["per_unroll"]["transitions_per_s"], 2)
+    print(f"[bench] apex ingest: {out}", file=sys.stderr)
+    return out
+
+
 def bench_long_context(iters: int) -> dict:
     """Single-chip long-context attention fwd+bwd at T=8192: dense vs
     blockwise online-softmax vs the fused Pallas flash kernels — plus
@@ -1038,6 +1089,14 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["ximpala_learn"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] ximpala failed: {e}", file=sys.stderr)
+
+    if os.environ.get("BENCH_APEX_INGEST", "1") == "1":
+        try:
+            extra["apex_ingest"] = bench_apex_ingest(
+                int(os.environ.get("BENCH_APEX_INGEST_ITERS", "5")))
+        except Exception as e:  # noqa: BLE001
+            extra["apex_ingest"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] apex ingest failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_INGEST", "1") == "1":
         try:

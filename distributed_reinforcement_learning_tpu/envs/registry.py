@@ -9,10 +9,13 @@ The reference resolves env names via `gym.make` (`train_impala.py:117`,
   write; set `DRL_NO_GYMNASIUM=1` to force the in-tree numpy physics
   (tests use it for determinism, and it is the automatic fallback);
 - Atari names (`*Deterministic-v4`, `*NoFrameskip-v4`) use gymnasium +
-  `ale-py` when the emulator is importable; otherwise they fall back to
-  the full preprocessing pipeline over `SyntheticAtari` — and say so on
-  stderr, once per name, because training "Breakout" on noise silently
-  is how a benchmark lies (`DRL_SYNTHETIC_ATARI=1` opts into silence).
+  `ale-py` when the emulator is importable; otherwise `Breakout*` falls
+  back to the in-tree Breakout simulator (real game dynamics at ALE
+  specs, through the same GymnasiumRawFrames adapter — envs/breakout_sim)
+  and other titles fall back to the full preprocessing pipeline over
+  `SyntheticAtari`. Both fallbacks say so on stderr, once per name,
+  because training "Breakout" on a stand-in silently is how a benchmark
+  lies (`DRL_SYNTHETIC_ATARI=1` opts into silence).
 """
 
 from __future__ import annotations
@@ -60,8 +63,27 @@ def make_env(name: str, seed: int = 0, num_actions: int = 18) -> Env:
 
             if ale_available():
                 return AtariPreprocessor(GymnasiumRawFrames(name, seed=seed))
-        # No emulator importable: synthetic frames through the real
-        # preprocessing pipeline (same shapes/dtypes/life semantics).
+        # No emulator importable. Breakout falls back to the in-tree
+        # Breakout simulator — a real game (paddle/ball/brick dynamics,
+        # 2600 palette, FIRE launch, 5 lives) rendered at ALE specs —
+        # through the SAME GymnasiumRawFrames adapter an ALE install
+        # would use. Other titles fall back to SyntheticAtari noise.
+        if name.startswith("Breakout"):
+            from distributed_reinforcement_learning_tpu.envs import breakout_sim
+
+            if name not in _warned_synthetic and os.environ.get("DRL_SYNTHETIC_ATARI") != "1":
+                _warned_synthetic.add(name)
+                print(f"[envs] WARNING: no ALE emulator available; {name!r} resolves "
+                      f"to the in-tree Breakout simulator (real game dynamics, not "
+                      f"the 2600 ROM). Install ale-py for the real game.",
+                      file=sys.stderr)
+            if _use_gymnasium() and breakout_sim.register_gymnasium():
+                from distributed_reinforcement_learning_tpu.envs.gymnasium_env import GymnasiumRawFrames
+
+                return AtariPreprocessor(GymnasiumRawFrames("BreakoutSim-v0", seed=seed))
+            return AtariPreprocessor(breakout_sim.BreakoutSimRaw(seed=seed))
+        # Synthetic frames through the real preprocessing pipeline (same
+        # shapes/dtypes/life semantics).
         if name not in _warned_synthetic and os.environ.get("DRL_SYNTHETIC_ATARI") != "1":
             _warned_synthetic.add(name)
             print(f"[envs] WARNING: no ALE emulator available; {name!r} resolves to "

@@ -753,7 +753,7 @@ def _learner_loop(
     elif algo == "apex":
         while learner.train_steps < num_updates:
             drained = False
-            while learner.ingest(timeout=0.05):
+            while learner.ingest_many(timeout=0.05):
                 drained = True
             if learner.train() is None and not drained:
                 time.sleep(0.05)

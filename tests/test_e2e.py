@@ -100,6 +100,49 @@ def test_apex_trains_cartpole():
     assert late > early
 
 
+def test_apex_ingest_many_matches_per_unroll():
+    """The batched [K*32] TD forward must ingest exactly what K per-unroll
+    passes ingest: same count, same priorities, same stored transitions."""
+    cfg = ApexConfig(obs_shape=(4,), num_actions=2)
+    agent = ApexAgent(cfg)
+    weights = WeightStore()
+    rng = np.random.RandomState(0)
+    unrolls = []
+    for i in range(4):
+        from distributed_reinforcement_learning_tpu.agents.apex import ApexBatch
+        unrolls.append(ApexBatch(
+            state=rng.rand(32, 4).astype(np.float32),
+            next_state=rng.rand(32, 4).astype(np.float32),
+            previous_action=rng.randint(0, 2, 32).astype(np.int32),
+            action=rng.randint(0, 2, 32).astype(np.int32),
+            reward=rng.randn(32).astype(np.float32),
+            done=(rng.rand(32) < 0.1),
+        ))
+
+    def make_learner():
+        q = TrajectoryQueue(capacity=16)
+        lr = apex_runner.ApexLearner(
+            agent, q, weights, batch_size=8, replay_capacity=1_000,
+            rng=jax.random.PRNGKey(0))
+        for u in unrolls:
+            q.put(u)
+        return lr
+
+    a = make_learner()
+    while a.ingest_many(max_unrolls=1, timeout=0.0):
+        pass
+    b = make_learner()
+    assert b.ingest_many(max_unrolls=4, timeout=0.0) == 4
+    assert a.ingested_unrolls == b.ingested_unrolls == 4
+    assert len(a.replay) == len(b.replay) == 128
+    snap_a, snap_b = a.replay.snapshot(), b.replay.snapshot()
+    np.testing.assert_allclose(snap_a["priorities"], snap_b["priorities"],
+                               rtol=1e-6)
+    for ia, ib in zip(snap_a["items"], snap_b["items"]):
+        np.testing.assert_array_equal(ia.state, ib.state)
+        np.testing.assert_array_equal(ia.action, ib.action)
+
+
 def test_r2d2_trains_cartpole_pomdp():
     cfg = R2D2Config(obs_shape=(2,), num_actions=2, seq_len=10, burn_in=5,
                      lstm_size=64, learning_rate=1e-3)
