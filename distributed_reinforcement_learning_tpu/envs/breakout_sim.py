@@ -59,13 +59,22 @@ NOOP, FIRE, RIGHT, LEFT = 0, 1, 2, 3
 
 
 class BreakoutCore:
-    """Game state + renderer. One `step` = one rendered frame."""
+    """Game state + renderer.
+
+    `frameskip`: ALE's built-in action repeat — the action is applied for
+    `frameskip` emulated frames, rewards sum, and the LAST frame is
+    returned (exactly what a `*Deterministic-v4` registration does;
+    `*NoFrameskip-v4` = 1). Serving a skip-1 game under a Deterministic
+    name would make dynamics 4x slower per action than the real env this
+    proxies, silently breaking configs the moment ale-py appears.
+    """
 
     num_actions = 4
 
-    def __init__(self, seed: int = 0, max_frames: int = 10_000):
+    def __init__(self, seed: int = 0, max_frames: int = 10_000, frameskip: int = 1):
         self._rng = np.random.RandomState(seed)
         self._max_frames = max_frames
+        self.frameskip = max(1, frameskip)
         self._consume_reward = 0.0
         self.reset()
 
@@ -98,12 +107,23 @@ class BreakoutCore:
             raise ValueError(
                 f"action {action} outside Breakout's {self.num_actions}-action set "
                 f"(alias the policy head with `action % available_action` first)")
+        reward = 0.0
+        done = False
+        for _ in range(self.frameskip):  # action held for every skipped frame
+            r, done = self._emulate_frame(action)
+            reward += r
+            if done:
+                break
+        return self.render(), reward, done, {"lives": self.lives}
+
+    def _emulate_frame(self, action: int) -> tuple[float, bool]:
+        """One emulated frame under a held action -> (reward, done)."""
         self.frames += 1
         reward = 0.0
         if action == RIGHT:
-            self.paddle_x = min(W - WALL_SIDE - PADDLE_W, self.paddle_x + 6)
+            self.paddle_x = min(W - WALL_SIDE - PADDLE_W, self.paddle_x + 4)
         elif action == LEFT:
-            self.paddle_x = max(WALL_SIDE, self.paddle_x - 6)
+            self.paddle_x = max(WALL_SIDE, self.paddle_x - 4)
         elif action == FIRE and self._ball_dead and self.lives > 0:
             self._launch()
 
@@ -120,7 +140,7 @@ class BreakoutCore:
                     break
 
         done = self.lives <= 0 or not self.bricks.any() or self.frames >= self._max_frames
-        return self.render(), reward, done, {"lives": self.lives}
+        return reward, done
 
     def _collide(self) -> None:
         # Side walls.
@@ -188,8 +208,9 @@ class BreakoutCore:
 class BreakoutSimRaw:
     """`RawFrameEnv`-protocol surface over `BreakoutCore` (no gymnasium)."""
 
-    def __init__(self, seed: int = 0, max_frames: int = 10_000):
-        self._core = BreakoutCore(seed=seed, max_frames=max_frames)
+    def __init__(self, seed: int = 0, max_frames: int = 10_000, frameskip: int = 1):
+        self._core = BreakoutCore(seed=seed, max_frames=max_frames,
+                                  frameskip=frameskip)
         self.num_actions = BreakoutCore.num_actions
 
     def reset(self) -> np.ndarray:
@@ -222,8 +243,9 @@ def register_gymnasium() -> bool:
     class _GymBreakoutSim(gymnasium.Env):
         metadata = {"render_modes": []}
 
-        def __init__(self, max_frames: int = 10_000):
+        def __init__(self, max_frames: int = 10_000, frameskip: int = 1):
             self._max_frames = max_frames
+            self._frameskip = frameskip
             self._core: BreakoutCore | None = None
             self.action_space = spaces.Discrete(BreakoutCore.num_actions)
             self.observation_space = spaces.Box(0, 255, (H, W, 3), np.uint8)
@@ -231,7 +253,8 @@ def register_gymnasium() -> bool:
         def reset(self, *, seed=None, options=None):
             super().reset(seed=seed)
             if self._core is None or seed is not None:
-                self._core = BreakoutCore(seed=seed or 0, max_frames=self._max_frames)
+                self._core = BreakoutCore(seed=seed or 0, max_frames=self._max_frames,
+                                          frameskip=self._frameskip)
             obs = self._core.reset()
             return obs, {"lives": self._core.lives}
 
@@ -239,6 +262,11 @@ def register_gymnasium() -> bool:
             obs, reward, done, info = self._core.step(int(action))
             return obs, reward, done, False, info
 
+    # Mirror ALE's registrations: the Deterministic id bakes in the
+    # emulator frameskip of 4, NoFrameskip/plain = 1.
     gymnasium.register(id="BreakoutSim-v0", entry_point=lambda **kw: _GymBreakoutSim(**kw))
+    gymnasium.register(
+        id="BreakoutSimDeterministic-v0",
+        entry_point=lambda **kw: _GymBreakoutSim(**{"frameskip": 4, **kw}))
     _GYM_REGISTERED = True
     return True

@@ -77,11 +77,16 @@ def make_env(name: str, seed: int = 0, num_actions: int = 18) -> Env:
                       f"to the in-tree Breakout simulator (real game dynamics, not "
                       f"the 2600 ROM). Install ale-py for the real game.",
                       file=sys.stderr)
+            # The Deterministic name encodes ALE's built-in frameskip 4
+            # (see GymnasiumRawFrames docstring) — honor it in the sim.
+            skip = 4 if "Deterministic" in name else 1
             if _use_gymnasium() and breakout_sim.register_gymnasium():
                 from distributed_reinforcement_learning_tpu.envs.gymnasium_env import GymnasiumRawFrames
 
-                return AtariPreprocessor(GymnasiumRawFrames("BreakoutSim-v0", seed=seed))
-            return AtariPreprocessor(breakout_sim.BreakoutSimRaw(seed=seed))
+                sim_name = ("BreakoutSimDeterministic-v0" if skip == 4
+                            else "BreakoutSim-v0")
+                return AtariPreprocessor(GymnasiumRawFrames(sim_name, seed=seed))
+            return AtariPreprocessor(breakout_sim.BreakoutSimRaw(seed=seed, frameskip=skip))
         # Synthetic frames through the real preprocessing pipeline (same
         # shapes/dtypes/life semantics).
         if name not in _warned_synthetic and os.environ.get("DRL_SYNTHETIC_ATARI") != "1":

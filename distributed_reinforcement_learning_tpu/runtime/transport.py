@@ -22,6 +22,7 @@ peer hung the cluster, SURVEY §5.3).
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -678,9 +679,14 @@ def run_role(
             inference = InferenceServer.for_agent(algo, learner.agent, weights,
                                                   seed=seed + 7777)
             print("[learner] SEED-style inference service enabled")
-        server = TransportServer(queue, weights, host="0.0.0.0", port=rt.server_port,
+        # Each multihost learner process serves its own data plane on
+        # server_port + process_index: globally unambiguous (actors pick
+        # a learner via DRL_LEARNER_INDEX) and collision-free when the
+        # processes share one machine (tests; single-host multi-chip).
+        serve_port = rt.server_port + (jax.process_index() if multihost else 0)
+        server = TransportServer(queue, weights, host="0.0.0.0", port=serve_port,
                                  inference=inference).start()
-        print(f"[learner] serving on :{rt.server_port}; training {num_updates} updates")
+        print(f"[learner] serving on :{serve_port}; training {num_updates} updates")
         try:
             _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
         finally:
@@ -695,13 +701,28 @@ def run_role(
     elif mode == "actor":
         if task < 0:
             raise ValueError("actor mode needs --task k")
-        client = TransportClient(rt.server_ip, rt.server_port)
+        # Multi-learner topology: each learner process needs its local
+        # batch share fed, so launch scripts partition actors across the
+        # learners. Addressing:
+        #   DRL_LEARNER_ADDR=host:port  — full address (learners on
+        #     different machines, the normal TPU-pod layout);
+        #   DRL_LEARNER_INDEX=k        — port offset against the config's
+        #     server_ip/server_port (learner processes co-hosted: tests,
+        #     single-host multi-chip).
+        addr = os.environ.get("DRL_LEARNER_ADDR")
+        if addr:
+            host, _, p = addr.rpartition(":")
+            server_ip, port = host, int(p)
+        else:
+            server_ip = rt.server_ip
+            port = rt.server_port + int(os.environ.get("DRL_LEARNER_INDEX", "0"))
+        client = TransportClient(server_ip, port)
         actor = launch.make_actor(
             algo, agent_cfg, rt, task, RemoteQueue(client), RemoteWeights(client),
             seed=seed + 1 + task,
             remote_act=RemoteInference(client) if remote_act else None,
         )
-        print(f"[actor {task}] connected to {rt.server_ip}:{rt.server_port}")
+        print(f"[actor {task}] connected to {server_ip}:{port}")
         # Elastic recovery (SURVEY §5.3 — the reference had none: a dead
         # learner left actors blocked forever): on transport failure the
         # actor keeps retrying for `actor_grace` seconds, riding out a

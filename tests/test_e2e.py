@@ -174,32 +174,39 @@ def test_r2d2_trains_cartpole_pomdp():
 def test_xformer_trains_cartpole_pomdp():
     """Fourth family: the causal transformer solves the same POMDP the
     LSTM does — attention over the window integrates velocity. Takeoff
-    is slower than the LSTM's (~500 vs ~250 updates) and needs the
-    actor's epsilon floor; measured ~10 -> ~120 @ 600 updates."""
+    is slower than the LSTM's (~500 vs ~250 updates).
+
+    Seed-AVERAGED bar (VERDICT r2 item 8): per-seed thresholds get
+    loosened whenever hardware FP drift shifts one trajectory; a 3-seed
+    mean tightens instead. Each seed still must clearly beat random
+    (~20) on its own."""
     from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent, XformerConfig
     from distributed_reinforcement_learning_tpu.runtime import xformer_runner
 
+    # One agent for all seeds: the jit cache dominates each run's cost and
+    # carries no training state (params live in the learner/actor).
     cfg = XformerConfig(obs_shape=(2,), num_actions=2, seq_len=10, burn_in=5,
                         d_model=32, num_heads=2, num_layers=2, learning_rate=2e-3)
     agent = XformerAgent(cfg)
-    queue = TrajectoryQueue(capacity=128)
-    weights = WeightStore()
-    learner = xformer_runner.XformerLearner(
-        agent, queue, weights, batch_size=16, replay_capacity=5_000,
-        target_sync_interval=20, rng=jax.random.PRNGKey(0))
-    env = VectorCartPole(num_envs=8, seed=0)
-    actor = xformer_runner.XformerActor(
-        agent, env, queue, weights, seed=1, obs_transform=pomdp_project)
 
-    result = xformer_runner.run_sync(learner, [actor], num_updates=600)
+    def run_seed(seed: int) -> float:
+        queue = TrajectoryQueue(capacity=128)
+        weights = WeightStore()
+        learner = xformer_runner.XformerLearner(
+            agent, queue, weights, batch_size=16, replay_capacity=5_000,
+            target_sync_interval=20, rng=jax.random.PRNGKey(seed))
+        env = VectorCartPole(num_envs=8, seed=seed)
+        actor = xformer_runner.XformerActor(
+            agent, env, queue, weights, seed=seed + 1, obs_transform=pomdp_project)
+        result = xformer_runner.run_sync(learner, [actor], num_updates=600)
+        assert learner.train_steps == 600
+        assert np.isfinite(result["last_metrics"]["loss"])
+        returns = result["episode_returns"]
+        return float(np.mean(returns[-20:]))
 
-    assert learner.train_steps == 600
-    assert np.isfinite(result["last_metrics"]["loss"])
-    returns = result["episode_returns"]
-    late = np.mean(returns[-20:])
-    early = np.mean(returns[:20])
-    assert late > 60, f"late mean return {late} (early {early})"
-    assert late > early
+    lates = [run_seed(s) for s in (0, 1, 2)]
+    assert all(late > 25 for late in lates), lates  # each seed beats random
+    assert np.mean(lates) > 60, lates  # the seed-averaged learning bar
 
 
 def test_impala_publish_interval_still_learns():

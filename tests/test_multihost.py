@@ -27,6 +27,129 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def test_socket_topology_two_learners_with_restart(tmp_path):
+    """The full lived-in cluster mode through run_role (VERDICT r2 item 5):
+    2 learner processes (4 virtual devices each, one global pjit mesh, own
+    data-plane port each) + 2 socket actor processes partitioned across
+    them. Asserts the weight versions advance in lockstep on BOTH data
+    planes mid-run, then kills and restarts the learner pair from the
+    checkpoint while the actors ride the outage on their grace window."""
+    import json
+    import time as _time
+
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        _I64, OP_GET_WEIGHTS, TransportClient)
+
+    worker = Path(__file__).parent / "socket_topology_worker.py"
+    base_port = _free_port()
+    # Test-local config: free data-plane port base, small queue.
+    cfg = json.load(open(Path(__file__).parent.parent / "config.json"))
+    section = dict(cfg["impala_cartpole"])
+    section["server_port"] = base_port
+    cfg["impala_cartpole_sock"] = section
+    config_path = tmp_path / "config.json"
+    config_path.write_text(json.dumps(cfg))
+    ckpt_dir = tmp_path / "ckpt"
+
+    env = {**os.environ, "DRL_NUM_PROCESSES": "2"}
+    env.pop("XLA_FLAGS", None)
+
+    def launch_learners(updates: int):
+        coord = _free_port()
+        e = {**env, "DRL_COORDINATOR": f"localhost:{coord}"}
+        return [
+            subprocess.Popen(
+                [sys.executable, str(worker), "learner", str(pid), str(updates),
+                 str(config_path), "impala_cartpole_sock", str(ckpt_dir)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=e,
+                cwd=str(worker.parent.parent))
+            for pid in range(2)
+        ]
+
+    def wait_all(procs, timeout):
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+        return outs
+
+    actors = []
+    learners = launch_learners(12)
+    try:
+        actors = [
+            subprocess.Popen(
+                [sys.executable, str(worker), "actor", str(task), str(task % 2),
+                 str(config_path), "impala_cartpole_sock"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+                cwd=str(worker.parent.parent))
+            for task in range(2)
+        ]
+
+        # Lockstep probe: both learner processes' data planes must expose
+        # advancing weight versions while training runs.
+        def poll_versions(deadline_s: float) -> list[tuple[int, int]]:
+            seen = []
+            deadline = _time.monotonic() + deadline_s
+            clients = {}
+            while _time.monotonic() < deadline:
+                try:
+                    pair = []
+                    for k in range(2):
+                        if k not in clients:
+                            clients[k] = TransportClient(
+                                "127.0.0.1", base_port + k,
+                                connect_retries=2, retry_interval=0.5)
+                        resp = clients[k]._call(OP_GET_WEIGHTS, _I64.pack(-2))
+                        pair.append(_I64.unpack(resp[: _I64.size])[0])
+                    seen.append(tuple(pair))
+                    if pair[0] >= 3 and pair[1] >= 3:
+                        break
+                except (ConnectionError, OSError):
+                    pass  # learners still compiling/binding
+                _time.sleep(2.0)
+            for c in clients.values():
+                c.close()
+            return seen
+
+        versions = poll_versions(240.0)
+        assert versions and versions[-1][0] >= 3 and versions[-1][1] >= 3, versions
+        # Lockstep: the global-mesh collectives force equal step counts.
+        # The observable bound is looser than +-1: async publication (the
+        # default) may lag a plane's visible version by up to
+        # 3*publish_interval before its bounded-staleness flush kicks in
+        # (runtime/publishing.py), plus one step of polling skew.
+        assert all(abs(a - b) <= 4 for a, b in versions), versions
+
+        outs = wait_all(learners, timeout=420)
+        for rc, out, err in outs:
+            assert rc == 0, f"learner rc={rc}\n{out}\n{err[-2000:]}"
+            assert "done: 12 updates" in out
+        assert (ckpt_dir / "latest").exists() or any(ckpt_dir.iterdir())
+
+        # Restart the learner pair from the checkpoint (the whole pjit
+        # group restarts together — single-process elastic rejoin is not
+        # a thing jax.distributed supports). Actors are still up, riding
+        # their grace window.
+        learners = launch_learners(24)
+        outs = wait_all(learners, timeout=420)
+        for rc, out, err in outs:
+            assert rc == 0, f"restart learner rc={rc}\n{out}\n{err[-2000:]}"
+            assert "resumed from step 12" in out, out
+            assert "done: 24 updates" in out
+        # The actors survived the restart: still running (no grace exit).
+        for a in actors:
+            assert a.poll() is None, a.communicate()[0]
+    finally:
+        for p in actors + learners:
+            if p.poll() is None:
+                p.kill()
+        for p in actors + learners:
+            try:
+                p.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+
 def test_two_process_learner_agrees():
     port = _free_port()
     env = {**os.environ}
