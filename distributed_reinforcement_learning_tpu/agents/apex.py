@@ -79,15 +79,15 @@ class ApexAgent:
     # -- act -------------------------------------------------------------
     def _act(self, params, obs, prev_action, epsilon, rng):
         """Batched epsilon-greedy: argmax Q with probability 1-eps."""
-        q = self.model.apply(params, common.normalize_obs(obs), prev_action)
+        q = self.model.apply(params, common.normalize_obs(obs, self.cfg.dtype), prev_action)
         action = common.epsilon_greedy(q, epsilon, self.cfg.num_actions, rng)
         return action, q
 
     # -- shared target math ----------------------------------------------
     def _targets(self, params, target_params, batch: ApexBatch):
         cfg = self.cfg
-        obs = common.normalize_obs(batch.state)
-        next_obs = common.normalize_obs(batch.next_state)
+        obs = common.normalize_obs(batch.state, self.cfg.dtype)
+        next_obs = common.normalize_obs(batch.next_state, self.cfg.dtype)
         # One conv pass over [s; s'] for the main net.
         stacked = jnp.concatenate([obs, next_obs], axis=0)
         stacked_pa = jnp.concatenate([batch.previous_action, batch.action], axis=0)

@@ -111,7 +111,7 @@ class ImpalaAgent:
         jax.random.categorical over log-probabilities), batched over the
         actor's parallel envs instead of one `sess.run` per env.
         """
-        out = self.model.apply(params, common.normalize_obs(obs), prev_action, h, c)
+        out = self.model.apply(params, common.normalize_obs(obs, self.cfg.dtype), prev_action, h, c)
         action = jax.random.categorical(rng, jnp.log(out.policy + 1e-20), axis=-1)
         return ActOutput(action, out.policy, out.h, out.c)
 
@@ -123,7 +123,7 @@ class ImpalaAgent:
             forward = jax.checkpoint(forward)
         policy, value = forward(
             params,
-            common.normalize_obs(batch.state),
+            common.normalize_obs(batch.state, self.cfg.dtype),
             batch.previous_action,
             batch.initial_h,
             batch.initial_c,

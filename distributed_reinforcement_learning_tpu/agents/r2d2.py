@@ -79,7 +79,7 @@ class R2D2Agent(common.SequenceReplayLearnMixin):
     # -- act -------------------------------------------------------------
     def _act(self, params, obs, h, c, prev_action, epsilon, rng):
         """Batched epsilon-greedy single step (`agent/r2d2.py:166-186`)."""
-        q, new_h, new_c = self.model.apply(params, common.normalize_obs(obs), prev_action, h, c)
+        q, new_h, new_c = self.model.apply(params, common.normalize_obs(obs, self.cfg.dtype), prev_action, h, c)
         action = common.epsilon_greedy(q, epsilon, self.cfg.num_actions, rng)
         return action, q, new_h, new_c
 
@@ -89,7 +89,7 @@ class R2D2Agent(common.SequenceReplayLearnMixin):
     # in `common.sequence_double_q_td` (`agent/r2d2.py:64-87`).
     def _sequence_td(self, params, target_params, batch: R2D2Batch):
         cfg = self.cfg
-        obs = common.normalize_obs(batch.state)
+        obs = common.normalize_obs(batch.state, self.cfg.dtype)
         unroll = lambda p: self.model.apply(
             p, obs, batch.previous_action, batch.done, batch.initial_h, batch.initial_c,
             method=self.model.unroll)

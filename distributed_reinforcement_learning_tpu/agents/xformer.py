@@ -252,7 +252,7 @@ class XformerAgent(common.SequenceReplayLearnMixin):
         same params, same math, no mesh.
         """
         q_seq = self._dense_model.apply(
-            params, common.normalize_obs(obs_win), prev_action_win, done_win)
+            params, common.normalize_obs(obs_win, self.cfg.dtype), prev_action_win, done_win)
         q = q_seq[:, -1]
         action = common.epsilon_greedy(q, epsilon, self.cfg.num_actions, rng)
         return action, q
@@ -265,7 +265,7 @@ class XformerAgent(common.SequenceReplayLearnMixin):
     def _sequence_td(self, params, target_params, batch: XformerBatch, model=None):
         cfg = self.cfg
         model = model or self.model
-        obs = common.normalize_obs(batch.state)
+        obs = common.normalize_obs(batch.state, self.cfg.dtype)
         forward = lambda p: model.apply(p, obs, batch.previous_action, batch.done)
         discounts = (~batch.done).astype(jnp.float32) * cfg.discount_factor
         if cfg.num_experts:

@@ -139,14 +139,14 @@ class XImpalaAgent:
         host).
         """
         policy, _ = self._dense_model.apply(
-            params, common.normalize_obs(obs_win), prev_action_win, done_win)
+            params, common.normalize_obs(obs_win, self.cfg.dtype), prev_action_win, done_win)
         policy = policy[:, -1]
         action = jax.random.categorical(rng, jnp.log(policy + 1e-20), axis=-1)
         return XImpalaActOutput(action, policy)
 
     # -- learn -----------------------------------------------------------
     def _forward(self, params, batch: XImpalaBatch):
-        obs = common.normalize_obs(batch.state)
+        obs = common.normalize_obs(batch.state, self.cfg.dtype)
         # env_done, not the shaped done: attention context follows true
         # episode boundaries (see XImpalaBatch).
         if self.cfg.num_experts:

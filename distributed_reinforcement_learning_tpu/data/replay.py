@@ -70,6 +70,20 @@ class SumTree:
         return idx, float(self._tree[idx]), self._data[data_idx]
 
 
+def _snapshot_items(snap: dict) -> list[Any]:
+    """Per-item view of a snapshot dict, whichever backend wrote it
+    (`items` list, or the array backend's `stacked` pytree)."""
+    if snap.get("items") is not None:
+        return snap["items"]
+    stacked = snap.get("stacked")
+    if stacked is None:
+        return []
+    import jax
+
+    n = len(snap["priorities"])
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+
+
 class PrioritizedReplay:
     """The reference's `Memory` surface: add / sample / update.
 
@@ -155,9 +169,49 @@ class PrioritizedReplay:
         the ring write cursor restarts at `count % capacity`, so after a
         wrapped buffer the future *eviction order* differs from the
         original — harmless for replay semantics."""
-        for p, item in zip(snap["priorities"], snap["items"]):
+        for p, item in zip(snap["priorities"], _snapshot_items(snap)):
             self.tree.add(float(p), item)  # raw: already |err|^alpha-transformed
         self.beta = float(snap["beta"])
+
+
+def _stratified_pick(tree, count: int, n: int, rng, is_written) -> tuple[np.ndarray, np.ndarray]:
+    """Shared stratified-sampling policy over a batched sum-tree:
+    one segment per sample, 4 retry rounds for descents that land on
+    unwritten leaves (float64 rounding while partially filled), then a
+    uniform-random written leaf as the final fallback. Returns
+    (tree_idxs, priorities). ONE copy of the policy for the two
+    native-tree backends — a fix here fixes both."""
+    cap = tree.capacity
+    segment = tree.total / n
+    lo = segment * np.arange(n)
+    idxs = np.empty(n, np.int64)
+    priorities = np.empty(n, np.float64)
+    filled = np.zeros(n, bool)
+    for _ in range(4):
+        todo = np.flatnonzero(~filled)
+        if todo.size == 0:
+            break
+        values = lo[todo] + rng.uniform(0.0, segment, size=todo.size)
+        got_idx, got_p = tree.get_batch(values)
+        ok = is_written(got_idx - (cap - 1))
+        hit = todo[ok]
+        idxs[hit] = got_idx[ok]
+        priorities[hit] = got_p[ok]
+        filled[hit] = True
+    for i in np.flatnonzero(~filled):
+        leaf = int(rng.randint(0, count))
+        idxs[i] = leaf + cap - 1
+        priorities[i] = tree.leaf_priority(int(idxs[i]))
+    return idxs, priorities
+
+
+def _is_weights(priorities: np.ndarray, total: float, count: int,
+                beta: float) -> np.ndarray:
+    """`(N * p)^-beta`, batch-max-normalized (`buffer_queue.py:338-341`)."""
+    probs = priorities / total
+    weights = np.power(count * probs, -beta)
+    weights /= weights.max()
+    return weights.astype(np.float32)
 
 
 class NativePrioritizedReplay:
@@ -207,34 +261,14 @@ class NativePrioritizedReplay:
     def _sample_locked(self, n: int, rng):
         rng = rng or np.random
         self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
-        segment = self.tree.total / n
-        lo = segment * np.arange(n)
-        idxs = np.empty(n, np.int64)
-        priorities = np.empty(n, np.float64)
-        filled = np.zeros(n, bool)
         cap = self.tree.capacity
-        # Same retry-then-fallback policy as the Python impl: rounding in
-        # the descent can land on unwritten leaves while partially filled.
-        for _ in range(4):
-            todo = np.flatnonzero(~filled)
-            if todo.size == 0:
-                break
-            values = lo[todo] + rng.uniform(0.0, segment, size=todo.size)
-            got_idx, got_p = self.tree.get_batch(values)
-            ok = np.array([self._data[int(i) - (cap - 1)] is not None for i in got_idx])
-            hit = todo[ok]
-            idxs[hit] = got_idx[ok]
-            priorities[hit] = got_p[ok]
-            filled[hit] = True
-        for i in np.flatnonzero(~filled):
-            leaf = int(rng.randint(0, len(self.tree)))
-            idxs[i] = leaf + cap - 1
-            priorities[i] = self.tree.leaf_priority(int(idxs[i]))
+        idxs, priorities = _stratified_pick(
+            self.tree, len(self.tree), n, rng,
+            is_written=lambda slots: np.array(
+                [self._data[int(s)] is not None for s in slots]))
         items = [self._data[int(i) - (cap - 1)] for i in idxs]
-        probs = priorities / self.tree.total
-        weights = np.power(len(self.tree) * probs, -self.beta)
-        weights /= weights.max()
-        return items, idxs, weights.astype(np.float32)
+        return items, idxs, _is_weights(priorities, self.tree.total,
+                                        len(self.tree), self.beta)
 
     def update(self, idx: int, error: float) -> None:
         self.update_batch(np.array([idx]), np.array([error]))
@@ -259,22 +293,174 @@ class NativePrioritizedReplay:
     def restore(self, snap: dict) -> None:
         with self._lock:
             slots = self.tree.add_batch(np.asarray(snap["priorities"], np.float64))
-            for slot, item in zip(slots, snap["items"]):
+            for slot, item in zip(slots, _snapshot_items(snap)):
                 self._data[slot] = item
             self.beta = float(snap["beta"])
 
 
+class ArrayPrioritizedReplay:
+    """Structure-of-arrays prioritized replay over the C++ sum-tree.
+
+    The backends above (and the reference's `Memory`) store one Python
+    pytree per transition: every ingest slices a batch into N objects
+    and every train step re-stacks batch_size of them — pure host
+    overhead on the learner thread. Here payloads live in preallocated
+    per-field numpy rings indexed by the native tree's write slots:
+
+    - `add_batch_stacked(errors, batch)` is one vectorized slice-assign
+      per field (no per-transition objects at all);
+    - `sample(n)` returns an ALREADY-STACKED batch via one fancy-index
+      gather per field (`stacked_samples = True` tells learners to skip
+      `stack_pytrees`).
+
+    Priority/IS math is identical to `PrioritizedReplay` (the parity
+    contract with `buffer_queue.py:303-346`). numpy's `np.empty` maps
+    pages lazily, so a capacity-1e5 Atari ring costs physical memory
+    only as slots are written — same high-water mark as the list
+    backends, paid gradually.
+    """
+
+    stacked_samples = True
+    EPS = PrioritizedReplay.EPS
+    ALPHA = PrioritizedReplay.ALPHA
+    BETA_INCREMENT = PrioritizedReplay.BETA_INCREMENT
+
+    def __init__(self, capacity: int, beta: float = 0.4):
+        from distributed_reinforcement_learning_tpu.data.native import NativeSumTree
+
+        self.tree = NativeSumTree(capacity)
+        self.beta = beta
+        self._store = None  # pytree of [capacity, ...] arrays, lazy
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.tree)
+
+    def _priority(self, errors) -> np.ndarray:
+        return (np.abs(np.asarray(errors, np.float64)) + self.EPS) ** self.ALPHA
+
+    def _ensure_store(self, batch: Any) -> None:
+        import jax
+
+        if self._store is None:
+            cap = self.tree.capacity
+            self._store = jax.tree.map(
+                lambda x: np.empty((cap, *np.asarray(x).shape[1:]),
+                                   np.asarray(x).dtype),
+                batch,
+            )
+
+    def _write(self, slots: np.ndarray, batch: Any) -> None:
+        import jax
+
+        jax.tree.map(lambda store, x: store.__setitem__(slots, np.asarray(x)),
+                     self._store, batch)
+
+    def add_batch_stacked(self, errors: np.ndarray, batch: Any) -> np.ndarray:
+        """Insert a `[N, ...]`-stacked batch of transitions/sequences."""
+        with self._lock:
+            self._ensure_store(batch)
+            slots = self.tree.add_batch(self._priority(errors))
+            self._write(slots, batch)
+            return slots + self.tree.capacity - 1
+
+    def add_batch(self, errors: np.ndarray, samples: list[Any]) -> list[int]:
+        from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
+
+        return list(self.add_batch_stacked(errors, stack_pytrees(samples)))
+
+    def add(self, error: float, sample: Any) -> int:
+        import jax
+
+        return int(self.add_batch_stacked(
+            np.array([error]), jax.tree.map(lambda x: np.asarray(x)[None], sample))[0])
+
+    def sample(self, n: int, rng: np.random.RandomState | None = None):
+        import jax
+
+        rng = rng or np.random
+        with self._lock:
+            self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
+            count = len(self.tree)
+            idxs, priorities = _stratified_pick(
+                self.tree, count, n, rng,
+                is_written=lambda slots: slots < count)
+            slots = idxs - (self.tree.capacity - 1)
+            batch = jax.tree.map(lambda store: store[slots], self._store)
+            return batch, idxs, _is_weights(priorities, self.tree.total,
+                                            count, self.beta)
+
+    def update(self, idx: int, error: float) -> None:
+        self.update_batch(np.array([idx]), np.array([error]))
+
+    def update_batch(self, idxs: np.ndarray, errors: np.ndarray) -> None:
+        self.tree.update_batch(np.asarray(idxs, np.int64), self._priority(errors))
+
+    def approx_snapshot_nbytes(self) -> int:
+        """Snapshot payload size WITHOUT materializing it — from store
+        dtypes/shapes only. checkpoint's size cap consults this first so
+        an over-cap replay (a full Atari ring is ~5 GB) is rejected
+        before snapshot() copies it under the lock."""
+        import jax
+
+        n = len(self.tree)
+        if self._store is None or n == 0:
+            return 0
+        per_item = sum(
+            int(np.prod(leaf.shape[1:], dtype=np.int64)) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(self._store))
+        return n * per_item + n * 8  # + float64 priorities
+
+    def snapshot(self) -> dict:
+        """Checkpoint state; `stacked` replaces the list backends' `items`
+        (decode handles both — utils/checkpoint.py)."""
+        import jax
+
+        with self._lock:
+            n = len(self.tree)
+            cap = self.tree.capacity
+            priorities = np.array(
+                [self.tree.leaf_priority(slot + cap - 1) for slot in range(n)],
+                np.float64)
+            stacked = (None if self._store is None else
+                       jax.tree.map(lambda store: store[:n].copy(), self._store))
+            return {"priorities": priorities, "stacked": stacked,
+                    "beta": float(self.beta)}
+
+    def restore(self, snap: dict) -> None:
+        import jax
+
+        with self._lock:
+            if "stacked" in snap and snap["stacked"] is not None:
+                self._ensure_store(snap["stacked"])
+                slots = self.tree.add_batch(np.asarray(snap["priorities"], np.float64))
+                self._write(slots, snap["stacked"])
+            elif snap.get("items"):  # a list-backend snapshot restores too
+                from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
+
+                batch = stack_pytrees(snap["items"])
+                self._ensure_store(batch)
+                slots = self.tree.add_batch(np.asarray(snap["priorities"], np.float64))
+                self._write(slots, batch)
+            self.beta = float(snap["beta"])
+
+
 def make_replay(capacity: int, beta: float = 0.4, backend: str = "auto"):
-    """Pick the replay implementation: 'python', 'native', or 'auto'."""
+    """Pick the replay implementation: 'python', 'native', 'array', or
+    'auto' (= structure-of-arrays over the C++ tree when the native lib
+    builds, else the pure-Python Memory)."""
     if backend == "python":
         return PrioritizedReplay(capacity, beta)
     if backend == "native":
         return NativePrioritizedReplay(capacity, beta)
-    if backend == "auto":
+    if backend in ("array", "auto"):
         from distributed_reinforcement_learning_tpu.data.native import native_available
 
-        cls = NativePrioritizedReplay if native_available() else PrioritizedReplay
-        return cls(capacity, beta)
+        if native_available():
+            return ArrayPrioritizedReplay(capacity, beta)
+        if backend == "array":
+            raise RuntimeError("array replay backend needs the native library")
+        return PrioritizedReplay(capacity, beta)
     raise ValueError(f"unknown replay backend {backend!r}")
 
 

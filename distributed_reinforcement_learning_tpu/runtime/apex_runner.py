@@ -251,9 +251,14 @@ class ApexLearner(PublishCadenceMixin):
                 lambda x: np.asarray(x).reshape(-1, *np.asarray(x).shape[2:]), stacked)
             td = np.asarray(self.agent.td_error(self.state, flat))
         with self.timer.stage("ingest_replay_add"):
-            self.replay.add_batch(
-                td, [jax.tree.map(lambda x: x[i], flat) for i in range(len(td))]
-            )
+            if getattr(self.replay, "stacked_samples", False):
+                # SoA backend: one vectorized slice-assign per field —
+                # no per-transition Python objects at all.
+                self.replay.add_batch_stacked(td, flat)
+            else:
+                self.replay.add_batch(
+                    td, [jax.tree.map(lambda x: x[i], flat) for i in range(len(td))]
+                )
         self.ingested_unrolls += k
         return k
 
@@ -263,7 +268,9 @@ class ApexLearner(PublishCadenceMixin):
             return None
         with self.timer.stage("replay_sample"):
             items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
-            batch = stack_pytrees(items)
+            # SoA backend returns the stacked batch directly.
+            batch = items if getattr(self.replay, "stacked_samples", False) \
+                else stack_pytrees(items)
         with self.timer.stage("learn"):
             if self._batch_sharding is not None:
                 from distributed_reinforcement_learning_tpu.parallel import place_local_batch

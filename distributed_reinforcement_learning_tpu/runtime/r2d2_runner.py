@@ -213,7 +213,10 @@ class R2D2Learner(PublishCadenceMixin):
             batch = stack_pytrees(seqs)
             td = np.asarray(self.agent.td_error(self.state, batch))
         with self.timer.stage("ingest_replay_add"):
-            self.replay.add_batch(td, seqs)
+            if getattr(self.replay, "stacked_samples", False):
+                self.replay.add_batch_stacked(td, batch)  # one slice-assign/field
+            else:
+                self.replay.add_batch(td, seqs)
         self.ingested_sequences += len(seqs)
         return len(seqs)
 
@@ -223,7 +226,9 @@ class R2D2Learner(PublishCadenceMixin):
             return None
         with self.timer.stage("replay_sample"):
             items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
-            batch = stack_pytrees(items)
+            # SoA backend returns the stacked batch directly.
+            batch = items if getattr(self.replay, "stacked_samples", False) \
+                else stack_pytrees(items)
         with self.timer.stage("learn"):
             if self._batch_sharding is not None:
                 from distributed_reinforcement_learning_tpu.parallel import place_local_batch

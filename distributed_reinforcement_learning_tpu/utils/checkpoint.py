@@ -43,15 +43,27 @@ def encode_replay_snapshot(replay) -> bytes | None:
     """
     if os.environ.get("DRL_CKPT_REPLAY", "1") == "0":
         return None
-    snap = replay.snapshot()
-    nbytes = sum(
-        x.nbytes for x in _iter_array_leaves(snap["items"])
-    ) + snap["priorities"].nbytes
     cap_mb = float(os.environ.get("DRL_CKPT_REPLAY_MAX_MB", "512"))
-    if nbytes > cap_mb * 1e6:
-        print(f"[checkpoint] replay snapshot {nbytes / 1e6:.0f} MB exceeds "
-              f"DRL_CKPT_REPLAY_MAX_MB={cap_mb:.0f}; skipping (set higher to keep it)",
-              file=sys.stderr)
+
+    def over_cap(nbytes: int) -> bool:
+        if nbytes > cap_mb * 1e6:
+            print(f"[checkpoint] replay snapshot {nbytes / 1e6:.0f} MB exceeds "
+                  f"DRL_CKPT_REPLAY_MAX_MB={cap_mb:.0f}; skipping (set higher "
+                  f"to keep it)", file=sys.stderr)
+            return True
+        return False
+
+    # The SoA backend can price its snapshot without materializing it —
+    # reject an over-cap replay BEFORE copying ~GBs under its lock.
+    estimate = getattr(replay, "approx_snapshot_nbytes", None)
+    if estimate is not None and over_cap(estimate()):
+        return None
+    snap = replay.snapshot()
+    payload = snap.get("items", snap.get("stacked"))  # list vs SoA backend
+    nbytes = sum(
+        x.nbytes for x in _iter_array_leaves(payload)
+    ) + snap["priorities"].nbytes
+    if over_cap(nbytes):
         return None
     return pickle.dumps(snap, protocol=pickle.HIGHEST_PROTOCOL)
 

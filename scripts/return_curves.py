@@ -1,0 +1,161 @@
+"""Long-horizon return-curve artifacts (VERDICT r2 item 6).
+
+Runs each algorithm family for >=2k updates and commits the per-episode
+return curves as JSONL under benchmarks/curves/, with a summary table.
+The reference's de-facto verification is TensorBoard score curves
+(`/root/reference/train_impala.py:109-113,170-172`); these files are the
+committed equivalent (the reference gitignores its runs/, so no curve of
+its own exists to diff against — BASELINE.md's targets stand in).
+
+Usage:
+    python scripts/return_curves.py [--families a,b,...] [--updates-scale 1.0]
+
+Writes one JSONL per family: first line = meta (config, updates, wall
+seconds, summary stats), then {"episode": i, "return": r} lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+OUT_DIR = os.path.join("benchmarks", "curves")
+
+
+def _summary(returns: list[float]) -> dict:
+    r = np.asarray(returns, np.float64)
+    if r.size == 0:
+        return {"episodes": 0}
+    win = 20
+    best = max(
+        (float(r[i:i + win].mean()) for i in range(0, max(1, r.size - win), 10)),
+        default=float(r.mean()),
+    )
+    return {
+        "episodes": int(r.size),
+        "early20_mean": round(float(r[:win].mean()), 2),
+        "late20_mean": round(float(r[-win:].mean()), 2),
+        "best20_mean": round(best, 2),
+        "overall_mean": round(float(r.mean()), 2),
+    }
+
+
+def _write_curve(name: str, meta: dict, returns: list[float]) -> dict:
+    os.makedirs(OUT_DIR, exist_ok=True)
+    meta = {**meta, **_summary(returns)}
+    path = os.path.join(OUT_DIR, f"{name}.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"meta": meta}) + "\n")
+        for i, r in enumerate(returns):
+            f.write(json.dumps({"episode": i, "return": round(float(r), 2)}) + "\n")
+    print(f"[curves] {name}: {meta}", file=sys.stderr)
+    return meta
+
+
+def _config_family(section: str, updates: int, seed: int = 0, **rt_overrides):
+    """A family driven through the config path (build_local + run_sync)."""
+    from distributed_reinforcement_learning_tpu.runtime.launch import build_local
+    from distributed_reinforcement_learning_tpu.utils.config import load_config
+
+    agent_cfg, rt = load_config("config.json", section)
+    if rt_overrides:
+        rt = dataclasses.replace(rt, **rt_overrides)
+    learner, actors, run_fn = build_local(agent_cfg, rt, seed=seed)
+    t0 = time.time()
+    result = run_fn(learner, actors, updates)
+    wall = time.time() - t0
+    return {
+        "section": section,
+        "updates": updates,
+        "seed": seed,
+        "overrides": {k: str(v) for k, v in rt_overrides.items()},
+        "wall_s": round(wall, 1),
+    }, result["episode_returns"]
+
+
+def run_apex_cartpole(updates: int, seed: int = 0):
+    """Ape-X on CartPole (no config section exists for it; built direct,
+    mirroring the e2e test's known-learning hyperparameters)."""
+    from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexConfig
+    from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+    from distributed_reinforcement_learning_tpu.envs.cartpole import VectorCartPole
+    from distributed_reinforcement_learning_tpu.runtime import apex_runner
+    from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+    cfg = ApexConfig(obs_shape=(4,), num_actions=2, start_learning_rate=1e-3,
+                     reward_clipping="abs_one")
+    agent = ApexAgent(cfg)
+    queue = TrajectoryQueue(capacity=64)
+    weights = WeightStore()
+    learner = apex_runner.ApexLearner(
+        agent, queue, weights, batch_size=32, replay_capacity=10_000,
+        target_sync_interval=25, rng=jax.random.PRNGKey(seed))
+    env = VectorCartPole(num_envs=8, seed=seed)
+    actor = apex_runner.ApexActor(
+        agent, env, queue, weights, seed=seed + 1, unroll_size=32,
+        local_capacity=5_000)
+    t0 = time.time()
+    result = apex_runner.run_sync(learner, [actor], num_updates=updates)
+    return {
+        "section": "apex_cartpole(direct)",
+        "updates": updates,
+        "seed": seed,
+        "wall_s": round(time.time() - t0, 1),
+    }, result["episode_returns"]
+
+
+FAMILIES = {
+    # The five families on CartPole (>=2k updates each).
+    "impala_cartpole": lambda s: _config_family("impala_cartpole", int(2500 * s)),
+    "apex_cartpole": lambda s: run_apex_cartpole(int(2500 * s)),
+    "r2d2_cartpole_pomdp": lambda s: _config_family("r2d2", int(2000 * s)),
+    "xformer_cartpole_pomdp": lambda s: _config_family("xformer", int(2000 * s)),
+    "ximpala_cartpole": lambda s: _config_family("ximpala", int(2000 * s)),
+    # IMPALA/Ape-X on the Breakout simulator (conv path; batch reduced so
+    # 2k updates fit a 1-core CPU host — the curve's shape is the point).
+    "impala_breakout_sim": lambda s: _config_family(
+        "impala", int(2000 * s), batch_size=8, num_actors=1, queue_size=64),
+    "apex_breakout_sim": lambda s: _config_family(
+        "apex", int(2000 * s), batch_size=8, num_actors=1, queue_size=64),
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--families", default=",".join(FAMILIES))
+    p.add_argument("--updates-scale", type=float, default=1.0,
+                   help="scale every family's update count (smoke: 0.01)")
+    args = p.parse_args()
+
+    summaries = {}
+    for name in args.families.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            meta, returns = FAMILIES[name](args.updates_scale)
+            summaries[name] = _write_curve(name, meta, returns)
+        except Exception as e:  # noqa: BLE001 — one family must not sink the rest
+            summaries[name] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[curves] {name} FAILED: {e}", file=sys.stderr)
+    with open(os.path.join(OUT_DIR, "summary.json"), "w") as f:
+        json.dump(summaries, f, indent=2)
+    print(json.dumps(summaries))
+
+
+if __name__ == "__main__":
+    main()

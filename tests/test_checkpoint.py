@@ -189,14 +189,14 @@ def test_orphan_sidecar_swept_on_startup(tmp_path):
     assert (tmp_path / "ckpt_0000000001.extra.json").exists()
 
 
-@pytest.mark.parametrize("backend", ["python", "native"])
+@pytest.mark.parametrize("backend", ["python", "native", "array"])
 def test_replay_snapshot_roundtrip(backend):
     """snapshot() -> restore() preserves contents, priorities, and beta
-    on both replay implementations."""
+    on every replay implementation."""
     from distributed_reinforcement_learning_tpu.data.native import native_available
     from distributed_reinforcement_learning_tpu.data.replay import make_replay
 
-    if backend == "native" and not native_available():
+    if backend in ("native", "array") and not native_available():
         pytest.skip("native sumtree not built")
     replay = make_replay(64, backend=backend)
     rng = np.random.default_rng(0)
@@ -213,10 +213,36 @@ def test_replay_snapshot_roundtrip(backend):
     assert len(restored) == len(replay) == 40
     assert restored.beta == replay.beta
     np.testing.assert_allclose(restored.tree.total, replay.tree.total, rtol=1e-12)
+    from distributed_reinforcement_learning_tpu.data.replay import _snapshot_items
+
     r_snap = restored.snapshot()
     np.testing.assert_allclose(r_snap["priorities"], snap["priorities"])
-    for a, b in zip(r_snap["items"], snap["items"]):
+    for a, b in zip(_snapshot_items(r_snap), _snapshot_items(snap)):
         np.testing.assert_array_equal(a["x"], b["x"])
+
+
+def test_array_snapshot_restores_into_list_backends():
+    """A checkpoint written by the SoA (auto-default) backend must restore
+    on a host WITHOUT the native library — i.e. into the pure-Python
+    backend — via _snapshot_items' stacked reslicing."""
+    from distributed_reinforcement_learning_tpu.data.native import native_available
+    from distributed_reinforcement_learning_tpu.data.replay import (
+        PrioritizedReplay, make_replay)
+
+    if not native_available():
+        pytest.skip("native sumtree not built")
+    arr = make_replay(32, backend="array")
+    errors = np.arange(1.0, 11.0)
+    items = [{"x": np.full(3, i, np.float32)} for i in range(10)]
+    arr.add_batch(errors, items)
+    snap = arr.snapshot()
+
+    restored = PrioritizedReplay(32)
+    restored.restore(snap)
+    assert len(restored) == 10
+    np.testing.assert_allclose(restored.tree.total, arr.tree.total, rtol=1e-12)
+    got, _, _ = restored.sample(4, np.random.RandomState(0))
+    assert all(g["x"].shape == (3,) for g in got)
 
 
 def test_replay_snapshot_disabled_by_env(tmp_path, monkeypatch):

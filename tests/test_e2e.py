@@ -135,10 +135,12 @@ def test_apex_ingest_many_matches_per_unroll():
     assert b.ingest_many(max_unrolls=4, timeout=0.0) == 4
     assert a.ingested_unrolls == b.ingested_unrolls == 4
     assert len(a.replay) == len(b.replay) == 128
+    from distributed_reinforcement_learning_tpu.data.replay import _snapshot_items
+
     snap_a, snap_b = a.replay.snapshot(), b.replay.snapshot()
     np.testing.assert_allclose(snap_a["priorities"], snap_b["priorities"],
                                rtol=1e-6)
-    for ia, ib in zip(snap_a["items"], snap_b["items"]):
+    for ia, ib in zip(_snapshot_items(snap_a), _snapshot_items(snap_b)):
         np.testing.assert_array_equal(ia.state, ib.state)
         np.testing.assert_array_equal(ia.action, ib.action)
 
