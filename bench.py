@@ -337,9 +337,18 @@ def bench_e2e(cfg, B: int, updates: int, feeders: int = 3,
         learner.timer.reset()  # stage means must exclude the compile step
         t0 = time.perf_counter()
         done = 0
+        last_m = None
         while done < updates:
-            if learner.step(timeout=120.0) is not None:
+            m = learner.step(timeout=120.0)
+            if m is not None:
                 done += 1
+                last_m = m
+        # Completion barrier: with async publication+metrics nothing else
+        # syncs the host loop to the device, so the window would count
+        # DISPATCHED updates. Materializing the last step's metric forces
+        # it (and, by program order, every prior step) to finish.
+        if last_m:
+            float(next(iter(last_m.values())))
         dt = time.perf_counter() - t0
     finally:
         stop.set()

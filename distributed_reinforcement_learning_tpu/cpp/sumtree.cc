@@ -82,6 +82,16 @@ double st_leaf_priority(void* h, int64_t tree_idx) {
   return t->tree[static_cast<size_t>(tree_idx)];
 }
 
+// Copy the leaf priorities of slots [start, start+n) in one call — the
+// checkpoint-snapshot read path (one FFI call for the whole ring instead
+// of count individual st_leaf_priority calls under the Python lock).
+void st_leaf_priorities(void* h, int64_t start, int64_t n, double* out) {
+  auto* t = static_cast<SumTree*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = t->tree[static_cast<size_t>(start + i) + t->capacity - 1];
+}
+
 // Append n priorities at the ring-write cursor; out_data_idx[i] receives
 // the leaf slot each landed in (tree idx = slot + capacity - 1).
 void st_add_batch(void* h, const double* priorities, int64_t n,

@@ -106,6 +106,8 @@ def _load():
             "st_total": ([ctypes.c_void_p], ctypes.c_double),
             "st_size": ([ctypes.c_void_p], ctypes.c_int64),
             "st_leaf_priority": ([ctypes.c_void_p, ctypes.c_int64], ctypes.c_double),
+            "st_leaf_priorities": (
+                [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, f64p], None),
             "st_add_batch": ([ctypes.c_void_p, f64p, ctypes.c_int64, i64p], None),
             "st_update_batch": ([ctypes.c_void_p, i64p, f64p, ctypes.c_int64], None),
             "st_get_batch": ([ctypes.c_void_p, f64p, ctypes.c_int64, i64p, f64p], None),
@@ -450,6 +452,13 @@ class NativeSumTree:
 
     def leaf_priority(self, tree_idx: int) -> float:
         return float(self._lib.st_leaf_priority(self._h, tree_idx))
+
+    def leaf_priorities(self, start: int, n: int) -> np.ndarray:
+        """Priorities of data slots [start, start+n) in ONE native call."""
+        out = np.empty(n, np.float64)
+        self._lib.st_leaf_priorities(
+            self._h, start, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return out
 
     def add_batch(self, priorities: np.ndarray) -> np.ndarray:
         """Returns the data slots written (tree idx = slot + capacity - 1)."""

@@ -280,12 +280,8 @@ class NativePrioritizedReplay:
         """Same contract as `PrioritizedReplay.snapshot` over the C++ tree."""
         with self._lock:
             n = len(self.tree)
-            cap = self.tree.capacity
-            priorities = np.array(
-                [self.tree.leaf_priority(slot + cap - 1) for slot in range(n)], np.float64
-            )
             return {
-                "priorities": priorities,
+                "priorities": self.tree.leaf_priorities(0, n),
                 "items": [self._data[i] for i in range(n)],
                 "beta": float(self.beta),
             }
@@ -418,27 +414,19 @@ class ArrayPrioritizedReplay:
 
         with self._lock:
             n = len(self.tree)
-            cap = self.tree.capacity
-            priorities = np.array(
-                [self.tree.leaf_priority(slot + cap - 1) for slot in range(n)],
-                np.float64)
             stacked = (None if self._store is None else
                        jax.tree.map(lambda store: store[:n].copy(), self._store))
-            return {"priorities": priorities, "stacked": stacked,
-                    "beta": float(self.beta)}
+            return {"priorities": self.tree.leaf_priorities(0, n),
+                    "stacked": stacked, "beta": float(self.beta)}
 
     def restore(self, snap: dict) -> None:
-        import jax
+        from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
 
+        batch = snap.get("stacked")
+        if batch is None and snap.get("items"):  # list-backend snapshot
+            batch = stack_pytrees(snap["items"])
         with self._lock:
-            if "stacked" in snap and snap["stacked"] is not None:
-                self._ensure_store(snap["stacked"])
-                slots = self.tree.add_batch(np.asarray(snap["priorities"], np.float64))
-                self._write(slots, snap["stacked"])
-            elif snap.get("items"):  # a list-backend snapshot restores too
-                from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
-
-                batch = stack_pytrees(snap["items"])
+            if batch is not None:
                 self._ensure_store(batch)
                 slots = self.tree.add_batch(np.asarray(snap["priorities"], np.float64))
                 self._write(slots, batch)

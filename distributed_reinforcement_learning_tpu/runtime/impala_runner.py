@@ -189,6 +189,7 @@ class ImpalaLearner(PublishCadenceMixin):
         self.frames_learned = 0
         self.timer = StageTimer(self.logger)
         self._profiler = ProfilerSession.from_env()
+        self._metrics_pump = None  # lazy: free-running async-metrics path
         weights.publish(self.state.params, 0)
 
     def save_checkpoint(self, ckpt) -> None:
@@ -228,13 +229,25 @@ class ImpalaLearner(PublishCadenceMixin):
         if self.maybe_publish():
             # Sync publish is this step's device sync (so "learn" above
             # measured dispatch, "publish" compute+D2H, and the float()
-            # after it is free). With DRL_ASYNC_PUBLISH the publish only
-            # enqueues a device copy, so the float() below becomes the
-            # sync — give it its own stage so the wait is attributed.
-            with self.timer.stage("metrics_sync"):
-                metrics = {k: float(v) for k, v in metrics.items()}
-            self.logger.add_scalars(
-                {f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
+            # after it is free). With async publication the float() here
+            # would become the learn thread's only device sync — so the
+            # free-running path hands the DEVICE arrays to the bounded
+            # MetricsPump instead (the pump's depth still caps how far
+            # ahead the host loop can dispatch). Sync loops keep the
+            # blocking float: it doubles as their pipelining bound.
+            from distributed_reinforcement_learning_tpu.runtime.publishing import (
+                MetricsPump, _async_metrics)
+
+            if _async_metrics(self.sync_publish):
+                if self._metrics_pump is None:
+                    self._metrics_pump = MetricsPump(self.logger)
+                with self.timer.stage("metrics_sync"):
+                    self._metrics_pump.submit(dict(metrics), self.train_steps)
+            else:
+                with self.timer.stage("metrics_sync"):
+                    metrics = {k: float(v) for k, v in metrics.items()}
+                self.logger.add_scalars(
+                    {f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
         # Non-publish steps return the metrics as DEVICE arrays and log
         # nothing: forcing a float() here would block on the step and
         # defeat the whole point of the interval (letting K device steps
@@ -249,6 +262,8 @@ class ImpalaLearner(PublishCadenceMixin):
 
         Called by every run path (run_sync/run_async/run_role) on exit."""
         self.flush_publish()
+        if self._metrics_pump is not None:
+            self._metrics_pump.close()  # drain pending log lines
         if self._prefetcher is not None:
             self._prefetcher.close()
         self._profiler.close()

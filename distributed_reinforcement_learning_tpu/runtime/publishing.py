@@ -10,6 +10,8 @@ host class provides `weights`, `state`, `train_steps`,
 from __future__ import annotations
 
 import os
+import queue as _queue
+import threading
 
 
 def _async_publish(sync_default: bool) -> bool:
@@ -24,6 +26,74 @@ def _async_publish(sync_default: bool) -> bool:
     if env is not None:
         return env.strip().lower() not in ("0", "false", "no", "off", "")
     return not sync_default
+
+
+class MetricsPump:
+    """Background metrics materialization for free-running learners.
+
+    With async publication, the publish-step `float(metric)` becomes the
+    learn thread's only device sync — on a thin-pipe host that is a
+    hundreds-of-ms stall per publish for numbers only a logger reads.
+    The pump takes the DEVICE arrays off the learn thread and floats +
+    logs them on a worker. Bounded: at most `depth` batches pending —
+    past that submit() blocks, which also caps how far ahead the host
+    loop can dispatch device steps.
+    """
+
+    def __init__(self, logger, prefix: str = "learner/", depth: int = 4):
+        self._logger = logger
+        self._prefix = prefix
+        self._q: _queue.Queue = _queue.Queue(maxsize=depth)
+        self._thread: threading.Thread | None = None
+
+    def submit(self, metrics: dict, step: int) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="metrics-pump")
+            self._thread.start()
+        self._q.put((metrics, step))
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            metrics, step = item
+            try:
+                floats = {k: float(v) for k, v in metrics.items()}
+                self._logger.add_scalars(
+                    {f"{self._prefix}{k}": v for k, v in floats.items()}, step)
+            except Exception as e:  # noqa: BLE001 — logging must not kill training
+                import sys
+
+                print(f"[metrics] WARNING: drop step {step}: {e!r}", file=sys.stderr)
+
+    def close(self) -> None:
+        if self._thread is not None:
+            try:
+                # Bounded: a worker wedged inside float(v) (stuck device
+                # sync) with a full queue must not hang shutdown forever.
+                self._q.put(None, timeout=10.0)
+            except _queue.Full:
+                pass
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+
+def _async_metrics(sync_default: bool) -> bool:
+    """Follows the async-publish gate unless DRL_ASYNC_METRICS overrides.
+
+    Additionally defaults OFF on the CPU backend: there the "device"
+    compute shares the host cores, so a metrics worker thread contends
+    with the very compute it is trying not to block (measured slower on
+    a 1-core host); on TPU/GPU the compute is elsewhere and the float()
+    it absorbs is a pure stall."""
+    env = os.environ.get("DRL_ASYNC_METRICS")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
+    import jax
+
+    return jax.default_backend() not in ("cpu",) and _async_publish(sync_default)
 
 
 class PublishCadenceMixin:
