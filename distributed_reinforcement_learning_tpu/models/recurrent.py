@@ -9,7 +9,9 @@ timestep. Here:
   forget bias 1.0) and exposes `unroll` over a whole `[B, T]` sequence:
   the time-parallel input projection runs as one big MXU matmul, and the
   sequential recursion goes through `ops.lstm.lstm_scan` — a `lax.scan`
-  on CPU, a fused Pallas VMEM kernel on TPU (`ops/pallas/lstm.py`).
+  by default; the fused Pallas VMEM kernel (`ops/pallas/lstm.py`) is
+  opt-in via DRL_LSTM_PALLAS=1 (its measured margin over the scan is
+  not yet stable across artifacts — see ops/lstm.py).
 - Stored-state training (IMPALA) needs **no unroll at all**: each timestep
   is seeded from the actor-recorded (h, c), so the learner applies the cell
   to a flattened `[B*T]` batch in one shot (see `agents/impala.py`).

@@ -13,12 +13,18 @@ Python graph-building loops (`/root/reference/model/r2d2_lstm.py:65-112`,
 (reference backend, differentiable by autodiff) or a Pallas kernel pair
 (`ops/pallas/lstm.py`) that keeps the carries in VMEM across a
 time-gridded launch, wired up through `jax.custom_vjp` with a
-hand-derived BPTT backward kernel. Measured on v5e at R2D2-replay shape
-(T=20, B=256, H=256) with bench.py's on-device timing loop the fused
-pair is at parity-to-slightly-ahead of the scan (126us vs 147us fwd+bwd
-per call, run-to-run variance ~15%; artifact: BENCH_r02
-`kernel_compare`); it wins by keeping the per-step [B,H] carries out of
-HBM, and `auto` picks it on TPU.
+hand-derived BPTT backward kernel.
+
+The kernel is OPT-IN (`DRL_LSTM_PALLAS=1`, or backend="pallas"), not
+auto: round-2's two committed v5e artifacts disagree on it — run 1
+measured pallas 128.0us vs scan 166.6us (kernel ahead), run 2 pallas
+149.6us vs scan 141.7us (kernel behind) — a spread inside the tunnel's
+noise floor, so the "fused pair wins" claim did not survive its own
+second measurement (VERDICT r2 "what's weak" #1; artifacts:
+benchmarks/r02_v5e_single_chip*.json `kernel_compare`). `auto`
+therefore resolves to the XLA scan until a stable two-artifact margin
+re-establishes the kernel; the V-trace kernel keeps its auto-enable
+(its ~4x margin was consistent across artifacts).
 
 Gate math (TF1 `LSTMCell` parity, forget bias 1.0):
 
@@ -77,7 +83,7 @@ def lstm_scan(
     backend: str = "auto",
 ):
     """Run the recursion; see module docstring for shapes/semantics."""
-    backend = resolve_backend(backend)
+    backend = resolve_backend(backend, opt_in_env="DRL_LSTM_PALLAS")
     xg_tm = jnp.swapaxes(xg, 0, 1)  # [T, B, 4H]
     keep_tm = jnp.swapaxes(keep, 0, 1).astype(xg.dtype)  # [T, B]
     if backend == "reference":

@@ -55,10 +55,18 @@ def pick_block(
     return b if b < block or b % block != 0 else block
 
 
-def resolve_backend(backend: str = "auto") -> str:
-    """-> 'pallas' | 'pallas_interpret' | 'reference'."""
+def resolve_backend(backend: str = "auto", opt_in_env: str | None = None) -> str:
+    """-> 'pallas' | 'pallas_interpret' | 'reference'.
+
+    `opt_in_env`: name of an env var that must be "1" for `auto` to pick
+    the kernel — used by ops whose measured advantage is not (or not
+    yet) established, e.g. the fused LSTM (DRL_LSTM_PALLAS). Ops with a
+    stable margin (V-trace) pass None and auto-enable on TPU.
+    """
     if backend == "auto":
         if os.environ.get("DRL_TPU_PALLAS", "1") == "0":
+            return "reference"
+        if opt_in_env is not None and os.environ.get(opt_in_env, "0") != "1":
             return "reference"
         return "pallas" if jax.default_backend() == "tpu" else "reference"
     if backend not in ("pallas", "pallas_interpret", "reference"):

@@ -27,6 +27,28 @@ def test_resolve_backend():
         resolve_backend("cuda")
 
 
+def test_resolve_backend_opt_in_env(monkeypatch):
+    """Ops without an established margin (the fused LSTM) stay demoted on
+    TPU under `auto` unless their opt-in env var is set; an explicit
+    backend always wins. Simulated-TPU so the gate is observable."""
+    import distributed_reinforcement_learning_tpu.ops.pallas as pallas_pkg
+
+    monkeypatch.setattr(pallas_pkg.jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("DRL_LSTM_PALLAS", raising=False)
+    # Established ops (no opt_in_env) auto-enable on TPU...
+    assert resolve_backend("auto") == "pallas"
+    # ...opt-in ops do not, until their env var says so.
+    assert resolve_backend("auto", opt_in_env="DRL_LSTM_PALLAS") == "reference"
+    monkeypatch.setenv("DRL_LSTM_PALLAS", "1")
+    assert resolve_backend("auto", opt_in_env="DRL_LSTM_PALLAS") == "pallas"
+    # Explicit selection bypasses the gate entirely.
+    monkeypatch.delenv("DRL_LSTM_PALLAS")
+    assert resolve_backend("pallas", opt_in_env="DRL_LSTM_PALLAS") == "pallas"
+    # The global kill switch still dominates.
+    monkeypatch.setenv("DRL_TPU_PALLAS", "0")
+    assert resolve_backend("auto") == "reference"
+
+
 @pytest.mark.parametrize("T,B", [(18, 32), (10, 16), (5, 256), (20, 384)])
 def test_vtrace_kernel_matches_scan(T, B):
     rng = np.random.RandomState(0)
