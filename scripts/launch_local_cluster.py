@@ -1,0 +1,158 @@
+"""Launch the reference topology (1+ learners + N actors) in one command.
+
+The reference README has the operator run N+1 shell commands by hand
+(`/root/reference/README.md:26-55`, one per `--job_name/--task`). This
+helper spawns the same topology as subprocesses of one command, prefixes
+their output, and tears everything down on Ctrl-C or learner exit.
+
+    python scripts/launch_local_cluster.py --section impala_cartpole \
+        --actors 2 --updates 500 [--learners 2] [--serve_inference ...]
+
+With --learners K > 1 the learner processes join one jax.distributed
+runtime (coordinator on localhost) and jointly pjit the learn step over
+the global mesh; actors are partitioned round-robin across the learners'
+data planes via DRL_LEARNER_INDEX. This is exactly the topology
+tests/test_multihost.py::test_socket_topology_two_learners_with_restart
+exercises.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ALGO_LAUNCHER = {
+    "impala": "train_impala.py", "apex": "train_apex.py", "r2d2": "train_r2d2.py",
+    "xformer": "train_xformer.py", "ximpala": "train_ximpala.py",
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _pump(prefix: str, proc: subprocess.Popen) -> None:
+    for line in proc.stdout:  # type: ignore[union-attr]
+        sys.stdout.write(f"[{prefix}] {line}")
+        sys.stdout.flush()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default=os.path.join(REPO, "config.json"))
+    p.add_argument("--section", default="impala_cartpole")
+    p.add_argument("--algo", default=None,
+                   help="algorithm (default: section-name prefix)")
+    p.add_argument("--actors", type=int, default=2)
+    p.add_argument("--learners", type=int, default=1,
+                   help=">1: multihost learner processes over one global mesh")
+    p.add_argument("--updates", type=int, default=500)
+    p.add_argument("--checkpoint_dir", default=None)
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform for the LEARNER (actors are cpu)")
+    p.add_argument("--serve_inference", action="store_true")
+    p.add_argument("--remote_act", action="store_true")
+    args = p.parse_args()
+
+    algo = args.algo or args.section.split("_")[0]
+    if algo not in ALGO_LAUNCHER:
+        p.error(f"unknown algorithm {algo!r} (from --section/--algo); "
+                f"one of {sorted(ALGO_LAUNCHER)}")
+    if args.remote_act and not args.serve_inference:
+        # Actors would fail fast with InferenceUnavailableError while the
+        # learner idles on an empty queue forever.
+        p.error("--remote_act needs the learner to serve inference; "
+                "pass --serve_inference too")
+    launcher = os.path.join(REPO, ALGO_LAUNCHER[algo])
+    procs: list[subprocess.Popen] = []
+    pumps: list[threading.Thread] = []
+
+    def spawn(name: str, cmd: list[str], env: dict) -> subprocess.Popen:
+        proc = subprocess.Popen(
+            cmd, cwd=REPO, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        t = threading.Thread(target=_pump, args=(name, proc), daemon=True)
+        t.start()
+        procs.append(proc)
+        pumps.append(t)
+        return proc
+
+    base = [sys.executable, launcher, "--config", args.config,
+            "--section", args.section]
+    learner_cmd = base + ["--mode", "learner", "--updates", str(args.updates)]
+    if args.checkpoint_dir:
+        learner_cmd += ["--checkpoint_dir", args.checkpoint_dir]
+    if args.platform:
+        learner_cmd += ["--platform", args.platform]
+    if args.serve_inference:
+        learner_cmd += ["--serve_inference"]
+
+    env = dict(os.environ)
+    learners = []
+    if args.learners > 1:
+        env["DRL_COORDINATOR"] = f"localhost:{_free_port()}"
+        env["DRL_NUM_PROCESSES"] = str(args.learners)
+        for pid in range(args.learners):
+            learners.append(spawn(
+                f"learner{pid}", learner_cmd,
+                {**env, "DRL_PROCESS_ID": str(pid)}))
+    else:
+        learners.append(spawn("learner", learner_cmd, env))
+
+    for task in range(args.actors):
+        actor_cmd = base + ["--mode", "actor", "--task", str(task)]
+        if args.remote_act:
+            actor_cmd += ["--remote_act"]
+        spawn(f"actor{task}",
+              actor_cmd, {**env, "DRL_LEARNER_INDEX": str(task % args.learners)})
+
+    def shutdown(*_):
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+    actors = [proc for proc in procs if proc not in learners]
+    rc = 0
+    # Wait on the whole topology: learners finishing is the normal end,
+    # but every actor dying while the learner idles (e.g. misconfigured
+    # envs) must also tear the run down rather than hang forever.
+    while any(proc.poll() is None for proc in learners):
+        if actors and all(proc.poll() is not None for proc in actors):
+            print("[cluster] all actors exited; shutting down", file=sys.stderr)
+            rc = 1
+            break
+        try:
+            signal.sigtimedwait([signal.SIGCHLD], 1.0)
+        except (AttributeError, InterruptedError):
+            import time
+
+            time.sleep(1.0)
+    for proc in learners:
+        code = proc.poll()
+        if code is None:
+            continue
+        # A signal-killed learner (negative returncode) is a failure,
+        # not exit 0: map to the shell's 128+sig convention.
+        rc = max(rc, 128 - code if code < 0 else code)
+    shutdown()  # bring everything down
+    for proc in procs:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
