@@ -403,7 +403,14 @@ def bench_stage_budget(cfg, B: int, learn_fps: float | None) -> dict:
     target = 50_000.0
     one = jax.tree.map(lambda x: x[0], _make_batch(cfg, 1))
     blob = bytes(codec.encode(one))
-    out: dict = {"B": B, "target_frames_per_s": target}
+    out: dict = {
+        "B": B,
+        "target_frames_per_s": target,
+        "note": ("encode/shm_put/tcp_put/gather are host-only (framework-"
+                 "owned); h2d and publish traverse the host<->device link — "
+                 "on a tunneled chip those rows price the tunnel, not the "
+                 "framework (co-located DMA is orders faster)"),
+    }
 
     def med(fn, n, reps=5):
         ts = []

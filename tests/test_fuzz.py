@@ -98,3 +98,42 @@ class TestMoEDispatchFuzz:
         assert np.all(combine[dispatch == 0.0] == 0.0)
         # Aux is finite and >= ~1 (its minimum at perfect balance).
         assert np.isfinite(float(aux)) and float(aux) > 0.5
+
+
+class TestBatchedPutFraming:
+    """OP_PUT_TRAJ_N wire framing (runtime/transport.pack_batch /
+    unpack_batch): any blob count/sizes must round-trip byte-exact, and
+    corrupt payload lengths must raise, not mis-slice."""
+
+    @given(st.lists(st.binary(min_size=0, max_size=2048), min_size=0, max_size=20))
+    @settings(max_examples=60, deadline=None)
+    def test_pack_unpack_roundtrip(self, blobs):
+        from distributed_reinforcement_learning_tpu.runtime.transport import (
+            pack_batch, unpack_batch)
+
+        parts = pack_batch(blobs)
+        payload = b"".join(bytes(p) for p in parts)
+        out = unpack_batch(payload)
+        assert len(out) == len(blobs)
+        for got, want in zip(out, blobs):
+            assert bytes(got) == want
+
+    @given(st.lists(st.binary(min_size=1, max_size=64), min_size=1, max_size=5),
+           st.integers(1, 16))
+    @settings(max_examples=40, deadline=None)
+    def test_truncated_payload_raises(self, blobs, cut):
+        import pytest as _pytest
+
+        from distributed_reinforcement_learning_tpu.runtime.transport import (
+            pack_batch, unpack_batch)
+
+        import struct
+
+        payload = b"".join(bytes(p) for p in pack_batch(blobs))
+        cut = min(cut, len(payload) - 1)
+        bad = payload[:-cut]
+        # The framing contract: truncation surfaces as struct.error (the
+        # u32 header reads) or ValueError (the offset-vs-length check) —
+        # never as a silent short read, and never as some other crash.
+        with _pytest.raises((struct.error, ValueError)):
+            unpack_batch(bad)
