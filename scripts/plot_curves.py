@@ -1,0 +1,101 @@
+"""Render benchmarks/curves/*.jsonl to one committed SVG (small multiples).
+
+Design follows the dataviz method: change-over-time -> line form; one
+family per panel (single series, so the panel title carries identity and
+no legend is needed); each family keeps its fixed categorical hue from
+the skill's pre-validated reference palette (light mode, documented slot
+order — the palette ships validated; node isn't in this image to re-run
+the validator, and no new colors are introduced); 2px rolling-mean line
+over a light same-hue raw trace; recessive grid; text in neutral ink.
+CartPole panels share one y-scale (0-210, cap 200); the Breakout-sim
+panels carry their own labeled scale.
+
+    python scripts/plot_curves.py   # writes benchmarks/curves/curves.svg
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+CURVES = os.path.join("benchmarks", "curves")
+
+# (file stem, panel title, validated categorical slot — fixed per family)
+PANELS = [
+    ("impala_cartpole", "IMPALA — CartPole", "#2a78d6"),
+    ("apex_cartpole", "Ape-X — CartPole", "#eb6834"),
+    ("r2d2_cartpole_pomdp", "R2D2 — CartPole POMDP", "#1baf7a"),
+    ("xformer_cartpole_pomdp", "Transformer-R2D2 — CartPole POMDP", "#eda100"),
+    ("ximpala_cartpole", "Transformer-IMPALA — CartPole", "#e87ba4"),
+    ("impala_breakout_sim", "IMPALA — Breakout-sim (pixels)", "#008300"),
+    ("apex_breakout_sim", "Ape-X — Breakout-sim (pixels)", "#4a3aa7"),
+]
+
+INK = "#0b0b0b"
+INK2 = "#52514e"
+GRID = "#e4e3df"
+SURFACE = "#fcfcfb"
+
+
+def _rolling(x: np.ndarray, w: int = 50) -> np.ndarray:
+    if x.size < w:
+        return x
+    return np.convolve(x, np.ones(w) / w, mode="valid")
+
+
+def _downsample(y: np.ndarray, max_pts: int = 1500):
+    if y.size <= max_pts:
+        return np.arange(y.size), y
+    idx = np.linspace(0, y.size - 1, max_pts).astype(int)
+    return idx, y[idx]
+
+
+def main() -> None:
+    fig, axes = plt.subplots(2, 4, figsize=(16, 6.5), facecolor=SURFACE)
+    axes = axes.ravel()
+    for ax in axes[len(PANELS):]:
+        ax.set_visible(False)
+
+    for ax, (stem, title, color) in zip(axes, PANELS):
+        rows = [json.loads(l) for l in open(os.path.join(CURVES, f"{stem}.jsonl"))]
+        rets = np.array([r["return"] for r in rows[1:]], float)
+        ax.set_facecolor(SURFACE)
+        # Raw per-episode trace: same entity, lighter tint as context.
+        xi, yi = _downsample(rets)
+        ax.plot(xi, yi, color=color, alpha=0.18, linewidth=0.8)
+        roll = _rolling(rets)
+        xr, yr = _downsample(roll)
+        ax.plot(xr, yr, color=color, linewidth=2.0,
+                label="50-episode rolling mean")
+        cartpole = "cartpole" in stem
+        ax.set_ylim(0, 210 if cartpole else max(12, float(rets.max()) * 1.15))
+        if cartpole:
+            ax.axhline(200, color=GRID, linewidth=1.0, zorder=0)
+        ax.set_title(title, fontsize=10, color=INK, loc="left")
+        ax.tick_params(colors=INK2, labelsize=8)
+        ax.grid(True, color=GRID, linewidth=0.6)
+        ax.set_axisbelow(True)
+        for spine in ax.spines.values():
+            spine.set_color(GRID)
+        ax.set_xlabel("episode", fontsize=8, color=INK2)
+        ax.set_ylabel("return", fontsize=8, color=INK2)
+
+    fig.suptitle(
+        "Return curves — five families on CartPole (cap 200, random ≈ 20) "
+        "+ IMPALA/Ape-X on the Breakout simulator from pixels "
+        "(thin trace: per-episode; heavy line: 50-episode rolling mean)",
+        fontsize=11, color=INK, x=0.01, ha="left")
+    fig.tight_layout(rect=(0, 0, 1, 0.93))
+    out = os.path.join(CURVES, "curves.svg")
+    fig.savefig(out, format="svg", facecolor=SURFACE)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
