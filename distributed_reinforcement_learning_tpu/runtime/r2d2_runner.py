@@ -210,15 +210,38 @@ class R2D2Learner(PublishCadenceMixin):
         if not seqs:
             return 0
         with self.timer.stage("ingest_td"):
-            batch = stack_pytrees(seqs)
-            td = np.asarray(self.agent.td_error(self.state, batch))
+            # Pad the stack to the next power of two (capped at
+            # batch_size, so a non-power-of-two batch_size still tops
+            # out at its own full-drain shape): the drain count varies
+            # per call (1..batch_size), and each distinct count would
+            # otherwise compile its own td_error executable on TPU.
+            # Padding rows are copies of row 0; their TDs are computed
+            # and discarded, and per-sequence math is batch-independent
+            # so real rows' priorities are bit-identical — EXCEPT under
+            # MoE, where expert capacity scales with the total token
+            # count and padding would shift real tokens' overflow; MoE
+            # configs skip padding and accept the recompiles.
+            n = len(seqs)
+            if getattr(self.agent.cfg, "num_experts", 0):
+                k = n
+            else:
+                k = 1
+                while k < n:
+                    k *= 2
+                k = min(k, self.batch_size)
+                k = max(k, n)  # batch_size may not be a power of two
+            padded = seqs if k == n else seqs + [seqs[0]] * (k - n)
+            batch = stack_pytrees(padded)
+            td = np.asarray(self.agent.td_error(self.state, batch))[:n]
         with self.timer.stage("ingest_replay_add"):
             if getattr(self.replay, "stacked_samples", False):
+                if k > n:
+                    batch = jax.tree.map(lambda x: x[:n], batch)
                 self.replay.add_batch_stacked(td, batch)  # one slice-assign/field
             else:
                 self.replay.add_batch(td, seqs)
-        self.ingested_sequences += len(seqs)
-        return len(seqs)
+        self.ingested_sequences += n
+        return n
 
     def train(self) -> dict | None:
         """One prioritized train step over sequences (`train_r2d2.py:121-164`)."""
