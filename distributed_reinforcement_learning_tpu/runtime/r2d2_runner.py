@@ -228,8 +228,9 @@ class R2D2Learner(PublishCadenceMixin):
                 k = 1
                 while k < n:
                     k *= 2
+                # next_pow2(n) and batch_size are both >= n (the drain
+                # loop caps n at batch_size), so the cap never undershoots.
                 k = min(k, self.batch_size)
-                k = max(k, n)  # batch_size may not be a power of two
             padded = seqs if k == n else seqs + [seqs[0]] * (k - n)
             batch = stack_pytrees(padded)
             td = np.asarray(self.agent.td_error(self.state, batch))[:n]

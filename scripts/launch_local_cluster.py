@@ -151,6 +151,10 @@ def main() -> None:
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+    for t in pumps:
+        # Drain the relay threads: without the join, the children's final
+        # lines (e.g. the learner's "done: N updates") race sys.exit.
+        t.join(timeout=5.0)
     sys.exit(rc)
 
 

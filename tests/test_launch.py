@@ -59,3 +59,32 @@ class TestNeedsShardedLearner:
 
     def test_recurrent_families_never(self):
         assert not needs_sharded_learner("impala", ImpalaConfig(), _rt())
+
+
+def test_launch_local_cluster_smoke():
+    """The one-command topology helper: spawns a learner + 1 actor,
+    finishes the updates, exits 0, and tears everything down."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    import os
+    import signal
+
+    repo = Path(__file__).parent.parent
+    # Own process group: on timeout the WHOLE topology dies, not just the
+    # launcher (an orphaned learner would hold the port and the core).
+    proc = subprocess.Popen(
+        [sys.executable, str(repo / "scripts" / "launch_local_cluster.py"),
+         "--section", "impala_cartpole", "--actors", "1", "--updates", "6",
+         "--platform", "cpu"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=str(repo), start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.communicate(timeout=10)
+        raise
+    assert proc.returncode == 0, out[-2000:] + err[-500:]
+    assert "done: 6 updates" in out
