@@ -120,17 +120,23 @@ def run_apex_cartpole(updates: int, seed: int = 0):
 
 FAMILIES = {
     # The five families on CartPole (>=2k updates each).
-    "impala_cartpole": lambda s: _config_family("impala_cartpole", int(2500 * s)),
-    "apex_cartpole": lambda s: run_apex_cartpole(int(2500 * s)),
-    "r2d2_cartpole_pomdp": lambda s: _config_family("r2d2", int(2000 * s)),
-    "xformer_cartpole_pomdp": lambda s: _config_family("xformer", int(2000 * s)),
-    "ximpala_cartpole": lambda s: _config_family("ximpala", int(2000 * s)),
+    "impala_cartpole": lambda s, seed=0: _config_family(
+        "impala_cartpole", int(2500 * s), seed=seed),
+    "apex_cartpole": lambda s, seed=0: run_apex_cartpole(int(2500 * s), seed=seed),
+    "r2d2_cartpole_pomdp": lambda s, seed=0: _config_family(
+        "r2d2", int(2000 * s), seed=seed),
+    "xformer_cartpole_pomdp": lambda s, seed=0: _config_family(
+        "xformer", int(2000 * s), seed=seed),
+    "ximpala_cartpole": lambda s, seed=0: _config_family(
+        "ximpala", int(2000 * s), seed=seed),
     # IMPALA/Ape-X on the Breakout simulator (conv path; batch reduced so
     # 2k updates fit a 1-core CPU host — the curve's shape is the point).
-    "impala_breakout_sim": lambda s: _config_family(
-        "impala", int(2000 * s), batch_size=8, num_actors=1, queue_size=64),
-    "apex_breakout_sim": lambda s: _config_family(
-        "apex", int(2000 * s), batch_size=8, num_actors=1, queue_size=64),
+    "impala_breakout_sim": lambda s, seed=0: _config_family(
+        "impala", int(2000 * s), seed=seed,
+        batch_size=8, num_actors=1, queue_size=64),
+    "apex_breakout_sim": lambda s, seed=0: _config_family(
+        "apex", int(2000 * s), seed=seed,
+        batch_size=8, num_actors=1, queue_size=64),
 }
 
 
@@ -139,6 +145,8 @@ def main() -> None:
     p.add_argument("--families", default=",".join(FAMILIES))
     p.add_argument("--updates-scale", type=float, default=1.0,
                    help="scale every family's update count (smoke: 0.01)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="seed != 0 writes <family>_seed<k>.jsonl")
     args = p.parse_args()
 
     summaries = {}
@@ -146,14 +154,23 @@ def main() -> None:
         name = name.strip()
         if not name:
             continue
+        out_name = name if args.seed == 0 else f"{name}_seed{args.seed}"
         try:
-            meta, returns = FAMILIES[name](args.updates_scale)
-            summaries[name] = _write_curve(name, meta, returns)
+            meta, returns = FAMILIES[name](args.updates_scale, seed=args.seed)
+            summaries[out_name] = _write_curve(out_name, meta, returns)
         except Exception as e:  # noqa: BLE001 — one family must not sink the rest
             summaries[name] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[curves] {name} FAILED: {e}", file=sys.stderr)
-    with open(os.path.join(OUT_DIR, "summary.json"), "w") as f:
-        json.dump(summaries, f, indent=2)
+    # Merge into the existing summary: a partial (one-family / alt-seed)
+    # run must not clobber the full table.
+    path = os.path.join(OUT_DIR, "summary.json")
+    merged = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            merged = json.load(f)
+    merged.update(summaries)
+    with open(path, "w") as f:
+        json.dump(merged, f, indent=2)
     print(json.dumps(summaries))
 
 
