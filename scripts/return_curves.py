@@ -149,28 +149,39 @@ def main() -> None:
                    help="seed != 0 writes <family>_seed<k>.jsonl")
     args = p.parse_args()
 
+    smoke = args.updates_scale != 1.0
     summaries = {}
     for name in args.families.split(","):
         name = name.strip()
         if not name:
             continue
         out_name = name if args.seed == 0 else f"{name}_seed{args.seed}"
+        if smoke:
+            # A scaled run is a smoke check: it must never overwrite the
+            # committed full-scale jsonl or summary entries.
+            out_name += "_smoke"
         try:
             meta, returns = FAMILIES[name](args.updates_scale, seed=args.seed)
             summaries[out_name] = _write_curve(out_name, meta, returns)
         except Exception as e:  # noqa: BLE001 — one family must not sink the rest
-            summaries[name] = {"error": f"{type(e).__name__}: {e}"}
-            print(f"[curves] {name} FAILED: {e}", file=sys.stderr)
-    # Merge into the existing summary: a partial (one-family / alt-seed)
-    # run must not clobber the full table.
-    path = os.path.join(OUT_DIR, "summary.json")
-    merged = {}
-    if os.path.exists(path):
-        with open(path) as f:
-            merged = json.load(f)
-    merged.update(summaries)
-    with open(path, "w") as f:
-        json.dump(merged, f, indent=2)
+            summaries[out_name] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[curves] {out_name} FAILED: {e}", file=sys.stderr)
+    if not smoke:
+        # Merge into the existing summary: a partial (one-family /
+        # alt-seed) run must not clobber the full table. Tolerate a
+        # corrupt existing file — hours of runs must not be lost to it.
+        path = os.path.join(OUT_DIR, "summary.json")
+        merged = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    merged = json.load(f)
+            except (json.JSONDecodeError, OSError) as e:
+                print(f"[curves] WARNING: existing summary unreadable ({e}); "
+                      f"rewriting with this run only", file=sys.stderr)
+        merged.update(summaries)
+        with open(path, "w") as f:
+            json.dump(merged, f, indent=2)
     print(json.dumps(summaries))
 
 
