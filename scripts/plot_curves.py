@@ -67,12 +67,29 @@ def main() -> None:
         rets = np.array([r["return"] for r in rows[1:]], float)
         ax.set_facecolor(SURFACE)
         # Raw per-episode trace: same entity, lighter tint as context.
+        # X is the fraction of the run: different seeds produce very
+        # different EPISODE counts for the same update budget (collapsed
+        # phases yield many short episodes), so a shared episode axis
+        # would squash one seed; run-fraction is the comparable clock.
         xi, yi = _downsample(rets)
-        ax.plot(xi, yi, color=color, alpha=0.18, linewidth=0.8)
+        ax.plot(xi / max(1, len(rets) - 1), yi, color=color, alpha=0.18,
+                linewidth=0.8)
         roll = _rolling(rets)
         xr, yr = _downsample(roll)
-        ax.plot(xr, yr, color=color, linewidth=2.0,
-                label="50-episode rolling mean")
+        ax.plot(xr / max(1, len(roll) - 1), yr, color=color, linewidth=2.0,
+                label="seed 0")
+        # Second seed, where committed: same entity, same hue, dashed —
+        # two series in a panel, so a legend appears on those panels.
+        seed1 = os.path.join(CURVES, f"{stem}_seed1.jsonl")
+        if os.path.exists(seed1):
+            rows1 = [json.loads(l) for l in open(seed1)]
+            rets1 = np.array([r["return"] for r in rows1[1:]], float)
+            roll1 = _rolling(rets1)
+            x1, y1 = _downsample(roll1)
+            ax.plot(x1 / max(1, len(roll1) - 1), y1, color=color,
+                    linewidth=1.6, linestyle=(0, (4, 2)),
+                    alpha=0.75, label="seed 1")
+            ax.legend(fontsize=7, frameon=False, labelcolor=INK2, loc="upper left")
         cartpole = "cartpole" in stem
         ax.set_ylim(0, 210 if cartpole else max(12, float(rets.max()) * 1.15))
         if cartpole:
@@ -83,13 +100,13 @@ def main() -> None:
         ax.set_axisbelow(True)
         for spine in ax.spines.values():
             spine.set_color(GRID)
-        ax.set_xlabel("episode", fontsize=8, color=INK2)
+        ax.set_xlabel("fraction of run", fontsize=8, color=INK2)
         ax.set_ylabel("return", fontsize=8, color=INK2)
 
     fig.suptitle(
         "Return curves — five families on CartPole (cap 200, random ≈ 20) "
         "+ IMPALA/Ape-X on the Breakout simulator from pixels "
-        "(thin trace: per-episode; heavy line: 50-episode rolling mean)",
+        "(x: fraction of run; thin trace: per-episode; heavy: 50-episode rolling mean)",
         fontsize=11, color=INK, x=0.01, ha="left")
     fig.tight_layout(rect=(0, 0, 1, 0.93))
     out = os.path.join(CURVES, "curves.svg")
