@@ -41,6 +41,9 @@ class ApexConfig:
     end_learning_rate: float = 0.0
     learning_frame: int = 100_000_000_000_000
     dtype: Any = jnp.float32
+    # Fold /255 into conv0's kernel; uint8 frames feed the model raw
+    # (see ImpalaConfig.fold_normalize / models.torso.NatureConv).
+    fold_normalize: bool = False
 
 
 class ApexBatch(NamedTuple):
@@ -60,7 +63,10 @@ class ApexAgent:
         if len(cfg.obs_shape) == 1:
             self.model = SimpleQNetwork(num_actions=cfg.num_actions, dtype=cfg.dtype)
         else:
-            self.model = DuelingQNetwork(num_actions=cfg.num_actions, dtype=cfg.dtype)
+            self.model = DuelingQNetwork(
+                num_actions=cfg.num_actions, dtype=cfg.dtype,
+                fold_normalize=cfg.fold_normalize,
+            )
         self._schedule = common.polynomial_lr(
             cfg.start_learning_rate, cfg.end_learning_rate, cfg.learning_frame
         )
@@ -68,6 +74,11 @@ class ApexAgent:
         self.act = jax.jit(self._act)
         self.td_error = jax.jit(self._td_error)
         self.learn = jax.jit(self._learn, donate_argnums=(0,))
+        # K prioritized steps per dispatch; priorities come back stacked
+        # [K, B] and land K-1 steps stale (common.scan_learn_weighted).
+        self.learn_many = jax.jit(
+            common.scan_learn_weighted(self._learn), donate_argnums=(0,)
+        )
         self.sync_target = jax.jit(lambda s: s.sync_target())
 
     def init_state(self, rng: jax.Array) -> common.TargetTrainState:
@@ -76,18 +87,28 @@ class ApexAgent:
         params = self.model.init(rng, obs, pa)
         return common.TargetTrainState.create(params, self.tx)
 
+    def _prep_obs(self, obs):
+        """Normalize frames — or pass integer frames raw under `fold_normalize`."""
+        if (
+            self.cfg.fold_normalize
+            and len(self.cfg.obs_shape) == 3
+            and jnp.issubdtype(obs.dtype, jnp.integer)
+        ):
+            return obs
+        return common.normalize_obs(obs, self.cfg.dtype)
+
     # -- act -------------------------------------------------------------
     def _act(self, params, obs, prev_action, epsilon, rng):
         """Batched epsilon-greedy: argmax Q with probability 1-eps."""
-        q = self.model.apply(params, common.normalize_obs(obs, self.cfg.dtype), prev_action)
+        q = self.model.apply(params, self._prep_obs(obs), prev_action)
         action = common.epsilon_greedy(q, epsilon, self.cfg.num_actions, rng)
         return action, q
 
     # -- shared target math ----------------------------------------------
     def _targets(self, params, target_params, batch: ApexBatch):
         cfg = self.cfg
-        obs = common.normalize_obs(batch.state, self.cfg.dtype)
-        next_obs = common.normalize_obs(batch.next_state, self.cfg.dtype)
+        obs = self._prep_obs(batch.state)
+        next_obs = self._prep_obs(batch.next_state)
         # One conv pass over [s; s'] for the main net.
         stacked = jnp.concatenate([obs, next_obs], axis=0)
         stacked_pa = jnp.concatenate([batch.previous_action, batch.action], axis=0)

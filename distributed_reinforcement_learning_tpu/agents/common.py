@@ -148,6 +148,49 @@ def global_norm(tree) -> jax.Array:
     return optax.global_norm(tree)
 
 
+def scan_learn(learn_fn):
+    """Wrap `(state, batch) -> (state, metrics)` into a K-step
+    `(state, stacked_batches[K, ...]) -> (state, stacked_metrics)`.
+
+    `lax.scan` runs K optimizer steps back-to-back in ONE compiled
+    dispatch — the math is identical to K sequential `learn` calls (the
+    step counter, LR schedule, and optimizer moments all advance inside
+    the scan), but the host never intervenes between steps. Through a
+    remote or tunneled device, the per-step dispatch gap costs more than
+    the step itself; this strips it. The trade is freshness: weights
+    publish at K-step granularity (IMPALA's V-trace corrects exactly
+    this off-policy staleness).
+    """
+
+    def many(state, batches):
+        return jax.lax.scan(lambda s, b: learn_fn(s, b), state, batches)
+
+    return many
+
+
+def scan_learn_weighted(learn_fn):
+    """`scan_learn` for the replay agents' `(state, batch, is_weight) ->
+    (state, priorities, metrics)` signature.
+
+    Returns `(state, stacked_priorities[K, B], stacked_metrics)`. Note
+    the replay semantics under K>1: all K batches are sampled BEFORE any
+    of the K updates, so priority updates land K-1 steps stale — the
+    same staleness distributed Ape-X already accepts from its actors
+    (`/root/reference/train_apex.py:207-217` pushes transitions scored
+    by old weights); keep K well under the target-sync interval.
+    """
+
+    def many(state, batches, is_weights):
+        def body(s, bw):
+            s, priorities, metrics = learn_fn(s, *bw)
+            return s, (priorities, metrics)
+
+        state, (priorities, metrics) = jax.lax.scan(body, state, (batches, is_weights))
+        return state, priorities, metrics
+
+    return many
+
+
 def epsilon_greedy(
     q_values: jax.Array, epsilon: jax.Array | float, num_actions: int, rng: jax.Array
 ) -> jax.Array:

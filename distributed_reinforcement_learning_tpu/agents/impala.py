@@ -54,6 +54,11 @@ class ImpalaConfig:
     # conv/LSTM activations of B*T frames in HBM — the knob that lets
     # batch size keep scaling once activations, not params, bound memory.
     remat: bool = False
+    # Fold the /255 frame normalization into conv0's kernel (NatureConv
+    # input_scale): uint8 frames feed the model raw, skipping the
+    # full-frame elementwise normalize pass. Exact same math modulo one
+    # rounding on the kernel; no-op for vector observations.
+    fold_normalize: bool = False
 
 
 class ImpalaBatch(NamedTuple):
@@ -82,7 +87,8 @@ class ImpalaAgent:
     def __init__(self, cfg: ImpalaConfig):
         self.cfg = cfg
         self.model = ImpalaActorCritic(
-            num_actions=cfg.num_actions, lstm_size=cfg.lstm_size, dtype=cfg.dtype
+            num_actions=cfg.num_actions, lstm_size=cfg.lstm_size, dtype=cfg.dtype,
+            fold_normalize=cfg.fold_normalize,
         )
         self._schedule = common.polynomial_lr(
             cfg.start_learning_rate, cfg.end_learning_rate, cfg.learning_frame
@@ -90,6 +96,10 @@ class ImpalaAgent:
         self.tx = common.rmsprop_with_clip(self._schedule, cfg.gradient_clip_norm)
         self.act = jax.jit(self._act)
         self.learn = jax.jit(self._learn, donate_argnums=(0,))
+        # K optimizer steps per dispatch (lax.scan over stacked batches):
+        # strips the per-step host->device dispatch gap, which through a
+        # remote/tunneled device costs more than the step itself.
+        self.learn_many = jax.jit(common.scan_learn(self._learn), donate_argnums=(0,))
 
     # -- init ------------------------------------------------------------
     def init_state(self, rng: jax.Array) -> common.TrainState:
@@ -103,6 +113,17 @@ class ImpalaAgent:
         z = jnp.zeros((batch_size, self.cfg.lstm_size), jnp.float32)
         return z, z
 
+    def _prep_obs(self, obs: jax.Array) -> jax.Array:
+        """Normalize frames — or pass integer frames raw when the model
+        folds the /255 into conv0 (`fold_normalize`)."""
+        if (
+            self.cfg.fold_normalize
+            and len(self.cfg.obs_shape) == 3
+            and jnp.issubdtype(obs.dtype, jnp.integer)
+        ):
+            return obs
+        return common.normalize_obs(obs, self.cfg.dtype)
+
     # -- act -------------------------------------------------------------
     def _act(self, params, obs, prev_action, h, c, rng) -> ActOutput:
         """Batched single-step act: sample from the softmax policy.
@@ -111,7 +132,7 @@ class ImpalaAgent:
         jax.random.categorical over log-probabilities), batched over the
         actor's parallel envs instead of one `sess.run` per env.
         """
-        out = self.model.apply(params, common.normalize_obs(obs, self.cfg.dtype), prev_action, h, c)
+        out = self.model.apply(params, self._prep_obs(obs), prev_action, h, c)
         action = jax.random.categorical(rng, jnp.log(out.policy + 1e-20), axis=-1)
         return ActOutput(action, out.policy, out.h, out.c)
 
@@ -123,7 +144,7 @@ class ImpalaAgent:
             forward = jax.checkpoint(forward)
         policy, value = forward(
             params,
-            common.normalize_obs(batch.state, self.cfg.dtype),
+            self._prep_obs(batch.state),
             batch.previous_action,
             batch.initial_h,
             batch.initial_c,

@@ -63,6 +63,9 @@ class R2D2Agent(common.SequenceReplayLearnMixin):
         self.act = jax.jit(self._act)
         self.td_error = jax.jit(self._td_error)
         self.learn = jax.jit(self._learn, donate_argnums=(0,))
+        self.learn_many = jax.jit(
+            common.scan_learn_weighted(self._learn), donate_argnums=(0,)
+        )
         self.sync_target = jax.jit(lambda s: s.sync_target())
 
     def init_state(self, rng: jax.Array) -> common.TargetTrainState:

@@ -121,6 +121,7 @@ class XImpalaAgent:
         self.tx = common.rmsprop_with_clip(self._schedule, cfg.gradient_clip_norm)
         self.act = jax.jit(self._act)
         self.learn = jax.jit(self._learn, donate_argnums=(0,))
+        self.learn_many = jax.jit(common.scan_learn(self._learn), donate_argnums=(0,))
 
     # -- init ------------------------------------------------------------
     def init_state(self, rng: jax.Array) -> common.TrainState:

@@ -41,14 +41,23 @@ class ImpalaActorCritic(nn.Module):
     num_actions: int
     lstm_size: int = 256
     dtype: jnp.dtype = jnp.float32
+    # Fold the /255 frame normalization into conv0's kernel: integer
+    # frames flow in raw and the model owns the scaling (see NatureConv).
+    fold_normalize: bool = False
 
     @nn.compact
     def __call__(self, obs: jax.Array, prev_action: jax.Array, h: jax.Array, c: jax.Array) -> ImpalaOutput:
-        obs = obs.astype(self.dtype)
         if obs.ndim == 2:  # vector observations (CartPole-class envs)
-            img = MLP([256], 256, final_activation=nn.relu, dtype=self.dtype, name="torso")(obs)
+            img = MLP([256], 256, final_activation=nn.relu, dtype=self.dtype, name="torso")(
+                obs.astype(self.dtype)
+            )
         else:
-            img = NatureConv(dtype=self.dtype, name="torso")(obs)
+            scale = (
+                1.0 / 255.0
+                if self.fold_normalize and jnp.issubdtype(obs.dtype, jnp.integer)
+                else None
+            )
+            img = NatureConv(dtype=self.dtype, input_scale=scale, name="torso")(obs)
         act = ActionEmbedding(self.num_actions, dtype=self.dtype, name="action_embed")(prev_action)
         z = jnp.concatenate([img, act], axis=-1)
         new_h, new_c = LSTMCell(self.lstm_size, dtype=self.dtype, name="lstm")(z, h, c)

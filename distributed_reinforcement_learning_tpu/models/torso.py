@@ -18,22 +18,41 @@ _glorot = nn.initializers.xavier_uniform()
 
 
 class NatureConv(nn.Module):
-    """Nature-DQN conv torso: 8x8/4 x32, 4x4/2 x64, 3x3/1 x64, flatten."""
+    """Nature-DQN conv torso: 8x8/4 x32, 4x4/2 x64, 3x3/1 x64, flatten.
+
+    Parameters are declared explicitly (HWIO `conv{i}_kernel` /
+    `conv{i}_bias`, fp32) rather than through `nn.Conv` so the first
+    kernel can carry a folded `input_scale`. Folding the frame
+    normalization (1/255) into conv0's kernel — a [8, 8, C, 32]
+    elementwise multiply at trace scale — lets callers feed raw uint8
+    frames and skip the full-frame `x * 1/255` pass, whose HBM
+    read+write (~3x the uint8 batch in the compute dtype) XLA does not
+    fuse into the TPU convolution's input. conv(x * s) == conv_{k*s}(x)
+    exactly, modulo one float rounding on the kernel.
+    """
 
     dtype: jnp.dtype = jnp.float32
+    input_scale: float | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        for features, kernel, stride in ((32, 8, 4), (64, 4, 2), (64, 3, 1)):
-            x = nn.Conv(
-                features,
-                (kernel, kernel),
-                strides=(stride, stride),
+        x = x.astype(self.dtype)
+        for i, (features, kernel, stride) in enumerate(((32, 8, 4), (64, 4, 2), (64, 3, 1))):
+            k = self.param(
+                f"conv{i}_kernel", _glorot, (kernel, kernel, x.shape[-1], features)
+            )
+            b = self.param(f"conv{i}_bias", nn.initializers.zeros_init(), (features,))
+            kc = k.astype(self.dtype)
+            if i == 0 and self.input_scale is not None:
+                kc = kc * jnp.asarray(self.input_scale, self.dtype)
+            x = jax.lax.conv_general_dilated(
+                x,
+                kc,
+                window_strides=(stride, stride),
                 padding="VALID",
-                kernel_init=_glorot,
-                dtype=self.dtype,
-            )(x)
-            x = nn.relu(x)
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            x = nn.relu(x + b.astype(self.dtype))
         return x.reshape((x.shape[0], -1))
 
 

@@ -1,0 +1,209 @@
+"""Perf-path equivalence tests: folded normalization and scanned learn.
+
+Both paths exist purely for TPU throughput; their contract is exact (up
+to float rounding) equivalence with the plain paths, checked here on CPU
+in fp32 with small image shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.agents import (
+    ApexAgent,
+    ApexBatch,
+    ApexConfig,
+    ImpalaAgent,
+    ImpalaBatch,
+    ImpalaConfig,
+    R2D2Agent,
+    R2D2Config,
+)
+from distributed_reinforcement_learning_tpu.agents import common
+from distributed_reinforcement_learning_tpu.models.torso import NatureConv
+
+OBS = (84, 84, 4)  # NatureConv's fixed geometry
+
+
+def small_impala_cfg(**kw):
+    base = dict(obs_shape=OBS, num_actions=4, trajectory=6, lstm_size=16,
+                learning_frame=1000)
+    base.update(kw)
+    return ImpalaConfig(**base)
+
+
+def impala_image_batch(cfg, key, B=2):
+    T, A, H = cfg.trajectory, cfg.num_actions, cfg.lstm_size
+    ks = jax.random.split(key, 8)
+    policy = jax.nn.softmax(jax.random.normal(ks[0], (B, T, A)), axis=-1)
+    return ImpalaBatch(
+        state=jax.random.randint(ks[1], (B, T, *OBS), 0, 256, dtype=jnp.int32).astype(jnp.uint8),
+        reward=jax.random.normal(ks[2], (B, T)),
+        action=jax.random.randint(ks[3], (B, T), 0, A),
+        done=jax.random.bernoulli(ks[4], 0.1, (B, T)),
+        behavior_policy=policy,
+        previous_action=jax.random.randint(ks[5], (B, T), 0, A),
+        initial_h=jax.random.normal(ks[6], (B, T, H)) * 0.1,
+        initial_c=jax.random.normal(ks[7], (B, T, H)) * 0.1,
+    )
+
+
+class TestFoldNormalize:
+    def test_nature_conv_input_scale_exact(self):
+        """conv_{k/255}(x) == conv_k(x/255) on the same params."""
+        conv = NatureConv()
+        conv_folded = NatureConv(input_scale=1.0 / 255.0)
+        x8 = np.random.default_rng(0).integers(0, 256, (3, *OBS)).astype(np.uint8)
+        params = conv.init(jax.random.PRNGKey(0), jnp.zeros((1, *OBS), jnp.float32))
+        plain = conv.apply(params, jnp.asarray(x8, jnp.float32) / 255.0)
+        folded = conv_folded.apply(params, jnp.asarray(x8))
+        np.testing.assert_allclose(np.asarray(plain), np.asarray(folded),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_impala_fold_normalize_same_params_and_loss(self):
+        plain = ImpalaAgent(small_impala_cfg())
+        folded = ImpalaAgent(small_impala_cfg(fold_normalize=True))
+        s0 = plain.init_state(jax.random.PRNGKey(1))
+        s1 = folded.init_state(jax.random.PRNGKey(1))
+        # identical param trees: the fold changes no parameter, only the call
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                     s0.params, s1.params)
+        batch = impala_image_batch(plain.cfg, jax.random.PRNGKey(2))
+        l0, _ = plain._loss(s0.params, batch)
+        l1, _ = folded._loss(s1.params, batch)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+
+    def test_impala_fold_normalize_act_parity(self):
+        plain = ImpalaAgent(small_impala_cfg())
+        folded = ImpalaAgent(small_impala_cfg(fold_normalize=True))
+        state = plain.init_state(jax.random.PRNGKey(1))
+        obs = np.random.default_rng(1).integers(0, 256, (2, *OBS)).astype(np.uint8)
+        pa = np.zeros(2, np.int32)
+        h, c = plain.initial_lstm_state(2)
+        rng = jax.random.PRNGKey(3)
+        a0 = plain.act(state.params, obs, pa, h, c, rng)
+        a1 = folded.act(state.params, obs, pa, h, c, rng)
+        np.testing.assert_allclose(np.asarray(a0.policy), np.asarray(a1.policy),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(a0.action), np.asarray(a1.action))
+
+    def test_apex_fold_normalize_td_parity(self):
+        cfg = dict(obs_shape=OBS, num_actions=4)
+        plain = ApexAgent(ApexConfig(**cfg))
+        folded = ApexAgent(ApexConfig(**cfg, fold_normalize=True))
+        state = plain.init_state(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(2)
+        B = 3
+        batch = ApexBatch(
+            state=rng.integers(0, 256, (B, *OBS)).astype(np.uint8),
+            next_state=rng.integers(0, 256, (B, *OBS)).astype(np.uint8),
+            previous_action=rng.integers(0, 4, B).astype(np.int32),
+            action=rng.integers(0, 4, B).astype(np.int32),
+            reward=rng.random(B).astype(np.float32),
+            done=rng.random(B) < 0.2,
+        )
+        td0 = plain.td_error(state, batch)
+        td1 = folded.td_error(state, batch)
+        np.testing.assert_allclose(np.asarray(td0), np.asarray(td1), rtol=1e-4, atol=1e-5)
+
+    def test_fold_normalize_ignores_vector_obs(self):
+        """Vector observations keep the normalize/cast path untouched."""
+        cfg = ImpalaConfig(obs_shape=(4,), num_actions=2, trajectory=4,
+                           lstm_size=8, fold_normalize=True)
+        agent = ImpalaAgent(cfg)
+        state = agent.init_state(jax.random.PRNGKey(0))
+        obs = np.random.default_rng(0).random((2, 4)).astype(np.float32)
+        h, c = agent.initial_lstm_state(2)
+        out = agent.act(state.params, obs, np.zeros(2, np.int32), h, c,
+                        jax.random.PRNGKey(1))
+        assert out.policy.shape == (2, 2)
+
+
+def stack_trees(trees):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+class TestLearnMany:
+    def test_impala_learn_many_matches_sequential(self):
+        cfg = ImpalaConfig(obs_shape=(4,), num_actions=2, trajectory=8,
+                           lstm_size=16, learning_frame=1000)
+        agent = ImpalaAgent(cfg)
+        K = 3
+        batches = [
+            __import__("tests.test_agents", fromlist=["make_impala_batch"]).make_impala_batch(
+                cfg, jax.random.PRNGKey(10 + i))
+            for i in range(K)
+        ]
+        s_seq = agent.init_state(jax.random.PRNGKey(0))
+        seq_metrics = []
+        for b in batches:
+            s_seq, m = agent.learn(s_seq, b)
+            seq_metrics.append(m)
+        s_many = agent.init_state(jax.random.PRNGKey(0))
+        s_many, stacked = agent.learn_many(s_many, stack_trees(batches))
+        assert int(s_many.step) == K
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+            s_seq.params, s_many.params)
+        for i, m in enumerate(seq_metrics):
+            np.testing.assert_allclose(float(stacked["total_loss"][i]),
+                                       float(m["total_loss"]), rtol=2e-5)
+
+    def test_apex_learn_many_matches_sequential(self):
+        cfg = ApexConfig(obs_shape=(4,), num_actions=3)
+        agent = ApexAgent(cfg)
+        K, B = 3, 4
+        rng = np.random.default_rng(0)
+
+        def batch(i):
+            r = np.random.default_rng(100 + i)
+            return ApexBatch(
+                state=r.random((B, 4), dtype=np.float32),
+                next_state=r.random((B, 4), dtype=np.float32),
+                previous_action=r.integers(0, 3, B).astype(np.int32),
+                action=r.integers(0, 3, B).astype(np.int32),
+                reward=r.random(B).astype(np.float32),
+                done=r.random(B) < 0.2,
+            )
+
+        batches = [batch(i) for i in range(K)]
+        weights = [rng.random(B).astype(np.float32) + 0.5 for _ in range(K)]
+        s_seq = agent.init_state(jax.random.PRNGKey(0))
+        tds = []
+        for b, w in zip(batches, weights):
+            s_seq, td, _ = agent.learn(s_seq, b, w)
+            tds.append(np.asarray(td))
+        s_many = agent.init_state(jax.random.PRNGKey(0))
+        s_many, td_stack, _ = agent.learn_many(
+            s_many, stack_trees(batches), jnp.stack([jnp.asarray(w) for w in weights]))
+        assert int(s_many.step) == K
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+            s_seq.params, s_many.params)
+        np.testing.assert_allclose(np.asarray(td_stack), np.stack(tds),
+                                   rtol=2e-5, atol=1e-6)
+
+    def test_r2d2_learn_many_matches_sequential(self):
+        from tests.test_agents import make_r2d2_batch, r2d2_cfg
+
+        cfg = r2d2_cfg()
+        agent = R2D2Agent(cfg)
+        K, B = 2, 3
+        batches = [make_r2d2_batch(cfg, jax.random.PRNGKey(20 + i), B=B) for i in range(K)]
+        weights = [np.full(B, 1.0, np.float32) for _ in range(K)]
+        s_seq = agent.init_state(jax.random.PRNGKey(0))
+        prios = []
+        for b, w in zip(batches, weights):
+            s_seq, p, _ = agent.learn(s_seq, b, w)
+            prios.append(np.asarray(p))
+        s_many = agent.init_state(jax.random.PRNGKey(0))
+        s_many, p_stack, _ = agent.learn_many(
+            s_many, stack_trees(batches), jnp.stack([jnp.asarray(w) for w in weights]))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+            s_seq.params, s_many.params)
+        np.testing.assert_allclose(np.asarray(p_stack), np.stack(prios),
+                                   rtol=2e-5, atol=1e-6)
