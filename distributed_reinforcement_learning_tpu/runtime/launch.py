@@ -150,7 +150,8 @@ def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
                else impala_runner.ImpalaLearner)
         return cls(
             agent, queue, weights, rt.batch_size, logger=logger, rng=rng,
-            prefetch=prefetch, mesh=mesh, publish_interval=rt.publish_interval)
+            prefetch=prefetch, mesh=mesh, publish_interval=rt.publish_interval,
+            updates_per_call=rt.updates_per_call)
     if algo == "apex":
         return apex_runner.ApexLearner(
             agent, queue, weights, rt.batch_size,

@@ -101,16 +101,23 @@ class PublishCadenceMixin:
     # actors interleave on one thread, so async publication buys nothing
     # and only makes the weight-staleness sequence nondeterministic.
     sync_publish = False
+    # Step count at the last publish. Cadence is "at least every
+    # `publish_interval` steps since the last publish", NOT a modulo on
+    # train_steps: learners advancing in strides (updates_per_call K, or
+    # a partial drain of K' < K) would alias a modulo to lcm(K, interval)
+    # — or miss it forever once the counter goes off-grid.
+    _last_publish_step = 0
 
     def maybe_publish(self) -> bool:
-        """Publish every `publish_interval`-th train step.
+        """Publish once `publish_interval` steps accumulate since the last.
 
         The publish's host snapshot (np.asarray) is the step's device
         sync, so with K>1 the intervening learn steps pipeline on-device
         with no host sync between them. Returns True when it published.
         """
-        if self.train_steps % self.publish_interval != 0:
+        if self.train_steps - self._last_publish_step < self.publish_interval:
             return False
+        self._last_publish_step = self.train_steps
         with self.timer.stage("publish"):
             if _async_publish(self.sync_publish):
                 self.weights.publish_async(self.state.params, self.train_steps)
@@ -133,9 +140,10 @@ class PublishCadenceMixin:
         return True
 
     def flush_publish(self) -> None:
-        """close()-time flush: with interval K and total steps % K != 0
-        the last <K updates would otherwise never reach the store."""
-        if self.train_steps > 0 and self.train_steps % self.publish_interval != 0:
+        """close()-time flush: any updates since the last publish would
+        otherwise never reach the store."""
+        if self.train_steps > self._last_publish_step:
             self.weights.publish(self.state.params, self.train_steps)
+            self._last_publish_step = self.train_steps
         if _async_publish(self.sync_publish):
             self.weights.flush_async()

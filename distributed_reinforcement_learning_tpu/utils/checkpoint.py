@@ -198,7 +198,19 @@ class Checkpointer:
         payload = self._payload_path(step)
         if not payload.exists():
             return None
-        state = serialization.from_bytes(template, payload.read_bytes())
+        data = payload.read_bytes()
+        try:
+            state = serialization.from_bytes(template, data)
+        except (ValueError, KeyError):
+            # Layout migration: pre-r3 image models nested conv params as
+            # nn.Conv's `Conv_{i}/{kernel,bias}`; the explicit NatureConv
+            # layout (models/torso.py) flattens them. Retry the restore
+            # through the upgrade map before giving up.
+            from distributed_reinforcement_learning_tpu.models.torso import (
+                upgrade_nature_conv_params)
+
+            raw = upgrade_nature_conv_params(serialization.msgpack_restore(data))
+            state = serialization.from_state_dict(template, raw)
         extra_path = self._extra_path(step)
         extra = json.loads(extra_path.read_text()) if extra_path.exists() else {}
         return state, extra, step

@@ -37,6 +37,7 @@ class RuntimeConfig:
     target_sync_interval: int = 100  # `train_apex.py:151-152`, `train_r2d2.py:163-164`
     train_start_factor: int = 3  # learner trains when queue > factor*batch (`train_impala.py:94`)
     publish_interval: int = 1  # IMPALA weight-publish cadence (1 = reference parity)
+    updates_per_call: int = 1  # IMPALA-family: K optimizer steps per learn_many dispatch
     seq_parallel: int = 1  # xformer: devices carving the mesh's `seq` axis
     expert_parallel: int = 1  # xformer MoE: devices carving the `expert` axis
 
@@ -67,6 +68,7 @@ def _runtime_from_section(algo: str, d: dict[str, Any]) -> RuntimeConfig:
         target_sync_interval=d.get("target_sync_interval", 100),
         train_start_factor=d.get("train_start_factor", 3),
         publish_interval=d.get("publish_interval", 1),
+        updates_per_call=d.get("updates_per_call", 1),
         seq_parallel=d.get("seq_parallel", 1),
         expert_parallel=d.get("expert_parallel", 1),
     )
@@ -99,6 +101,7 @@ def load_config(path: str | Path, section: str):
             start_learning_rate=d.get("start_learning_rate", 6e-4),
             end_learning_rate=d.get("end_learning_rate", 0.0),
             learning_frame=int(d.get("learning_frame", 1e9)),
+            fold_normalize=d.get("fold_normalize", False),
         )
     elif algorithm == "apex":
         agent_cfg = ApexConfig(
@@ -110,6 +113,7 @@ def load_config(path: str | Path, section: str):
             start_learning_rate=d.get("start_learning_rate", 1e-4),
             end_learning_rate=d.get("end_learning_rate", 0.0),
             learning_frame=int(d.get("learning_frame", 1e9)),
+            fold_normalize=d.get("fold_normalize", False),
         )
     elif algorithm == "r2d2":
         agent_cfg = R2D2Config(

@@ -358,3 +358,39 @@ def test_new_param_layouts_roundtrip(variant, tmp_path):
     # Restored state must keep training.
     state2, _, m = agent.learn(restored, batch, w)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_restore_pre_r3_conv_param_layout(tmp_path):
+    """A checkpoint serialized with the pre-r3 nn.Conv nesting
+    (`Conv_{i}/{kernel,bias}`) restores against the current explicit
+    NatureConv layout via the upgrade map in Checkpointer.restore."""
+    from flax import serialization
+
+    from distributed_reinforcement_learning_tpu.utils import checkpoint as ckpt_mod
+
+    cfg = ImpalaConfig(obs_shape=(84, 84, 4), num_actions=4, trajectory=4,
+                       lstm_size=16)
+    agent = ImpalaAgent(cfg)
+    state = agent.init_state(jax.random.PRNGKey(0))
+
+    def downgrade(tree):
+        if not isinstance(tree, dict):
+            return tree
+        out = dict(tree)
+        for i in range(3):
+            kk, bk = f"conv{i}_kernel", f"conv{i}_bias"
+            if kk in out:
+                out[f"Conv_{i}"] = {"kernel": out.pop(kk), "bias": out.pop(bk)}
+        return {k: downgrade(v) for k, v in out.items()}
+
+    old_style = downgrade(serialization.to_state_dict(state))
+    ckpt = Checkpointer(tmp_path, retain=2)
+    path = ckpt._payload_path(7)
+    ckpt_mod._atomic_write(ckpt._extra_path(7), b"{}")
+    ckpt_mod._atomic_write(path, serialization.msgpack_serialize(old_style))
+
+    got = ckpt.restore(state)
+    assert got is not None
+    restored, _, step = got
+    assert step == 7
+    assert _tree_equal(restored.params, state.params)

@@ -119,6 +119,24 @@ class TestFoldNormalize:
         assert out.policy.shape == (2, 2)
 
 
+def test_upgrade_nature_conv_params_maps_old_layout():
+    """Pre-r3 nn.Conv nesting (`Conv_i/{kernel,bias}`) restores via the
+    upgrade helper into the explicit conv{i}_* layout."""
+    from distributed_reinforcement_learning_tpu.models.torso import upgrade_nature_conv_params
+
+    conv = NatureConv()
+    params = conv.init(jax.random.PRNGKey(0), jnp.zeros((1, *OBS), jnp.float32))
+    new_tree = params["params"]
+    old_tree = {
+        f"Conv_{i}": {"kernel": new_tree[f"conv{i}_kernel"],
+                      "bias": new_tree[f"conv{i}_bias"]}
+        for i in range(3)
+    }
+    upgraded = upgrade_nature_conv_params({"params": {"torso": old_tree}})
+    jax.tree.map(np.testing.assert_array_equal,
+                 upgraded, {"params": {"torso": new_tree}})
+
+
 def stack_trees(trees):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
 
@@ -184,6 +202,65 @@ class TestLearnMany:
             s_seq.params, s_many.params)
         np.testing.assert_allclose(np.asarray(td_stack), np.stack(tds),
                                    rtol=2e-5, atol=1e-6)
+
+    def test_learner_updates_per_call_matches_sequential(self):
+        from tests.test_agents import make_impala_batch
+
+        from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+        from distributed_reinforcement_learning_tpu.runtime.impala_runner import ImpalaLearner
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        cfg = ImpalaConfig(obs_shape=(4,), num_actions=2, trajectory=8,
+                           lstm_size=16, learning_frame=1000)
+        agent = ImpalaAgent(cfg)
+
+        def fill(queue, n_items):
+            for i in range(n_items):
+                b = make_impala_batch(cfg, jax.random.PRNGKey(1000 + i), B=1)
+                queue.put(jax.tree.map(lambda x: np.asarray(x)[0], b))
+
+        qa, qb = TrajectoryQueue(capacity=64), TrajectoryQueue(capacity=64)
+        fill(qa, 8)
+        fill(qb, 8)
+        la = ImpalaLearner(agent, qa, WeightStore(), batch_size=2,
+                           rng=jax.random.PRNGKey(0))
+        lb = ImpalaLearner(agent, qb, WeightStore(), batch_size=2,
+                           rng=jax.random.PRNGKey(0), updates_per_call=2)
+        for _ in range(4):
+            la.step(timeout=1.0)
+        for _ in range(2):
+            lb.step(timeout=1.0)
+        assert la.train_steps == lb.train_steps == 4
+        assert la.frames_learned == lb.frames_learned
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+            la.state.params, lb.state.params)
+        # Partial drain (only one batch available) trains sequentially
+        # rather than dropping data or stalling.
+        fill(qb, 2)
+        assert lb.step(timeout=0.2) is not None
+        assert lb.train_steps == 5
+        la.close()
+        lb.close()
+
+        # Prefetched stacking: the prefetcher assembles [K, B, ...] stacks
+        # on its background thread; results match the unprefetched path.
+        qc = TrajectoryQueue(capacity=64)
+        fill(qc, 8)
+        lc = ImpalaLearner(agent, qc, WeightStore(), batch_size=2,
+                           rng=jax.random.PRNGKey(0), updates_per_call=2,
+                           prefetch=True)
+        try:
+            for _ in range(2):
+                assert lc.step(timeout=5.0) is not None
+            assert lc.train_steps == 4
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+                la.state.params, lc.state.params)
+        finally:
+            lc.close()
 
     def test_r2d2_learn_many_matches_sequential(self):
         from tests.test_agents import make_r2d2_batch, r2d2_cfg
