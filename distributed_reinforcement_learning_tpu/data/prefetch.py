@@ -46,6 +46,7 @@ class DevicePrefetcher:
         depth: int = 1,
         transform: Callable[[Any], Any] | None = None,
         stack_calls: int = 1,
+        stack_sharding: Any | None = None,
     ):
         self.source = source
         self.batch_size = batch_size
@@ -54,10 +55,15 @@ class DevicePrefetcher:
         # stack_calls=K: each get_batch yields a [K, B, ...] stack of K
         # dequeued batches (for learn_many / updates_per_call learners).
         # The stacking happens on this background thread, overlapped with
-        # device compute like the H2D itself.
+        # device compute like the H2D itself. Over a mesh the stack needs
+        # its own spec (`stack_sharding`, B on the data axis, K
+        # unsharded) — the per-batch `sharding` would put K there.
         self.stack_calls = max(1, int(stack_calls))
-        if self.stack_calls > 1 and sharding is not None:
-            raise ValueError("stack_calls > 1 is not supported with a sharded mesh")
+        self.stack_sharding = stack_sharding
+        if self.stack_calls > 1 and sharding is not None and stack_sharding is None:
+            raise ValueError(
+                "stack_calls > 1 over a mesh needs stack_sharding "
+                "(a [K, B, ...] spec with the batch dim on the data axis)")
         self._out: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
         self._error: BaseException | None = None
         self._stop = threading.Event()
@@ -124,10 +130,12 @@ class DevicePrefetcher:
             # overlaps with whatever the device is computing. Multi-host
             # meshes route through make_array_from_process_local_data
             # (parallel.mesh.place_local_batch).
-            if self.sharding is not None:
+            sharding = (self.stack_sharding if self.stack_calls > 1
+                        else self.sharding)
+            if sharding is not None:
                 from distributed_reinforcement_learning_tpu.parallel import place_local_batch
 
-                batch = place_local_batch(batch, self.sharding)
+                batch = place_local_batch(batch, sharding)
             else:
                 batch = jax.device_put(batch)
             if pooled:

@@ -121,6 +121,21 @@ class ShardedLearner:
             out_shardings=out_shardings,
             donate_argnums=(0,),
         )
+        # K-step scanned learn over [K, B, ...] stacks (agents/common
+        # scan_learn): the scan carries the sharded TrainState, each
+        # iteration's batch slice shards its B dim over `data`. Only the
+        # (state, batch) signature — replay agents' weighted learn stays
+        # per-step at the runner level.
+        if num_data_args == 1:
+            from distributed_reinforcement_learning_tpu.agents.common import scan_learn
+
+            self.stacked_data_sharding = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS))
+            self.learn_many = jax.jit(
+                scan_learn(agent._learn),
+                in_shardings=(self.state_sharding, self.stacked_data_sharding),
+                out_shardings=(self.state_sharding, self._repl),
+                donate_argnums=(0,),
+            )
 
     def init_state(self, rng: jax.Array):
         """Initialize the TrainState directly into its mesh sharding."""

@@ -151,13 +151,11 @@ class ImpalaLearner(PublishCadenceMixin):
         self.weights = weights
         self.batch_size = batch_size
         # K>1: dequeue K batches and run them as ONE lax.scan dispatch
-        # (agent.learn_many). Strips the per-step dispatch gap — the
-        # dominant cost on remote/tunneled devices — at the price of
-        # weights publishing at K-step granularity. Single-jit path only
-        # (the sharded learner keeps per-step pjit calls).
+        # (learn_many). Strips the per-step dispatch gap — the dominant
+        # cost on remote/tunneled devices — at the price of weights
+        # publishing at K-step granularity. Works single-jit and over a
+        # mesh (ShardedLearner.learn_many scans the pjit-sharded step).
         self.updates_per_call = max(1, int(updates_per_call))
-        if self.updates_per_call > 1 and mesh is not None:
-            raise ValueError("updates_per_call > 1 is not supported with a sharded mesh")
         self.logger = logger or MetricsLogger(None)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         # Multi-chip learner: pjit the learn step over the mesh, batch
@@ -170,10 +168,12 @@ class ImpalaLearner(PublishCadenceMixin):
 
             self._sharded = ShardedLearner(agent, mesh)
             self._learn = self._sharded.learn
+            self._learn_many = self._sharded.learn_many
             self._batch_sharding = data_sharding(mesh)
         else:
             self._sharded = None
             self._learn = agent.learn
+            self._learn_many = agent.learn_many
         # Double-buffered host->device pipeline (SURVEY §7 hard part (a)):
         # batch k+1 is dequeued/stacked/device_put while batch k trains.
         # Off in sync/test mode (a background consumer would race the
@@ -184,10 +184,13 @@ class ImpalaLearner(PublishCadenceMixin):
 
             # With updates_per_call=K the prefetcher stacks K dequeues into
             # one [K, B, ...] batch on its background thread, feeding
-            # learn_many directly.
+            # learn_many directly (over a mesh, with the stack's own spec).
             self._prefetcher = DevicePrefetcher(
                 queue, batch_size, sharding=self._batch_sharding,
-                stack_calls=self.updates_per_call)
+                stack_calls=self.updates_per_call,
+                stack_sharding=(self._sharded.stacked_data_sharding
+                                if self._sharded is not None
+                                and self.updates_per_call > 1 else None))
         # Publish cadence: every step (interval=1, reference-parity
         # freshness) forces a full D2H param copy + device sync per step.
         # interval=K lets K device steps pipeline back-to-back before the
@@ -254,17 +257,24 @@ class ImpalaLearner(PublishCadenceMixin):
             return None
         steps_done = K if batch is not None or K == 1 else len(parts)
         with self.timer.stage("learn"):
+            place = None
             if self._batch_sharding is not None and self._prefetcher is None:
                 from distributed_reinforcement_learning_tpu.parallel import place_local_batch
 
-                batch = place_local_batch(batch, self._batch_sharding)
+                place = place_local_batch
             if K > 1 and batch is not None:
-                self.state, stacked = self.agent.learn_many(self.state, batch)
+                if place is not None:
+                    batch = place(batch, self._sharded.stacked_data_sharding)
+                self.state, stacked = self._learn_many(self.state, batch)
                 metrics = jax.tree.map(lambda x: x[-1], stacked)
             elif K > 1:
                 for b in parts:
+                    if place is not None:
+                        b = place(b, self._batch_sharding)
                     self.state, metrics = self._learn(self.state, b)
             else:
+                if place is not None:
+                    batch = place(batch, self._batch_sharding)
                 self.state, metrics = self._learn(self.state, batch)
         self.train_steps += steps_done
         self.frames_learned += steps_done * self.batch_size * self.agent.cfg.trajectory

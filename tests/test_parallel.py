@@ -223,3 +223,73 @@ class TestXformerTensorParallel:
         _, pri, m = learner.learn(state, *learner.shard_batch((batch, w)))
         np.testing.assert_allclose(np.asarray(ref_pri), np.asarray(pri), atol=1e-4)
         assert abs(float(ref_m["loss"]) - float(m["loss"])) < 1e-4
+
+
+class TestShardedLearnMany:
+    def test_sharded_learn_many_matches_sequential(self):
+        """K scanned steps over the mesh == K sequential sharded steps,
+        with the stacked batch's B dim (not K) on the data axis."""
+        agent = ImpalaAgent(ImpalaConfig(obs_shape=(4,), num_actions=3,
+                                         lstm_size=32, trajectory=6))
+        K = 3
+        batches = [_impala_batch(10 + i, B=8, T=6, obs=4, A=3, H=32)
+                   for i in range(K)]
+
+        mesh = make_mesh(8)
+        learner = ShardedLearner(agent, mesh)
+        s_seq = learner.init_state(jax.random.PRNGKey(1))
+        for b in batches:
+            s_seq, _ = learner.learn(s_seq, learner.shard_batch(b))
+
+        s_many = learner.init_state(jax.random.PRNGKey(1))
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+        stacked = jax.device_put(stacked, learner.stacked_data_sharding)
+        s_many, metrics = learner.learn_many(s_many, stacked)
+
+        assert int(s_many.step) == K
+        assert np.asarray(metrics["total_loss"]).shape == (K,)
+        _tree_allclose(jax.device_get(s_seq.params), jax.device_get(s_many.params))
+
+    def test_learner_updates_per_call_with_mesh(self):
+        """ImpalaLearner routes K>1 through the sharded learn_many."""
+        from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+        from distributed_reinforcement_learning_tpu.runtime.impala_runner import ImpalaLearner
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        cfg = ImpalaConfig(obs_shape=(4,), num_actions=3, lstm_size=32, trajectory=6)
+        agent = ImpalaAgent(cfg)
+        queue = TrajectoryQueue(capacity=64)
+        for i in range(16):
+            b = _impala_batch(50 + i, B=1, T=6, obs=4, A=3, H=32)
+            queue.put(jax.tree.map(lambda x: np.asarray(x)[0], b))
+        learner = ImpalaLearner(agent, queue, WeightStore(), batch_size=8,
+                                rng=jax.random.PRNGKey(0), mesh=make_mesh(8),
+                                updates_per_call=2)
+        try:
+            assert learner.step(timeout=5.0) is not None
+            assert learner.train_steps == 2
+        finally:
+            learner.close()
+
+    def test_learner_updates_per_call_with_mesh_and_prefetch(self):
+        """The transport learner path (prefetch=True + mesh): the
+        prefetcher stacks K dequeues and places them with the stacked
+        spec (B on data, K unsharded)."""
+        from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+        from distributed_reinforcement_learning_tpu.runtime.impala_runner import ImpalaLearner
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        cfg = ImpalaConfig(obs_shape=(4,), num_actions=3, lstm_size=32, trajectory=6)
+        agent = ImpalaAgent(cfg)
+        queue = TrajectoryQueue(capacity=64)
+        for i in range(16):
+            b = _impala_batch(80 + i, B=1, T=6, obs=4, A=3, H=32)
+            queue.put(jax.tree.map(lambda x: np.asarray(x)[0], b))
+        learner = ImpalaLearner(agent, queue, WeightStore(), batch_size=8,
+                                rng=jax.random.PRNGKey(0), mesh=make_mesh(8),
+                                updates_per_call=2, prefetch=True)
+        try:
+            assert learner.step(timeout=10.0) is not None
+            assert learner.train_steps == 2
+        finally:
+            learner.close()
