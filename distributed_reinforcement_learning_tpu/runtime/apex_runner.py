@@ -25,6 +25,7 @@ from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, st
 from distributed_reinforcement_learning_tpu.data.replay import UniformBuffer, make_replay
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
+from distributed_reinforcement_learning_tpu.runtime.replay_train import ReplayTrainMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
 from distributed_reinforcement_learning_tpu.utils.profiling import ProfilerSession, StageTimer
@@ -135,7 +136,7 @@ class ApexActor:
         return num_steps * self._obs.shape[0]
 
 
-class ApexLearner(PublishCadenceMixin):
+class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
     def __init__(
         self,
         agent: ApexAgent,
@@ -150,6 +151,7 @@ class ApexLearner(PublishCadenceMixin):
         seed: int = 0,
         mesh=None,
         publish_interval: int = 1,
+        updates_per_call: int = 1,
     ):
         self.agent = agent
         self.queue = queue
@@ -157,6 +159,9 @@ class ApexLearner(PublishCadenceMixin):
         self.batch_size = batch_size
         self.replay = make_replay(replay_capacity)
         self.target_sync_interval = target_sync_interval
+        # K>1: K prioritized updates per learn_many dispatch
+        # (runtime/replay_train.py; K-1-step-stale priorities).
+        self._init_stride(updates_per_call, mesh)
         self.train_start_unrolls = train_start_unrolls
         self.logger = logger or MetricsLogger(None)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -199,6 +204,7 @@ class ApexLearner(PublishCadenceMixin):
             "train_steps": self.train_steps,
             "replay_beta": float(self.replay.beta),
             "ingested_unrolls": self.ingested_unrolls,
+            **self._cadence_extra(),
         }, blobs={"replay": blob} if blob is not None else None)
 
     def restore_checkpoint(self, ckpt) -> bool:
@@ -218,6 +224,7 @@ class ApexLearner(PublishCadenceMixin):
             self.ingested_unrolls = 0
         self.replay.beta = float(extra.get("replay_beta", self.replay.beta))
         self.weights.publish(self.state.params, self.train_steps)
+        self._restore_cadence(extra)
         return True
 
     def ingest(self, timeout: float | None = 0.0) -> bool:
@@ -263,26 +270,30 @@ class ApexLearner(PublishCadenceMixin):
         return k
 
     def train(self) -> dict | None:
-        """One prioritized train step (`train_apex.py:124-155`)."""
+        """One prioritized train call (`train_apex.py:124-155`); with
+        `updates_per_call` K > 1, K scanned updates (replay_train.py)."""
         if self.ingested_unrolls < self.train_start_unrolls:
             return None
-        with self.timer.stage("replay_sample"):
-            items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
-            # SoA backend returns the stacked batch directly.
-            batch = items if getattr(self.replay, "stacked_samples", False) \
-                else stack_pytrees(items)
-        with self.timer.stage("learn"):
-            if self._batch_sharding is not None:
-                from distributed_reinforcement_learning_tpu.parallel import place_local_batch
+        if self.updates_per_call > 1:
+            from distributed_reinforcement_learning_tpu.runtime.replay_train import (
+                prioritized_train_call)
 
-                batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
-            self.state, td, metrics = self._learn(self.state, batch, is_weight)
-        with self.timer.stage("replay_update"):
-            self.replay.update_batch(idxs, np.asarray(td))
-        self.train_steps += 1
-        self.maybe_publish()
-        if self.train_steps % self.target_sync_interval == 0:
-            self.state = self.agent.sync_target(self.state)
+            metrics = prioritized_train_call(self, self.updates_per_call)
+        else:
+            with self.timer.stage("replay_sample"):
+                items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
+                # SoA backend returns the stacked batch directly.
+                batch = items if getattr(self.replay, "stacked_samples", False) \
+                    else stack_pytrees(items)
+            with self.timer.stage("learn"):
+                if self._batch_sharding is not None:
+                    from distributed_reinforcement_learning_tpu.parallel import place_local_batch
+
+                    batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
+                self.state, td, metrics = self._learn(self.state, batch, is_weight)
+            with self.timer.stage("replay_update"):
+                self.replay.update_batch(idxs, np.asarray(td))
+        self._finish_train_call()
         metrics = {k: float(v) for k, v in metrics.items()}
         self.timer.step_done(self.train_steps)
         self._profiler.on_step(self.train_steps)

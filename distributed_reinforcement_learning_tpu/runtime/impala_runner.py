@@ -224,6 +224,7 @@ class ImpalaLearner(PublishCadenceMixin):
         self.train_steps = int(extra.get("train_steps", 0))
         self.frames_learned = int(extra.get("frames_learned", 0))
         self.weights.publish(self.state.params, self.train_steps)
+        self._last_publish_step = self.train_steps  # the line above IS a publish
         return True
 
     def step(self, timeout: float | None = None) -> dict | None:

@@ -157,14 +157,16 @@ def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
             agent, queue, weights, rt.batch_size,
             replay_capacity=rt.replay_capacity,
             target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng,
-            mesh=mesh, publish_interval=rt.publish_interval)
+            mesh=mesh, publish_interval=rt.publish_interval,
+            updates_per_call=rt.updates_per_call)
     cls = (xformer_runner.XformerLearner if algo == "xformer"
            else r2d2_runner.R2D2Learner)
     return cls(
         agent, queue, weights, rt.batch_size,
         replay_capacity=rt.replay_capacity,
         target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng,
-        mesh=mesh, publish_interval=rt.publish_interval)
+        mesh=mesh, publish_interval=rt.publish_interval,
+        updates_per_call=rt.updates_per_call)
 
 
 def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, weights,

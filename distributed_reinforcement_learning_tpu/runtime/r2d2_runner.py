@@ -24,6 +24,7 @@ from distributed_reinforcement_learning_tpu.data.replay import make_replay
 from distributed_reinforcement_learning_tpu.data.structures import R2D2SequenceAccumulator
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
+from distributed_reinforcement_learning_tpu.runtime.replay_train import ReplayTrainMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
 from distributed_reinforcement_learning_tpu.utils.profiling import ProfilerSession, StageTimer
@@ -118,7 +119,7 @@ class R2D2Actor:
         return n * cfg.seq_len
 
 
-class R2D2Learner(PublishCadenceMixin):
+class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
     def __init__(
         self,
         agent: R2D2Agent,
@@ -132,6 +133,7 @@ class R2D2Learner(PublishCadenceMixin):
         seed: int = 0,
         mesh=None,
         publish_interval: int = 1,
+        updates_per_call: int = 1,
     ):
         self.agent = agent
         self.queue = queue
@@ -139,6 +141,9 @@ class R2D2Learner(PublishCadenceMixin):
         self.batch_size = batch_size
         self.replay = make_replay(replay_capacity)
         self.target_sync_interval = target_sync_interval
+        # K>1: K prioritized updates per learn_many dispatch
+        # (runtime/replay_train.py; K-1-step-stale priorities).
+        self._init_stride(updates_per_call, mesh)
         self.logger = logger or MetricsLogger(None)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._batch_sharding = None
@@ -176,6 +181,7 @@ class R2D2Learner(PublishCadenceMixin):
             "train_steps": self.train_steps,
             "replay_beta": float(self.replay.beta),
             "ingested_sequences": self.ingested_sequences,
+            **self._cadence_extra(),
         }, blobs={"replay": blob} if blob is not None else None)
 
     def restore_checkpoint(self, ckpt) -> bool:
@@ -194,6 +200,7 @@ class R2D2Learner(PublishCadenceMixin):
             self.ingested_sequences = 0  # replay refills from live traffic
         self.replay.beta = float(extra.get("replay_beta", self.replay.beta))
         self.weights.publish(self.state.params, self.train_steps)
+        self._restore_cadence(extra)
         return True
 
     def ingest_batch(self, timeout: float | None = 0.0) -> int:
@@ -248,23 +255,26 @@ class R2D2Learner(PublishCadenceMixin):
         """One prioritized train step over sequences (`train_r2d2.py:121-164`)."""
         if self.ingested_sequences < 2 * self.batch_size:  # `train_r2d2.py:121`
             return None
-        with self.timer.stage("replay_sample"):
-            items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
-            # SoA backend returns the stacked batch directly.
-            batch = items if getattr(self.replay, "stacked_samples", False) \
-                else stack_pytrees(items)
-        with self.timer.stage("learn"):
-            if self._batch_sharding is not None:
-                from distributed_reinforcement_learning_tpu.parallel import place_local_batch
+        if self.updates_per_call > 1:
+            from distributed_reinforcement_learning_tpu.runtime.replay_train import (
+                prioritized_train_call)
 
-                batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
-            self.state, priorities, metrics = self._learn(self.state, batch, is_weight)
-        with self.timer.stage("replay_update"):
-            self.replay.update_batch(idxs, np.asarray(priorities))
-        self.train_steps += 1
-        self.maybe_publish()
-        if self.train_steps % self.target_sync_interval == 0:
-            self.state = self.agent.sync_target(self.state)
+            metrics = prioritized_train_call(self, self.updates_per_call)
+        else:
+            with self.timer.stage("replay_sample"):
+                items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
+                # SoA backend returns the stacked batch directly.
+                batch = items if getattr(self.replay, "stacked_samples", False) \
+                    else stack_pytrees(items)
+            with self.timer.stage("learn"):
+                if self._batch_sharding is not None:
+                    from distributed_reinforcement_learning_tpu.parallel import place_local_batch
+
+                    batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
+                self.state, priorities, metrics = self._learn(self.state, batch, is_weight)
+            with self.timer.stage("replay_update"):
+                self.replay.update_batch(idxs, np.asarray(priorities))
+        self._finish_train_call()
         metrics = {k: float(v) for k, v in metrics.items()}
         self.timer.step_done(self.train_steps)
         self._profiler.on_step(self.train_steps)

@@ -1,0 +1,88 @@
+"""K-step scanned training for the prioritized-replay learners.
+
+The replay analogue of ImpalaLearner's `updates_per_call`: sample K
+prioritized batches up front, run them as ONE `learn_many` dispatch
+(`agents/common.scan_learn_weighted`), then apply all K priority
+updates. Relative to K sequential `train()` calls the only semantic
+difference is priority staleness — batches 2..K are sampled under
+priorities that predate updates 1..K-1, the same staleness distributed
+Ape-X already accepts from its actors (`/root/reference/
+train_apex.py:207-217` pushes transitions scored by old weights).
+Single-jit learners only (the pjit ShardedLearner keeps per-step calls);
+keep K well under the target-sync interval.
+
+`ReplayTrainMixin` centralizes the stride bookkeeping shared by
+ApexLearner and R2D2Learner (and its Xformer subclass): the K clamp +
+mesh guard, the steps-since-last target-sync cadence (a modulo goes
+off-grid under stride-K counters), and that cadence's checkpoint
+round-trip (without it, a restore would see _last_target_sync=0 and
+overwrite the restored target net up to interval-1 steps early).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
+
+
+class ReplayTrainMixin:
+    """Stride accounting for prioritized learners. Host-class contract:
+    `agent` / `state` / `timer` / `replay` / `batch_size` / `_np_rng` /
+    `target_sync_interval` / PublishCadenceMixin."""
+
+    def _init_stride(self, updates_per_call: int, mesh) -> None:
+        self.updates_per_call = max(1, int(updates_per_call))
+        if self.updates_per_call > 1 and mesh is not None:
+            raise ValueError(
+                "updates_per_call > 1 is not supported with a sharded mesh "
+                "(the weighted learn_many is single-jit only)")
+        self._last_target_sync = 0
+
+    def _finish_train_call(self) -> None:
+        """Advance counters by the call's K steps; publish and target-sync
+        on steps-since-last cadences."""
+        self.train_steps += self.updates_per_call
+        self.maybe_publish()
+        if self.train_steps - self._last_target_sync >= self.target_sync_interval:
+            self._last_target_sync = self.train_steps
+            self.state = self.agent.sync_target(self.state)
+
+    def _cadence_extra(self) -> dict:
+        """Checkpoint fields for the cadence counters."""
+        return {"last_target_sync": self._last_target_sync}
+
+    def _restore_cadence(self, extra: dict) -> None:
+        """Resume cadences; absent fields fall back to `train_steps` (next
+        sync/publish a full interval away — never an early overwrite)."""
+        self._last_target_sync = int(extra.get("last_target_sync", self.train_steps))
+        self._last_publish_step = self.train_steps  # restore just republished
+
+
+def prioritized_train_call(learner, k: int) -> dict:
+    """Run `k` prioritized updates as one scan on `learner`; returns the
+    last step's metrics (device arrays; callers float them)."""
+    soa = getattr(learner.replay, "stacked_samples", False)
+    sampled = []
+    with learner.timer.stage("replay_sample"):
+        for _ in range(k):
+            sampled.append(learner.replay.sample(learner.batch_size, learner._np_rng))
+    with learner.timer.stage("learn"):
+        if soa:
+            # SoA backend hands back already-stacked [B, ...] arrays.
+            stacked = stack_pytrees([items for items, _, _ in sampled])
+        else:
+            # AoS: one copy — stack all K*B items once, view as [K, B, ...].
+            flat = stack_pytrees([it for items, _, _ in sampled for it in items])
+            stacked = jax.tree.map(
+                lambda x: x.reshape((k, -1) + x.shape[1:]), flat)
+        weights = np.stack([np.asarray(w, np.float32) for _, _, w in sampled])
+        learner.state, prio_stack, metrics_stack = learner.agent.learn_many(
+            learner.state, stacked, weights)
+        metrics = jax.tree.map(lambda x: x[-1], metrics_stack)
+    with learner.timer.stage("replay_update"):
+        prio_stack = np.asarray(prio_stack)
+        for (_, idxs, _), prio in zip(sampled, prio_stack):
+            learner.replay.update_batch(idxs, prio)
+    return metrics

@@ -37,7 +37,7 @@ class RuntimeConfig:
     target_sync_interval: int = 100  # `train_apex.py:151-152`, `train_r2d2.py:163-164`
     train_start_factor: int = 3  # learner trains when queue > factor*batch (`train_impala.py:94`)
     publish_interval: int = 1  # IMPALA weight-publish cadence (1 = reference parity)
-    updates_per_call: int = 1  # IMPALA-family: K optimizer steps per learn_many dispatch
+    updates_per_call: int = 1  # K optimizer steps per learn_many dispatch (all families)
     seq_parallel: int = 1  # xformer: devices carving the mesh's `seq` axis
     expert_parallel: int = 1  # xformer MoE: devices carving the `expert` axis
 

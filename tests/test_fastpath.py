@@ -262,6 +262,34 @@ class TestLearnMany:
         finally:
             lc.close()
 
+    def test_apex_learner_updates_per_call_trains(self):
+        """Replay-family updates_per_call: K scanned prioritized updates
+        per train() call, priorities updated for every sampled batch."""
+        from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+        from distributed_reinforcement_learning_tpu.runtime.apex_runner import ApexLearner
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+        from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_apex_batch
+
+        cfg = ApexConfig(obs_shape=(4,), num_actions=3)
+        agent = ApexAgent(cfg)
+        queue = TrajectoryQueue(capacity=64)
+        learner = ApexLearner(agent, queue, WeightStore(), batch_size=8,
+                              replay_capacity=1000, rng=jax.random.PRNGKey(0),
+                              train_start_unrolls=1, updates_per_call=3)
+        one, _ = synthetic_apex_batch(32, cfg.obs_shape, cfg.num_actions)
+        for _ in range(4):
+            queue.put(one)
+        while learner.ingest_many(timeout=0.0):
+            pass
+        m = learner.train()
+        assert m is not None and np.isfinite(float(m["loss"]))
+        assert learner.train_steps == 3
+        m = learner.train()
+        assert m is not None
+        assert learner.train_steps == 6
+        learner.close()
+        queue.close()
+
     def test_r2d2_learn_many_matches_sequential(self):
         from tests.test_agents import make_r2d2_batch, r2d2_cfg
 
