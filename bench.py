@@ -418,9 +418,13 @@ def bench_e2e(cfg, B: int, updates: int, feeders: int = 3,
     agent = ImpalaAgent(cfg)
     queue = _make_queue(max(4 * B, 128))
     weights = WeightStore()
+    # BENCH_E2E_K>1: the learner drains K batches per learn_many dispatch
+    # (prefetcher stacks them in the background) — the co-located fast
+    # config; through the tunnel the h2d stage bounds e2e either way.
     learner = ImpalaLearner(
         agent, queue, weights, batch_size=B, prefetch=True,
-        publish_interval=publish_interval)
+        publish_interval=publish_interval,
+        updates_per_call=int(os.environ.get("BENCH_E2E_K", "1")))
     learner.timer.log_every = updates  # one flush covering the measured window
     server = None
     port = 0
@@ -466,12 +470,11 @@ def bench_e2e(cfg, B: int, updates: int, feeders: int = 3,
         learner.step(timeout=120.0)  # compile + warm the pipeline
         learner.timer.reset()  # stage means must exclude the compile step
         t0 = time.perf_counter()
-        done = 0
+        start_steps = learner.train_steps  # step() may do K>1 updates/call
         last_m = None
-        while done < updates:
+        while learner.train_steps - start_steps < updates:
             m = learner.step(timeout=120.0)
             if m is not None:
-                done += 1
                 last_m = m
         # Completion barrier: with async publication+metrics nothing else
         # syncs the host loop to the device, so the window would count
@@ -488,17 +491,19 @@ def bench_e2e(cfg, B: int, updates: int, feeders: int = 3,
             server.stop()
         for t in threads:
             t.join(timeout=5.0)
-    fps = B * cfg.trajectory * updates / dt
+    fps = B * cfg.trajectory * (learner.train_steps - start_steps) / dt
     stage_ms = dict(learner.timer.last_means_ms) or {
         n: round(1e3 * s / learner.timer._counts[n], 3)
         for n, s in learner.timer._sums.items()
     }
     stage_ms = {k: round(v, 3) for k, v in stage_ms.items()}
-    print(f"[bench] e2e[{mode}] B={B}: {updates} updates in {dt:.2f}s = "
+    done = learner.train_steps - start_steps
+    print(f"[bench] e2e[{mode}] B={B}: {done} updates in {dt:.2f}s = "
           f"{fps:,.0f} frames/s, stages {stage_ms}", file=sys.stderr)
     out = {"B": B, "mode": mode, "feeders": feeders,
            "unrolls_per_put": unrolls_per_put,
            "publish_interval": publish_interval,
+           "updates_per_call": learner.updates_per_call,
            "frames_per_s": round(fps, 1), "stage_ms": stage_ms}
     if publish_interval > 1:
         # With interval K the learn stage times dispatch only; the publish

@@ -38,6 +38,15 @@ class ReplayTrainMixin:
             raise ValueError(
                 "updates_per_call > 1 is not supported with a sharded mesh "
                 "(the weighted learn_many is single-jit only)")
+        if self.updates_per_call > self.target_sync_interval:
+            # Every scanned update inside one call trains against a frozen
+            # target net; a K that swallows whole sync intervals silently
+            # degrades replay-family dynamics (IMPALA has no target net,
+            # which is why the shared config key can carry such a K).
+            raise ValueError(
+                f"updates_per_call ({self.updates_per_call}) must not exceed "
+                f"target_sync_interval ({self.target_sync_interval}) — the "
+                "scan cannot target-sync mid-call")
         self._last_target_sync = 0
 
     def _finish_train_call(self) -> None:
@@ -68,7 +77,8 @@ def prioritized_train_call(learner, k: int) -> dict:
     with learner.timer.stage("replay_sample"):
         for _ in range(k):
             sampled.append(learner.replay.sample(learner.batch_size, learner._np_rng))
-    with learner.timer.stage("learn"):
+        # Host-side batch assembly belongs to the sample stage (the K=1
+        # path stacks there too): keep the learn stage device-only.
         if soa:
             # SoA backend hands back already-stacked [B, ...] arrays.
             stacked = stack_pytrees([items for items, _, _ in sampled])
@@ -78,6 +88,7 @@ def prioritized_train_call(learner, k: int) -> dict:
             stacked = jax.tree.map(
                 lambda x: x.reshape((k, -1) + x.shape[1:]), flat)
         weights = np.stack([np.asarray(w, np.float32) for _, _, w in sampled])
+    with learner.timer.stage("learn"):
         learner.state, prio_stack, metrics_stack = learner.agent.learn_many(
             learner.state, stacked, weights)
         metrics = jax.tree.map(lambda x: x[-1], metrics_stack)

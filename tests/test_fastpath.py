@@ -290,6 +290,50 @@ class TestLearnMany:
         learner.close()
         queue.close()
 
+    def test_r2d2_learner_updates_per_call_trains(self):
+        """Sequence-shaped replay items through prioritized_train_call."""
+        from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+        from distributed_reinforcement_learning_tpu.runtime.r2d2_runner import R2D2Learner
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+        from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Batch
+
+        cfg = R2D2Config(obs_shape=(2,), num_actions=2, seq_len=6, burn_in=2,
+                         lstm_size=16)
+        agent = R2D2Agent(cfg)
+        queue = TrajectoryQueue(capacity=64)
+        learner = R2D2Learner(agent, queue, WeightStore(), batch_size=4,
+                              replay_capacity=1000, rng=jax.random.PRNGKey(0),
+                              updates_per_call=2)
+        rng = np.random.default_rng(0)
+        T = cfg.seq_len
+        for _ in range(2 * 4 + 2):  # past the 2*batch_size warm-up gate
+            queue.put(R2D2Batch(
+                state=rng.integers(0, 255, (T, 2)).astype(np.int32),
+                previous_action=rng.integers(0, 2, T).astype(np.int32),
+                action=rng.integers(0, 2, T).astype(np.int32),
+                reward=rng.random(T).astype(np.float32),
+                done=rng.random(T) < 0.1,
+                initial_h=(rng.standard_normal(16) * 0.1).astype(np.float32),
+                initial_c=(rng.standard_normal(16) * 0.1).astype(np.float32),
+            ))
+        while learner.ingest_batch(timeout=0.0):
+            pass
+        m = learner.train()
+        assert m is not None and np.isfinite(float(m["loss"]))
+        assert learner.train_steps == 2
+        learner.close()
+        queue.close()
+
+    def test_updates_per_call_must_not_exceed_target_sync(self):
+        from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+        from distributed_reinforcement_learning_tpu.runtime.apex_runner import ApexLearner
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        with np.testing.assert_raises(ValueError):
+            ApexLearner(ApexAgent(ApexConfig(obs_shape=(4,), num_actions=2)),
+                        TrajectoryQueue(capacity=8), WeightStore(), batch_size=4,
+                        target_sync_interval=4, updates_per_call=8)
+
     def test_r2d2_learn_many_matches_sequential(self):
         from tests.test_agents import make_r2d2_batch, r2d2_cfg
 
