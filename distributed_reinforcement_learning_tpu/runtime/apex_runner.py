@@ -306,7 +306,7 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
 
 
 def run_sync(learner: ApexLearner, actors: list[ApexActor], num_updates: int,
-             actor_steps_per_round: int = 8) -> dict:
+             actor_steps_per_round: int = 8, close_learner: bool = True) -> dict:
     """Interleaved stepping for tests/single-host training."""
     metrics: dict = {}
     learner.sync_publish = True  # deterministic staleness in the sync loop
@@ -320,6 +320,7 @@ def run_sync(learner: ApexLearner, actors: list[ApexActor], num_updates: int,
             if m is not None:
                 metrics = m
     finally:
-        learner.close()
+        if close_learner:
+            learner.close()
     returns = [r for a in actors for r in a.episode_returns]
     return {"last_metrics": metrics, "episode_returns": returns}

@@ -326,6 +326,7 @@ def run_sync(
     learner: ImpalaLearner,
     actors: list[ImpalaActor],
     num_updates: int,
+    close_learner: bool = True,
 ) -> dict:
     """Deterministic interleaving: actors fill the queue, learner drains it.
 
@@ -356,7 +357,10 @@ def run_sync(
             if m is not None:
                 metrics = m
     finally:
-        learner.close()
+        # close_learner=False: chunked callers (train_local checkpoint
+        # loop) re-enter with the same learner and close it themselves.
+        if close_learner:
+            learner.close()
     returns = [r for a in actors for r in a.episode_returns]
     # On a non-publish step `metrics` holds device arrays (the interval's
     # pipelining contract); the public result is always host floats.

@@ -239,12 +239,44 @@ def build_local(agent_cfg: Any, rt: RuntimeConfig, run_dir: str | None = None, s
 
 
 def train_local(config_path: str, section: str, num_updates: int,
-                run_dir: str | None = None, seed: int = 0) -> dict:
-    """Single-process training entry used by the CLI launchers."""
+                run_dir: str | None = None, seed: int = 0,
+                checkpoint_dir: str | None = None,
+                checkpoint_interval: int = 500) -> dict:
+    """Single-process training entry used by the CLI launchers.
+
+    With `checkpoint_dir`, resumes from the latest checkpoint and saves
+    every `checkpoint_interval` updates by running the sync loop in
+    chunks (the loops target absolute `learner.train_steps`, so chunked
+    calls compose; actor episode returns persist across chunks)."""
     agent_cfg, rt = load_config(config_path, section)
     learner, actors, run_fn = build_local(agent_cfg, rt, run_dir=run_dir, seed=seed)
-    result = run_fn(learner, actors, num_updates)
-    returns = result["episode_returns"]
+    checkpoint_interval = max(1, int(checkpoint_interval))  # 0 would spin forever
+    ckpt = None
+    if checkpoint_dir:
+        from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(checkpoint_dir)
+        learner.restore_checkpoint(ckpt)
+    frames = 0
+    result: dict = {"frames": 0, "last_metrics": {}, "episode_returns": []}
+    if learner.train_steps >= num_updates:
+        # Resumed at/past the target: report, don't silently print {}.
+        result["skipped"] = (
+            f"checkpoint already at step {learner.train_steps} >= {num_updates}")
+    try:
+        while learner.train_steps < num_updates:
+            target = (num_updates if ckpt is None else
+                      min(learner.train_steps + checkpoint_interval, num_updates))
+            # close_learner=False: this loop owns the learner across chunks.
+            result = run_fn(learner, actors, target, close_learner=False)
+            frames += result.get("frames", 0)
+            if ckpt is not None:
+                learner.save_checkpoint(ckpt)
+    finally:
+        learner.close()
+    if "frames" in result:
+        result["frames"] = frames
+    returns = result.get("episode_returns", [])
     if returns:
         import numpy as np
 

@@ -286,7 +286,8 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
         self._profiler.close()
 
 
-def run_sync(learner: R2D2Learner, actors: list[R2D2Actor], num_updates: int) -> dict:
+def run_sync(learner: R2D2Learner, actors: list[R2D2Actor], num_updates: int,
+             close_learner: bool = True) -> dict:
     metrics: dict = {}
     learner.sync_publish = True  # deterministic staleness in the sync loop
     try:
@@ -298,6 +299,7 @@ def run_sync(learner: R2D2Learner, actors: list[R2D2Actor], num_updates: int) ->
             if m is not None:
                 metrics = m
     finally:
-        learner.close()
+        if close_learner:
+            learner.close()
     returns = [r for a in actors for r in a.episode_returns]
     return {"last_metrics": metrics, "episode_returns": returns}

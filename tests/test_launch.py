@@ -88,3 +88,32 @@ def test_launch_local_cluster_smoke():
         raise
     assert proc.returncode == 0, out[-2000:] + err[-500:]
     assert "done: 6 updates" in out
+
+
+def test_train_local_checkpoints_and_evaluate(tmp_path, monkeypatch):
+    """Local-mode chunked checkpointing + the standalone evaluator."""
+    import json
+    import sys
+
+    from distributed_reinforcement_learning_tpu.runtime.launch import train_local
+
+    ckpt_dir = tmp_path / "ckpts"
+    result = train_local("config.json", "impala_cartpole", num_updates=4,
+                         checkpoint_dir=str(ckpt_dir), checkpoint_interval=2)
+    assert result["frames"] == 4 * 16 * 16
+    steps = sorted(int(p.stem.split("_")[1]) for p in ckpt_dir.glob("ckpt_*.msgpack"))
+    assert steps == [2, 4]
+
+    sys.path.insert(0, "scripts")
+    import evaluate as eval_mod
+
+    monkeypatch.setattr(sys, "argv", [
+        "evaluate.py", "--section", "impala_cartpole", "--checkpoint_dir",
+        str(ckpt_dir), "--episodes", "2", "--max_unrolls", "200"])
+    printed = []
+    monkeypatch.setattr("builtins.print", lambda *a, **k: printed.append(a[0]))
+    eval_mod.main()
+    out = json.loads(printed[-1])
+    assert out["checkpoint_step"] == 4
+    assert out["episodes"] == 2
+    assert out["return_mean"] > 0

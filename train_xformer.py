@@ -43,7 +43,9 @@ def main() -> None:
         from distributed_reinforcement_learning_tpu.runtime.launch import train_local
 
         result = train_local(args.config, args.section, args.updates,
-                             run_dir=args.run_dir, seed=args.seed)
+                             run_dir=args.run_dir, seed=args.seed,
+                             checkpoint_dir=args.checkpoint_dir,
+                             checkpoint_interval=args.checkpoint_interval)
         print({k: v for k, v in result.items() if k != "episode_returns"})
     else:
         from distributed_reinforcement_learning_tpu.runtime.transport import run_role
