@@ -298,6 +298,62 @@ def bench_learn_scan(cfg, B: int, K: int, iters: int) -> dict:
     return out
 
 
+def bench_anakin(num_envs: int, chunk: int, iters: int) -> dict:
+    """Fully on-device IMPALA (the Podracer 'Anakin' pattern,
+    runtime/anakin.py): env step + act + trajectory buffer + optimizer
+    update all inside ONE compiled scan over the pure-JAX CartPole.
+    Zero host round-trips and zero H2D per update — the configuration
+    that answers 'can the pipeline feed the chip' by deleting the
+    pipeline. frames/s here are env frames collected AND learned on."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+    from distributed_reinforcement_learning_tpu.runtime.anakin import AnakinImpala
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    cfg = ImpalaConfig(obs_shape=(4,), num_actions=2, trajectory=16,
+                       lstm_size=256, start_learning_rate=5e-3,
+                       end_learning_rate=5e-3, entropy_coef=0.01,
+                       baseline_loss_coef=0.5, learning_frame=10**9,
+                       dtype=jnp.bfloat16 if on_accel else jnp.float32)
+    anakin = AnakinImpala(ImpalaAgent(cfg), num_envs=num_envs)
+    state = anakin.init(jax.random.PRNGKey(0))
+
+    t0 = time.perf_counter()
+    state, m = anakin.train_chunk(state, chunk)
+    float(m["total_loss"][-1])
+    compile_s = time.perf_counter() - t0
+    box = {"state": state}
+
+    def window(n):
+        t0 = time.perf_counter()
+        state = box["state"]
+        for _ in range(n):
+            state, m = anakin.train_chunk(state, chunk)
+        box["ret_sum"] = float(m["episode_return_sum"].sum())
+        box["eps"] = float(m["episodes_done"].sum())
+        box["state"] = state
+        return time.perf_counter() - t0
+
+    call_s, stats = _marginal_step_s(window, iters)
+    update_s = call_s / chunk
+    frames = num_envs * cfg.trajectory
+    out = {
+        "num_envs": num_envs, "trajectory": cfg.trajectory, "chunk": chunk,
+        "updates_per_s": round(1.0 / update_s, 1),
+        "frames_per_s": round(frames / update_s, 1),
+        "compile_s": round(compile_s, 1), "timing": stats,
+        "last_chunk_mean_return": round(
+            box.get("ret_sum", 0.0) / max(box.get("eps", 0.0), 1.0), 1),
+    }
+    print(f"[bench] anakin B={num_envs}: {1e3*update_s:.3f}ms/update = "
+          f"{frames / update_s:,.0f} on-device frames/s "
+          f"(iqr {stats['iqr_rel']:.0%}, mean return "
+          f"{out['last_chunk_mean_return']})", file=sys.stderr)
+    return out
+
+
 def _pad_util(n: int, q: int = 128) -> float:
     """Fraction of a q-wide MXU dimension a size-n operand actually fills."""
     import math
@@ -1315,6 +1371,16 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["ingest"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] ingest failed: {e}", file=sys.stderr)
+
+    if os.environ.get("BENCH_ANAKIN", "1" if on_accel else "0") == "1":
+        try:
+            extra["anakin"] = bench_anakin(
+                int(os.environ.get("BENCH_ANAKIN_ENVS", "1024")),
+                int(os.environ.get("BENCH_ANAKIN_CHUNK", "100")),
+                max(iters // 30, 3))
+        except Exception as e:  # noqa: BLE001
+            extra["anakin"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] anakin failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_LONG_CONTEXT", "1" if on_accel else "0") == "1":
         try:

@@ -1,0 +1,132 @@
+"""Anakin-style fully-on-device IMPALA: collect + learn inside one jit.
+
+The reference's architecture (and this repo's runner topology) moves
+trajectories host->queue->device every step. The Podracer "Anakin"
+pattern (arXiv:2104.06272) removes the host entirely for jittable envs:
+the env step, the act step, the trajectory buffer, and the optimizer
+update all live inside ONE compiled program — `train_chunk` runs U
+updates x T env steps x B envs per dispatch with zero host round-trips
+and zero H2D traffic. This is the configuration the TPU makes possible
+and a process-per-actor design cannot express; it complements (not
+replaces) the socket topology, which exists for envs that aren't pure
+functions (ALE, robotics).
+
+Semantics per update, matching `runtime/impala_runner.py`:
+- on-policy collection with the CURRENT params (behavior == target
+  policy, so V-trace's importance ratios are exactly 1 — the off-policy
+  correction margin exists for the distributed topology's staleness);
+- stored-state LSTM: each timestep records the pre-act (h, c), the
+  learner re-applies from those (SURVEY §2 rows 2/12);
+- (h, c) zeroed and prev_action reset at episode boundaries.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.agents.common import TrainState
+from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaBatch
+from distributed_reinforcement_learning_tpu.envs import cartpole_jax
+
+
+class AnakinState(NamedTuple):
+    train: TrainState
+    env: cartpole_jax.CartPoleState
+    obs: jax.Array  # [B, 4]
+    prev_action: jax.Array  # [B] i32
+    h: jax.Array  # [B, H]
+    c: jax.Array  # [B, H]
+    rng: jax.Array
+
+
+class AnakinImpala:
+    """IMPALA over the pure-JAX CartPole, everything on-device.
+
+    `num_envs` is the batch dim B; `agent.cfg.trajectory` the unroll T.
+    """
+
+    def __init__(self, agent: ImpalaAgent, num_envs: int):
+        if agent.cfg.obs_shape != cartpole_jax.OBS_SHAPE:
+            raise ValueError(
+                f"AnakinImpala runs the JAX CartPole (obs {cartpole_jax.OBS_SHAPE}); "
+                f"config has obs_shape={agent.cfg.obs_shape}")
+        self.agent = agent
+        self.num_envs = num_envs
+        # No donation: the freshly-init state's zero-filled leaves (env
+        # counters, LSTM state, prev_action) can alias one deduped
+        # constant buffer, which donation rejects; the state is small
+        # (CartPole MLP+LSTM), so the copy is noise.
+        self.train_chunk = jax.jit(self._train_chunk, static_argnums=(1,))
+
+    def init(self, rng: jax.Array) -> AnakinState:
+        # Three distinct streams: params init, env reset, and the ongoing
+        # rollout chain (reusing the parent key would make the first act
+        # key collide with the env-reset key under partitionable threefry).
+        k_train, k_env, k_run = jax.random.split(rng, 3)
+        train = self.agent.init_state(k_train)
+        env, obs = cartpole_jax.reset(k_env, self.num_envs)
+        h, c = self.agent.initial_lstm_state(self.num_envs)
+        return AnakinState(
+            train=train,
+            env=env,
+            obs=obs,
+            prev_action=jnp.zeros(self.num_envs, jnp.int32),
+            h=h,
+            c=c,
+            rng=k_run,
+        )
+
+    # -- one env step (scanned T times per update) -----------------------
+    def _env_step(self, params, carry, _):
+        env, obs, prev_action, h, c, rng = carry
+        rng, k_act, k_env = jax.random.split(rng, 3)
+        out = self.agent._act(params, obs, prev_action, h, c, k_act)
+        env, next_obs, reward, done, ep_ret = cartpole_jax.step(env, out.action, k_env)
+        record = dict(
+            state=obs,
+            reward=reward,
+            done=done,
+            action=out.action,
+            behavior_policy=out.policy,
+            previous_action=prev_action,
+            initial_h=h,
+            initial_c=c,
+            episode_return=ep_ret,
+        )
+        keep = (~done).astype(out.h.dtype)[:, None]
+        carry = (env, next_obs, jnp.where(done, 0, out.action).astype(jnp.int32),
+                 out.h * keep, out.c * keep, rng)
+        return carry, record
+
+    # -- one update: T-step collect then learn ---------------------------
+    def _update(self, state: AnakinState, _):
+        T = self.agent.cfg.trajectory
+        carry = (state.env, state.obs, state.prev_action, state.h, state.c, state.rng)
+        carry, rec = jax.lax.scan(
+            functools.partial(self._env_step, state.train.params), carry, None, length=T)
+        env, obs, prev_action, h, c, rng = carry
+        # rec fields are [T, B, ...]; the learner wants [B, T, ...].
+        bt = lambda name: jnp.swapaxes(rec[name], 0, 1)
+        batch = ImpalaBatch(
+            state=bt("state"),
+            reward=bt("reward"),
+            action=bt("action"),
+            done=bt("done"),
+            behavior_policy=bt("behavior_policy"),
+            previous_action=bt("previous_action"),
+            initial_h=bt("initial_h"),
+            initial_c=bt("initial_c"),
+        )
+        train, metrics = self.agent._learn(state.train, batch)
+        metrics["episode_return_sum"] = rec["episode_return"].sum()
+        metrics["episodes_done"] = rec["done"].sum().astype(jnp.float32)
+        new_state = AnakinState(train, env, obs, prev_action, h, c, rng)
+        return new_state, metrics
+
+    def _train_chunk(self, state: AnakinState, num_updates: int):
+        """U updates in one compiled program -> (state, stacked metrics)."""
+        return jax.lax.scan(self._update, state, None, length=num_updates)

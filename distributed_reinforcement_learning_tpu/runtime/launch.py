@@ -238,6 +238,53 @@ def build_local(agent_cfg: Any, rt: RuntimeConfig, run_dir: str | None = None, s
     return learner, actors, _RUN_SYNC[algo]
 
 
+def train_anakin(config_path: str, section: str, num_updates: int,
+                 chunk: int = 50, seed: int = 0, num_envs: int | None = None,
+                 checkpoint_dir: str | None = None) -> dict:
+    """Fully on-device IMPALA training (runtime/anakin.py): jittable-env
+    sections only (CartPole-family). Collect + learn run as compiled
+    chunks of `chunk` updates; per-chunk mean episode returns stream to
+    stdout. No queue, no transport, no host loop. `checkpoint_dir`
+    saves/restores the TrainState per chunk (env/LSTM state is
+    ephemeral: a resume starts fresh episodes, same as every
+    actor restart in the distributed topology)."""
+    import numpy as np
+
+    agent_cfg, rt = load_config(config_path, section)
+    if _algo_of(agent_cfg) != "impala":
+        raise ValueError("anakin mode currently runs the IMPALA family")
+    from distributed_reinforcement_learning_tpu.runtime.anakin import AnakinImpala
+
+    agent = ImpalaAgent(agent_cfg)
+    anakin = AnakinImpala(agent, num_envs or rt.num_actors * rt.envs_per_actor)
+    state = anakin.init(jax.random.PRNGKey(seed))
+    ckpt = None
+    if checkpoint_dir:
+        from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(checkpoint_dir)
+        got = ckpt.restore(state.train)
+        if got is not None:
+            state = state._replace(train=got[0])
+    chunk = max(1, min(chunk, num_updates))
+    returns = []
+    while int(state.train.step) < num_updates:
+        u = min(chunk, num_updates - int(state.train.step))
+        state, m = anakin.train_chunk(state, u)
+        eps = float(np.asarray(m["episodes_done"]).sum())
+        mean_ret = float(np.asarray(m["episode_return_sum"]).sum()) / max(eps, 1.0)
+        returns.append(mean_ret)
+        print(f"[anakin] step {int(state.train.step)}: mean_return {mean_ret:.1f} "
+              f"({eps:.0f} episodes, loss {float(m['total_loss'][-1]):.2f})")
+        if ckpt is not None:
+            ckpt.save(int(state.train.step), state.train, {})
+    return {
+        "frames": int(state.train.step) * anakin.num_envs * agent_cfg.trajectory,
+        "chunk_mean_returns": [round(r, 2) for r in returns],
+        "mean_return_last_chunk": round(returns[-1], 2) if returns else None,
+    }
+
+
 def train_local(config_path: str, section: str, num_updates: int,
                 run_dir: str | None = None, seed: int = 0,
                 checkpoint_dir: str | None = None,

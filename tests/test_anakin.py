@@ -1,0 +1,99 @@
+"""Anakin (fully on-device) IMPALA tests: env parity, mechanics, learning."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+from distributed_reinforcement_learning_tpu.envs import cartpole_jax
+from distributed_reinforcement_learning_tpu.envs.cartpole import VectorCartPole, _physics_step
+from distributed_reinforcement_learning_tpu.runtime.anakin import AnakinImpala
+
+
+def anakin_cfg(**kw):
+    base = dict(obs_shape=(4,), num_actions=2, trajectory=16, lstm_size=32,
+                start_learning_rate=5e-3, end_learning_rate=5e-3,
+                entropy_coef=0.01, baseline_loss_coef=0.5, learning_frame=10**9)
+    base.update(kw)
+    return ImpalaConfig(**base)
+
+
+class TestCartPoleJax:
+    def test_physics_matches_numpy_env(self):
+        """One dynamics step == the numpy env's float64 step (f32 tol)."""
+        rng = np.random.default_rng(0)
+        phys = rng.uniform(-0.05, 0.05, (7, 4))
+        actions = rng.integers(0, 2, 7)
+        expect = _physics_step(phys, actions)
+        state = cartpole_jax.CartPoleState(
+            physics=jnp.asarray(phys, jnp.float32),
+            steps=jnp.zeros(7, jnp.int32),
+            returns=jnp.zeros(7, jnp.float32),
+        )
+        new_state, obs, reward, done, ep = cartpole_jax.step(
+            state, jnp.asarray(actions), jax.random.PRNGKey(1))
+        assert not bool(done.any())  # tiny states terminate nothing in 1 step
+        np.testing.assert_allclose(np.asarray(new_state.physics), expect,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(reward), np.ones(7, np.float32))
+
+    def test_auto_reset_and_episode_returns(self):
+        """A forced out-of-bounds cart resets with its return surfaced."""
+        phys = np.zeros((3, 4), np.float32)
+        phys[1, 0] = 5.0  # |x| > 2.4 after one step
+        state = cartpole_jax.CartPoleState(
+            physics=jnp.asarray(phys),
+            steps=jnp.full(3, 9, jnp.int32),
+            returns=jnp.full(3, 9.0, jnp.float32),
+        )
+        new_state, obs, reward, done, ep = cartpole_jax.step(
+            state, jnp.zeros(3, jnp.int32), jax.random.PRNGKey(2))
+        assert bool(done[1]) and not bool(done[0]) and not bool(done[2])
+        assert float(ep[1]) == 10.0 and float(ep[0]) == 0.0
+        assert int(new_state.steps[1]) == 0
+        assert abs(float(new_state.physics[1, 0])) <= 0.05  # fresh cart
+        assert int(new_state.steps[0]) == 10
+
+    def test_episode_length_cap(self):
+        env = VectorCartPole(1)  # semantics source: 200-step v0 cap
+        assert env._max_steps == 200
+        state = cartpole_jax.CartPoleState(
+            physics=jnp.zeros((1, 4)),
+            steps=jnp.asarray([199], jnp.int32),
+            returns=jnp.asarray([199.0], jnp.float32),
+        )
+        _, _, _, done, ep = cartpole_jax.step(
+            state, jnp.zeros(1, jnp.int32), jax.random.PRNGKey(0))
+        assert bool(done[0]) and float(ep[0]) == 200.0
+
+
+class TestAnakinImpala:
+    def test_chunk_mechanics(self):
+        anakin = AnakinImpala(ImpalaAgent(anakin_cfg()), num_envs=4)
+        st = anakin.init(jax.random.PRNGKey(0))
+        st, m = anakin.train_chunk(st, 3)
+        assert int(st.train.step) == 3
+        assert m["total_loss"].shape == (3,)
+        assert np.isfinite(np.asarray(m["total_loss"])).all()
+        # Same compiled program serves subsequent chunks.
+        st, _ = anakin.train_chunk(st, 3)
+        assert int(st.train.step) == 6
+
+    def test_rejects_non_cartpole_obs(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            AnakinImpala(ImpalaAgent(anakin_cfg(obs_shape=(84, 84, 4))), 4)
+
+    def test_learns_cartpole_on_device(self):
+        """On-device collect+learn reaches the same learning bar as the
+        host-loop e2e test (tests/test_e2e.py: late return > 60 vs ~20
+        random) in ~300 updates."""
+        anakin = AnakinImpala(ImpalaAgent(anakin_cfg()), num_envs=16)
+        st = anakin.init(jax.random.PRNGKey(0))
+        st, _ = anakin.train_chunk(st, 250)  # burn-in
+        st, m = anakin.train_chunk(st, 50)  # measure the late window
+        episodes = float(m["episodes_done"].sum())
+        mean_return = float(m["episode_return_sum"].sum()) / max(episodes, 1.0)
+        assert episodes > 0
+        assert mean_return > 60, f"late mean return {mean_return}"

@@ -117,3 +117,12 @@ def test_train_local_checkpoints_and_evaluate(tmp_path, monkeypatch):
     assert out["checkpoint_step"] == 4
     assert out["episodes"] == 2
     assert out["return_mean"] > 0
+
+
+def test_train_anakin_entry():
+    """CLI-level anakin path: chunked on-device training from a config."""
+    from distributed_reinforcement_learning_tpu.runtime.launch import train_anakin
+
+    r = train_anakin("config.json", "impala_cartpole", num_updates=4, chunk=2)
+    assert r["frames"] == 4 * 16 * 16
+    assert len(r["chunk_mean_returns"]) == 2

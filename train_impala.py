@@ -24,7 +24,8 @@ def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", default="config.json")
     p.add_argument("--section", default="impala")
-    p.add_argument("--mode", default="local", choices=["local", "learner", "actor"])
+    p.add_argument("--mode", default="local",
+                   choices=["local", "learner", "actor", "anakin"])
     p.add_argument("--task", type=int, default=-1, help="actor index (actor mode)")
     p.add_argument("--updates", type=int, default=1000)
     p.add_argument("--run_dir", default=None)
@@ -50,6 +51,13 @@ def main() -> None:
         import jax
         jax.config.update("jax_platforms", platform)
 
+    if args.mode == "anakin":
+        # Fully on-device collect+learn (jittable envs; runtime/anakin.py).
+        from distributed_reinforcement_learning_tpu.runtime.launch import train_anakin
+
+        print(train_anakin(args.config, args.section, args.updates, seed=args.seed,
+                           checkpoint_dir=args.checkpoint_dir))
+        return
     if args.mode == "local":
         from distributed_reinforcement_learning_tpu.runtime.launch import train_local
 
