@@ -49,18 +49,49 @@ class AnakinImpala:
     `num_envs` is the batch dim B; `agent.cfg.trajectory` the unroll T.
     """
 
-    def __init__(self, agent: ImpalaAgent, num_envs: int):
+    def __init__(self, agent: ImpalaAgent, num_envs: int, mesh=None):
         if agent.cfg.obs_shape != cartpole_jax.OBS_SHAPE:
             raise ValueError(
                 f"AnakinImpala runs the JAX CartPole (obs {cartpole_jax.OBS_SHAPE}); "
                 f"config has obs_shape={agent.cfg.obs_shape}")
         self.agent = agent
         self.num_envs = num_envs
+        self.mesh = mesh
         # No donation: the freshly-init state's zero-filled leaves (env
         # counters, LSTM state, prev_action) can alias one deduped
         # constant buffer, which donation rejects; the state is small
         # (CartPole MLP+LSTM), so the copy is noise.
-        self.train_chunk = jax.jit(self._train_chunk, static_argnums=(1,))
+        if mesh is None:
+            self.train_chunk = jax.jit(self._train_chunk, static_argnums=(1,))
+        else:
+            # Multi-chip Anakin: envs shard over the `data` axis (each
+            # chip steps + acts on its env shard), the TrainState follows
+            # the structural mesh rule (replicated, or model-sharded
+            # kernels) — XLA inserts the gradient psum over ICI. Same
+            # program, N chips, no host between them.
+            from distributed_reinforcement_learning_tpu.parallel import (
+                data_sharding, replicated)
+            from distributed_reinforcement_learning_tpu.parallel.learner import (
+                train_state_sharding)
+
+            data = data_sharding(mesh)
+            repl = replicated(mesh)
+            if num_envs % mesh.shape.get("data", 1) != 0:
+                raise ValueError(
+                    f"num_envs ({num_envs}) must divide over the data axis "
+                    f"({mesh.shape.get('data', 1)})")
+            abstract = jax.eval_shape(agent.init_state, jax.random.PRNGKey(0))
+            train_sh = train_state_sharding(mesh, abstract)
+            self._state_sharding = AnakinState(
+                train=train_sh,
+                env=cartpole_jax.CartPoleState(physics=data, steps=data, returns=data),
+                obs=data, prev_action=data, h=data, c=data, rng=repl,
+            )
+            self.train_chunk = jax.jit(
+                self._train_chunk, static_argnums=(1,),
+                in_shardings=(self._state_sharding,),
+                out_shardings=(self._state_sharding, repl),
+            )
 
     def init(self, rng: jax.Array) -> AnakinState:
         # Three distinct streams: params init, env reset, and the ongoing
@@ -70,7 +101,7 @@ class AnakinImpala:
         train = self.agent.init_state(k_train)
         env, obs = cartpole_jax.reset(k_env, self.num_envs)
         h, c = self.agent.initial_lstm_state(self.num_envs)
-        return AnakinState(
+        state = AnakinState(
             train=train,
             env=env,
             obs=obs,
@@ -79,6 +110,9 @@ class AnakinImpala:
             c=c,
             rng=k_run,
         )
+        if self.mesh is not None:
+            state = jax.device_put(state, self._state_sharding)
+        return state
 
     # -- one env step (scanned T times per update) -----------------------
     def _env_step(self, params, carry, _):
