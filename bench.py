@@ -1287,6 +1287,13 @@ def main() -> None:
 
     try:
         extra["roofline"] = impala_roofline(cfg, best["B"], best["step_ms"] / 1e3)
+        scan = extra.get("learn_scan", {})
+        if scan.get("step_ms", 0) > 0 and "attainable_step_ms" in extra["roofline"]:
+            # The scan-measured step is the honest device time (no
+            # dispatch gap), so this is the truer attainable fraction.
+            extra["roofline"]["scan_measured_step_ms"] = scan["step_ms"]
+            extra["roofline"]["mfu_attainable_scan"] = round(
+                extra["roofline"]["attainable_step_ms"] / scan["step_ms"], 3)
     except Exception as e:  # noqa: BLE001
         extra["roofline"] = {"error": f"{type(e).__name__}: {e}"}
 
