@@ -266,8 +266,18 @@ def bench_learn_scan(cfg, B: int, K: int, iters: int) -> dict:
 
     agent = ImpalaAgent(cfg)
     state = agent.init_state(jax.random.PRNGKey(0))
-    one = _make_batch(cfg, B)
-    stacked = jax.device_put(jax.tree.map(lambda x: np.stack([np.asarray(x)] * K), one))
+    # K DISTINCT batches (different seeds): the scanned steps see fresh
+    # data like a real learner would, so the loss window is representative
+    # — not K updates on one batch (advisor r3 finding).
+    from distributed_reinforcement_learning_tpu.utils.synthetic import synthetic_impala_batch
+
+    distinct = [synthetic_impala_batch(B, cfg.trajectory, cfg.obs_shape,
+                                       cfg.num_actions, cfg.lstm_size,
+                                       seed=k, uniform_behavior=False)
+                for k in range(K)]
+    one = distinct[0]  # _analytic_flops sees the same shapes the scan times
+    stacked = jax.device_put(
+        jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *distinct))
 
     t0 = time.perf_counter()
     state, m = agent.learn_many(state, stacked)

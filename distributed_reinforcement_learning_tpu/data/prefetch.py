@@ -21,12 +21,15 @@ win).
 
 from __future__ import annotations
 
+import logging
 import queue as _queue
 import threading
 import time
 from typing import Any, Callable
 
 import jax
+
+_log = logging.getLogger(__name__)
 
 
 class DevicePrefetcher:
@@ -65,6 +68,7 @@ class DevicePrefetcher:
                 "stack_calls > 1 over a mesh needs stack_sharding "
                 "(a [K, B, ...] spec with the batch dim on the data axis)")
         self._out: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
+        self.dropped_batches = 0  # dequeued-but-untrained batches lost at stop
         self._error: BaseException | None = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -80,6 +84,14 @@ class DevicePrefetcher:
             # actors: record the failure so get_batch re-raises it instead
             # of the learner polling timeouts forever.
             self._error = e
+
+    def _note_dropped(self, parts: list) -> None:
+        # Stop/close arriving mid-stack drops the already-dequeued partial
+        # stack (acceptable at shutdown, but make it visible — advisor r3).
+        if parts:
+            self.dropped_batches += len(parts)
+            _log.info("prefetch stopped mid-stack: dropped %d "
+                      "dequeued-but-untrained batches", len(parts))
 
     def _loop_inner(self) -> None:
         # Pooled dequeue: the source hands back REUSED host arrays (no
@@ -107,17 +119,20 @@ class DevicePrefetcher:
                         batch = self.source.get_batch(self.batch_size, timeout=0.2)
                 except RuntimeError:
                     if getattr(self.source, "closed", False):
-                        return  # orderly shutdown
+                        self._note_dropped(parts)  # orderly shutdown
+                        return
                     raise  # genuine failure: record via _loop, don't die silently
                 if batch is None:
                     # A closed+drained source returns None instantly — exit
                     # rather than hot-spin on it (closed is sticky).
                     if getattr(self.source, "closed", False):
+                        self._note_dropped(parts)
                         return
                     continue
                 parts.append(batch)
             if len(parts) < self.stack_calls:
-                return  # stopped mid-stack
+                self._note_dropped(parts)  # stopped mid-stack
+                return
             if self.stack_calls > 1:
                 from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
 

@@ -309,11 +309,12 @@ def run_sync(learner: ApexLearner, actors: list[ApexActor], num_updates: int,
              actor_steps_per_round: int = 8, close_learner: bool = True) -> dict:
     """Interleaved stepping for tests/single-host training."""
     metrics: dict = {}
+    frames = 0
     learner.sync_publish = True  # deterministic staleness in the sync loop
     try:
         while learner.train_steps < num_updates:
             for actor in actors:
-                actor.run_steps(actor_steps_per_round)
+                frames += actor.run_steps(actor_steps_per_round)
             while learner.ingest_many(timeout=0.0):
                 pass
             m = learner.train()
@@ -323,4 +324,4 @@ def run_sync(learner: ApexLearner, actors: list[ApexActor], num_updates: int,
         if close_learner:
             learner.close()
     returns = [r for a in actors for r in a.episode_returns]
-    return {"last_metrics": metrics, "episode_returns": returns}
+    return {"frames": frames, "last_metrics": metrics, "episode_returns": returns}

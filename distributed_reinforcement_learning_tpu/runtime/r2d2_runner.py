@@ -289,11 +289,12 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
 def run_sync(learner: R2D2Learner, actors: list[R2D2Actor], num_updates: int,
              close_learner: bool = True) -> dict:
     metrics: dict = {}
+    frames = 0
     learner.sync_publish = True  # deterministic staleness in the sync loop
     try:
         while learner.train_steps < num_updates:
             for actor in actors:
-                actor.run_unroll()
+                frames += actor.run_unroll()
             learner.ingest_batch(timeout=0.0)
             m = learner.train()
             if m is not None:
@@ -302,4 +303,4 @@ def run_sync(learner: R2D2Learner, actors: list[R2D2Actor], num_updates: int,
         if close_learner:
             learner.close()
     returns = [r for a in actors for r in a.episode_returns]
-    return {"last_metrics": metrics, "episode_returns": returns}
+    return {"frames": frames, "last_metrics": metrics, "episode_returns": returns}

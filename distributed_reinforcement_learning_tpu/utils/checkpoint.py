@@ -201,16 +201,21 @@ class Checkpointer:
         data = payload.read_bytes()
         try:
             state = serialization.from_bytes(template, data)
-        except (ValueError, KeyError):
+        except (ValueError, KeyError) as e:
             # Layout migration: pre-r3 image models nested conv params as
             # nn.Conv's `Conv_{i}/{kernel,bias}`; the explicit NatureConv
             # layout (models/torso.py) flattens them. Retry the restore
-            # through the upgrade map before giving up.
+            # through the upgrade map before giving up — chained to the
+            # original error so a genuinely corrupt checkpoint surfaces
+            # both failures, not just the retry's.
             from distributed_reinforcement_learning_tpu.models.torso import (
                 upgrade_nature_conv_params)
 
-            raw = upgrade_nature_conv_params(serialization.msgpack_restore(data))
-            state = serialization.from_state_dict(template, raw)
+            try:
+                raw = upgrade_nature_conv_params(serialization.msgpack_restore(data))
+                state = serialization.from_state_dict(template, raw)
+            except Exception as retry_err:
+                raise retry_err from e
         extra_path = self._extra_path(step)
         extra = json.loads(extra_path.read_text()) if extra_path.exists() else {}
         return state, extra, step

@@ -35,6 +35,32 @@ class TestReferenceSchema:
         assert agent_cfg.num_actions >= 2
         assert rt.num_actors == len(rt.envs) == len(rt.available_action)
 
+    def test_vestigial_keys_accepted(self, tmp_path):
+        """Unknown/vestigial reference keys (`config.json:66,105`
+        `optimization_method`) load-and-ignore rather than erroring."""
+        path = _write(tmp_path, "impala", {
+            "model_input": [84, 84, 4], "model_output": 4,
+            "env": ["BreakoutDeterministic-v4"], "available_action": [4],
+            "num_actors": 1,
+            "optimization_method": "impala",        # vestigial in the reference
+            "some_future_key": {"nested": True},    # arbitrary unknowns too
+        })
+        cfg, rt = load_config(path, "impala")
+        assert cfg.num_actions == 4 and rt.algorithm == "impala"
+
+    def test_reference_config_loads_unmodified(self):
+        """The reference's own config.json (all three sections) loads
+        verbatim through this config system (`/root/reference/config.json`)."""
+        ref = "/root/reference/config.json"
+        import os
+        if not os.path.exists(ref):
+            pytest.skip("reference tree not present on this host")
+        for section, algo in (("impala", "impala"), ("apex", "apex"),
+                              ("r2d2", "r2d2")):
+            agent_cfg, rt = load_config(ref, section)
+            assert rt.algorithm == algo
+            assert agent_cfg.num_actions >= 2
+
 
 class TestExtensionKnobs:
     def test_xformer_parallelism_knobs_flow(self, tmp_path):
