@@ -9,13 +9,15 @@ The reference resolves env names via `gym.make` (`train_impala.py:117`,
   write; set `DRL_NO_GYMNASIUM=1` to force the in-tree numpy physics
   (tests use it for determinism, and it is the automatic fallback);
 - Atari names (`*Deterministic-v4`, `*NoFrameskip-v4`) use gymnasium +
-  `ale-py` when the emulator is importable; otherwise `Breakout*` falls
-  back to the in-tree Breakout simulator (real game dynamics at ALE
-  specs, through the same GymnasiumRawFrames adapter — envs/breakout_sim)
-  and other titles fall back to the full preprocessing pipeline over
-  `SyntheticAtari`. Both fallbacks say so on stderr, once per name,
-  because training "Breakout" on a stand-in silently is how a benchmark
-  lies (`DRL_SYNTHETIC_ATARI=1` opts into silence).
+  `ale-py` when the emulator is importable; otherwise `Breakout*` and
+  `Pong*` fall back to the in-tree simulators (real game dynamics at ALE
+  specs, through the same GymnasiumRawFrames adapter —
+  envs/breakout_sim, envs/pong_sim; Pong adapts without fire-reset, the
+  reference's `make_uint8_env_no_fire` path) and other titles fall back
+  to the full preprocessing pipeline over `SyntheticAtari`. All
+  fallbacks say so on stderr, once per name, because training
+  "Breakout" on a stand-in silently is how a benchmark lies
+  (`DRL_SYNTHETIC_ATARI=1` opts into silence).
 """
 
 from __future__ import annotations
@@ -87,6 +89,29 @@ def make_env(name: str, seed: int = 0, num_actions: int = 18) -> Env:
                             else "BreakoutSim-v0")
                 return AtariPreprocessor(GymnasiumRawFrames(sim_name, seed=seed))
             return AtariPreprocessor(breakout_sim.BreakoutSimRaw(seed=seed, frameskip=skip))
+        if name.startswith("Pong"):
+            # Second faithful game (envs/pong_sim): 6-action set, signed
+            # rewards, no lives. Adapted WITHOUT fire-reset — the
+            # reference's `make_uint8_env_no_fire` path
+            # (`wrappers.py:132-138`); serves are FIRE or auto.
+            from distributed_reinforcement_learning_tpu.envs import pong_sim
+
+            if name not in _warned_synthetic and os.environ.get("DRL_SYNTHETIC_ATARI") != "1":
+                _warned_synthetic.add(name)
+                print(f"[envs] WARNING: no ALE emulator available; {name!r} resolves "
+                      f"to the in-tree Pong simulator (real game dynamics, not "
+                      f"the 2600 ROM). Install ale-py for the real game.",
+                      file=sys.stderr)
+            skip = 4 if "Deterministic" in name else 1
+            if _use_gymnasium() and pong_sim.register_gymnasium():
+                from distributed_reinforcement_learning_tpu.envs.gymnasium_env import GymnasiumRawFrames
+
+                sim_name = ("PongSimDeterministic-v0" if skip == 4
+                            else "PongSim-v0")
+                return AtariPreprocessor(GymnasiumRawFrames(sim_name, seed=seed),
+                                         fire_reset=False)
+            return AtariPreprocessor(pong_sim.PongSimRaw(seed=seed, frameskip=skip),
+                                     fire_reset=False)
         # Synthetic frames through the real preprocessing pipeline (same
         # shapes/dtypes/life semantics).
         if name not in _warned_synthetic and os.environ.get("DRL_SYNTHETIC_ATARI") != "1":
