@@ -240,13 +240,28 @@ class SequenceReplayLearnMixin:
     -> (target_value, sav) — optionally with a third scalar model aux
     loss (e.g. the MoE router's load-balancing term), added to the TD
     loss as-is — and `self.tx`. Loss = IS-weighted mean over time of
-    squared TD (`agent/r2d2.py:88-89`); priority = |mean TD| per
-    sequence (`agent/r2d2.py:151-153`).
+    squared TD (`agent/r2d2.py:88-89`).
+
+    Priority: the reference's quirk |mean_t TD| (`agent/r2d2.py:151-153`
+    — signed TDs cancel across the sequence, so a high-error sequence
+    can score ~0 and starve) is the default for parity. Setting
+    `cfg.priority_eta` switches to the R2D2 paper's stable mixture
+    p = eta*max_t|TD| + (1-eta)*mean_t|TD| (Kapturowski et al. 2019,
+    eta=0.9) — the known fix for the reference's replay-collapse cycles
+    (VERDICT r3 item 5).
     """
+
+    def _seq_priority(self, tv, sav):
+        delta = tv - sav
+        eta = getattr(self.cfg, "priority_eta", None)
+        if eta is None:
+            return jnp.abs(jnp.mean(delta, axis=1))  # reference parity
+        ad = jnp.abs(delta)
+        return eta * jnp.max(ad, axis=1) + (1.0 - eta) * jnp.mean(ad, axis=1)
 
     def _td_error(self, state, batch):
         tv, sav = self._sequence_td(state.params, state.target_params, batch)[:2]
-        return jnp.abs(jnp.mean(tv - sav, axis=1))
+        return self._seq_priority(tv, sav)
 
     def _loss(self, params, target_params, batch, is_weight):
         out = self._sequence_td(params, target_params, batch)
@@ -254,7 +269,7 @@ class SequenceReplayLearnMixin:
         aux = out[2] if len(out) > 2 else 0.0
         per_seq = jnp.mean(jnp.square(tv - sav), axis=1)
         loss = jnp.mean(per_seq * is_weight) + aux
-        priorities = jnp.abs(jnp.mean(tv - sav, axis=1))
+        priorities = self._seq_priority(tv, sav)
         return loss, priorities
 
     def _learn(self, state, batch, is_weight):

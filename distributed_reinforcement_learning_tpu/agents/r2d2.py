@@ -41,6 +41,10 @@ class R2D2Config:
     learning_rate: float = 1e-4
     rescale_eps: float = 1e-3
     dtype: Any = jnp.float32
+    # None = the reference's |mean TD| sequence priority (parity quirk);
+    # a float (paper: 0.9) = eta*max|TD| + (1-eta)*mean|TD| stable mode
+    # (common.SequenceReplayLearnMixin._seq_priority).
+    priority_eta: float | None = None
 
 
 class R2D2Batch(NamedTuple):

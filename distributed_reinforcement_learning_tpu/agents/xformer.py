@@ -87,6 +87,10 @@ class XformerConfig:
     # it alone on actor twins so they share a pipelined learner's
     # checkpoint/weight layout.
     stacked: bool = False
+    # None = the reference's |mean TD| sequence priority (parity quirk);
+    # a float (paper: 0.9) = eta*max|TD| + (1-eta)*mean|TD| stable mode
+    # (common.SequenceReplayLearnMixin._seq_priority).
+    priority_eta: float | None = None
 
 
 class XformerBatch(NamedTuple):

@@ -204,7 +204,7 @@ def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, w
             remote_act=remote_act)
     return r2d2_runner.R2D2Actor(
         agent, env, queue, weights, seed=seed, obs_transform=transform,
-        remote_act=remote_act)
+        epsilon_floor=rt.epsilon_floor, remote_act=remote_act)
 
 
 _RUN_SYNC = {

@@ -39,6 +39,10 @@ class R2D2Actor:
         weights: WeightStore,
         seed: int = 0,
         epsilon_decay: float = 0.1,  # `train_r2d2.py:221`
+        epsilon_floor: float = 0.0,  # 0 = reference parity; >0 keeps a
+        # residual exploration floor (stable mode, VERDICT r3 item 5 —
+        # `1/(0.1*ep+1)` decays to ~0 and the greedy policy then feeds
+        # replay nothing but its own on-policy loop)
         obs_transform=None,  # e.g. envs.cartpole.pomdp_project
         remote_act=None,  # SEED-style: RemoteInference; no weight pulls at all
     ):
@@ -47,6 +51,7 @@ class R2D2Actor:
         self.queue = queue
         self.weights = weights
         self.epsilon_decay = epsilon_decay
+        self.epsilon_floor = epsilon_floor
         self.obs_transform = obs_transform or (lambda x: x)
         self.remote_act = remote_act
 
@@ -63,7 +68,9 @@ class R2D2Actor:
 
     @property
     def epsilon(self) -> np.ndarray:
-        return 1.0 / (self.epsilon_decay * self._episodes + 1.0)
+        return np.maximum(
+            1.0 / (self.epsilon_decay * self._episodes + 1.0),
+            self.epsilon_floor)
 
     def _sync_params(self) -> None:
         got = self.weights.get_if_newer(self._version)

@@ -40,6 +40,8 @@ class RuntimeConfig:
     updates_per_call: int = 1  # K optimizer steps per learn_many dispatch (all families)
     seq_parallel: int = 1  # xformer: devices carving the mesh's `seq` axis
     expert_parallel: int = 1  # xformer MoE: devices carving the `expert` axis
+    epsilon_floor: float = 0.0  # r2d2 actors: residual exploration floor
+    # (0 = reference-parity decay to ~greedy; stable mode uses e.g. 0.02)
 
 
 def check_config(rt: RuntimeConfig, num_actions: int) -> None:
@@ -71,6 +73,7 @@ def _runtime_from_section(algo: str, d: dict[str, Any]) -> RuntimeConfig:
         updates_per_call=d.get("updates_per_call", 1),
         seq_parallel=d.get("seq_parallel", 1),
         expert_parallel=d.get("expert_parallel", 1),
+        epsilon_floor=d.get("epsilon_floor", 0.0),
     )
 
 
@@ -124,6 +127,7 @@ def load_config(path: str | Path, section: str):
             lstm_size=d.get("lstm_size", 512),
             discount_factor=d.get("discount_factor", 0.997),
             learning_rate=d.get("start_learning_rate", 1e-4),
+            priority_eta=d.get("priority_eta", None),
         )
     elif algorithm == "xformer":
         agent_cfg = XformerConfig(
@@ -145,6 +149,7 @@ def load_config(path: str | Path, section: str):
             pipeline_microbatches=d.get("pipeline_microbatches", 2),
             pipeline_stages=d.get("pipeline_stages", 0),
             remat=d.get("remat", False),
+            priority_eta=d.get("priority_eta", None),
         )
     elif algorithm == "ximpala":
         from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaConfig

@@ -66,12 +66,15 @@ def _write_curve(name: str, meta: dict, returns: list[float]) -> dict:
     return meta
 
 
-def _config_family(section: str, updates: int, seed: int = 0, **rt_overrides):
+def _config_family(section: str, updates: int, seed: int = 0,
+                   agent_overrides: dict | None = None, **rt_overrides):
     """A family driven through the config path (build_local + run_sync)."""
     from distributed_reinforcement_learning_tpu.runtime.launch import build_local
     from distributed_reinforcement_learning_tpu.utils.config import load_config
 
     agent_cfg, rt = load_config("config.json", section)
+    if agent_overrides:
+        agent_cfg = dataclasses.replace(agent_cfg, **agent_overrides)
     if rt_overrides:
         rt = dataclasses.replace(rt, **rt_overrides)
     learner, actors, run_fn = build_local(agent_cfg, rt, seed=seed)
@@ -82,7 +85,8 @@ def _config_family(section: str, updates: int, seed: int = 0, **rt_overrides):
         "section": section,
         "updates": updates,
         "seed": seed,
-        "overrides": {k: str(v) for k, v in rt_overrides.items()},
+        "overrides": {k: str(v) for k, v in
+                      {**(agent_overrides or {}), **rt_overrides}.items()},
         "wall_s": round(wall, 1),
     }, result["episode_returns"]
 
@@ -125,6 +129,12 @@ FAMILIES = {
     "apex_cartpole": lambda s, seed=0: run_apex_cartpole(int(2500 * s), seed=seed),
     "r2d2_cartpole_pomdp": lambda s, seed=0: _config_family(
         "r2d2", int(2000 * s), seed=seed),
+    # Stable mode (VERDICT r3 item 5): the R2D2 paper's eta-mixture
+    # sequence priority + a residual epsilon floor; defaults elsewhere
+    # stay reference-faithful. Expectation: no replay-collapse cycles.
+    "r2d2_cartpole_pomdp_stable": lambda s, seed=0: _config_family(
+        "r2d2", int(2000 * s), seed=seed,
+        agent_overrides={"priority_eta": 0.9}, epsilon_floor=0.02),
     "xformer_cartpole_pomdp": lambda s, seed=0: _config_family(
         "xformer", int(2000 * s), seed=seed),
     "ximpala_cartpole": lambda s, seed=0: _config_family(

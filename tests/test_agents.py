@@ -242,3 +242,72 @@ def test_impala_remat_matches_exact():
         float(m_plain["total_loss"]), float(m_remat["total_loss"]), rtol=1e-6)
     for p, r in zip(jax.tree.leaves(s_plain.params), jax.tree.leaves(s_remat.params)):
         np.testing.assert_allclose(np.asarray(p), np.asarray(r), rtol=2e-5, atol=1e-6)
+
+
+class TestR2D2StablePriority:
+    """Stable-mode knobs (VERDICT r3 item 5): the paper's eta-mixture
+    sequence priority and the actor epsilon floor. Defaults stay
+    reference-faithful (|mean TD|, no floor)."""
+
+    def test_eta_mixture_matches_formula(self):
+        agent_ref = R2D2Agent(r2d2_cfg())
+        agent_eta = R2D2Agent(r2d2_cfg(priority_eta=0.9))
+        state = agent_ref.init_state(jax.random.PRNGKey(0))
+        batch = make_r2d2_batch(agent_ref.cfg, jax.random.PRNGKey(1))
+
+        tv, sav = agent_ref._sequence_td(state.params, state.target_params, batch)[:2]
+        delta = np.asarray(tv) - np.asarray(sav)
+
+        ref = np.asarray(agent_ref.td_error(state, batch))
+        np.testing.assert_allclose(ref, np.abs(delta.mean(axis=1)),
+                                   rtol=1e-5, atol=1e-6)
+        eta = np.asarray(agent_eta.td_error(state, batch))
+        want = 0.9 * np.abs(delta).max(axis=1) + 0.1 * np.abs(delta).mean(axis=1)
+        np.testing.assert_allclose(eta, want, rtol=1e-5, atol=1e-6)
+
+    def test_eta_priority_never_cancels(self):
+        """The reference quirk lets signed TDs cancel to ~0 priority; the
+        mixture cannot score a high-|TD| sequence near zero."""
+        agent_ref = R2D2Agent(r2d2_cfg())
+        agent_eta = R2D2Agent(r2d2_cfg(priority_eta=0.9))
+        state = agent_ref.init_state(jax.random.PRNGKey(0))
+        batch = make_r2d2_batch(agent_ref.cfg, jax.random.PRNGKey(1))
+        tv, sav = agent_ref._sequence_td(state.params, state.target_params, batch)[:2]
+        max_abs = np.abs(np.asarray(tv) - np.asarray(sav)).max(axis=1)
+        eta = np.asarray(agent_eta.td_error(state, batch))
+        assert (eta >= 0.9 * max_abs - 1e-6).all()
+
+    def test_learn_uses_eta_priorities(self):
+        agent = R2D2Agent(r2d2_cfg(priority_eta=0.9))
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = make_r2d2_batch(agent.cfg, jax.random.PRNGKey(1))
+        td = agent.td_error(state, batch)
+        _, priorities, _ = agent.learn(state, batch, jnp.ones((4,)))
+        np.testing.assert_allclose(td, priorities, rtol=1e-5, atol=1e-5)
+
+    def test_actor_epsilon_floor(self):
+        from distributed_reinforcement_learning_tpu.runtime.r2d2_runner import R2D2Actor
+
+        actor = R2D2Actor.__new__(R2D2Actor)  # epsilon is pure state math
+        actor.epsilon_decay = 0.1
+        actor.epsilon_floor = 0.02
+        actor._episodes = np.array([0, 10, 10_000])
+        eps = actor.epsilon
+        np.testing.assert_allclose(eps[0], 1.0)
+        np.testing.assert_allclose(eps[1], 0.5)
+        np.testing.assert_allclose(eps[2], 0.02)  # floored, not ~1e-3
+
+    def test_config_plumbs_stable_knobs(self, tmp_path):
+        import json as _json
+
+        from distributed_reinforcement_learning_tpu.utils.config import load_config
+
+        p = tmp_path / "config.json"
+        p.write_text(_json.dumps({"r2d2": {
+            "model_input": [2], "model_output": 2,
+            "env": ["CartPole-v0"], "available_action": [2], "num_actors": 1,
+            "priority_eta": 0.9, "epsilon_floor": 0.02,
+        }}))
+        cfg, rt = load_config(str(p), "r2d2")
+        assert cfg.priority_eta == 0.9
+        assert rt.epsilon_floor == 0.02
