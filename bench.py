@@ -521,10 +521,19 @@ def bench_e2e(cfg, B: int, updates: int, feeders: int = 3,
         try:
             while not stop.is_set():
                 if hasattr(queue, "put_bytes_many"):
-                    queue.put_bytes_many(blobs, timeout=0.5)
+                    accepted = queue.put_bytes_many(blobs, timeout=0.5)
                 else:
-                    queue.put_many([codec.decode(b, copy=True) for b in blobs],
-                                   timeout=0.5)
+                    accepted = queue.put_many(
+                        [codec.decode(b, copy=True) for b in blobs],
+                        timeout=0.5)
+                if not accepted:
+                    # Queue stayed full through the whole timeout: back
+                    # off instead of re-arming the condvar herd at full
+                    # rate — N shm feeders have no RTT throttling them
+                    # (tcp feeders idle in recv between round trips), and
+                    # their wakeup stampede on every learner pop is host
+                    # time stolen from the learn loop (r3 run1's shm<tcp).
+                    time.sleep(0.02)
         except RuntimeError:  # queue closed at teardown
             pass
 
@@ -752,10 +761,39 @@ def bench_stage_budget(cfg, B: int, learn_fps: float | None) -> dict:
     for k in ("encode", "shm_put", "gather", "tcp_put", "h2d", "learn"):
         if k in out and "frames_per_s" in out[k]:
             out[k]["meets_target"] = out[k]["frames_per_s"] >= target
+
+    # e2e_attainable (VERDICT r3 item 2c): the pipelined e2e this host's
+    # stages would sustain if the h2d link were a CO-LOCATED DMA path
+    # instead of the axon tunnel. Every stage overlaps in deployment
+    # (actor processes / prefetch thread / device queue), so attainable
+    # e2e = min over stage rates, with the MEASURED h2d row replaced by
+    # the stated assumed bandwidth. Clearly a DERIVED number — the
+    # assumption is in the row, the measured tunnel row stays above.
+    assumed_gbps = float(os.environ.get("BENCH_ASSUMED_H2D_GBPS", "8.0"))
+    h2d_assumed_fps = B * T / (total_bytes / (assumed_gbps * 1e9))
+    rates = {"h2d_assumed": h2d_assumed_fps}
+    for k in ("encode", "shm_put", "gather", "tcp_put", "learn"):
+        if k in out and "frames_per_s" in out[k]:
+            rates[k] = out[k]["frames_per_s"]
+    binding = min(rates, key=rates.get)
+    out["e2e_attainable"] = {
+        "assumed_h2d_gb_per_s": assumed_gbps,
+        "assumed_h2d_frames_per_s": round(h2d_assumed_fps, 1),
+        "attainable_frames_per_s": round(rates[binding], 1),
+        "binding_stage": binding,
+        "meets_target": rates[binding] >= target,
+        "note": ("DERIVED, not measured: min over measured framework "
+                 "stage rates with the tunnel h2d row substituted by the "
+                 "assumed co-located DMA bandwidth (overlapped pipeline "
+                 "model; BENCH_ASSUMED_H2D_GBPS overrides)"),
+    }
+
     print(f"[bench] stage budget: " + ", ".join(
         f"{k}={out[k]['frames_per_s']:,.0f}f/s"
         for k in ("encode", "shm_put", "gather", "tcp_put", "h2d", "learn")
-        if k in out and "frames_per_s" in out[k]), file=sys.stderr)
+        if k in out and "frames_per_s" in out[k])
+        + f"; attainable={rates[binding]:,.0f}f/s (binding: {binding})",
+        file=sys.stderr)
     return out
 
 

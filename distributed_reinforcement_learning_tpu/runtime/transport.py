@@ -92,7 +92,11 @@ class InferenceUnavailableError(RuntimeError):
     """
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly n bytes. Returns the bytearray itself — NOT a bytes()
+    copy: a 16-unroll PUT payload is ~9 MB, and the copy was pure waste
+    on the 1-core host (every consumer — struct.unpack, slicing,
+    codec.decode, unpack_batch — is buffer-protocol-happy)."""
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
@@ -101,23 +105,70 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         if k == 0:
             raise TransportError("peer closed")
         got += k
-    return bytes(buf)
+    return buf
 
 
 def _send_msg(sock: socket.socket, tag: int, *parts: bytes | bytearray) -> None:
-    """One framed message; multi-part payloads are sent without concatenating
-    (no copy of multi-MB weight blobs just to prefix an 8-byte version)."""
-    total = sum(len(p) for p in parts)
-    sock.sendall(_HDR.pack(tag, total))
-    for p in parts:
-        if p:
-            sock.sendall(p)
+    """One framed message; multi-part payloads are sent without
+    concatenating (no copy of multi-MB weight blobs just to prefix an
+    8-byte version) AND without one syscall per part: `sendmsg` is
+    writev(2), so header + K length-prefixes + K blobs go to the kernel
+    in one vectored call (a batched PUT was 2K+1 sendall syscalls)."""
+    bufs = [memoryview(_HDR.pack(tag, sum(len(p) for p in parts)))]
+    bufs += [memoryview(p).cast("B") for p in parts if len(p)]
+    while bufs:
+        sent = sock.sendmsg(bufs[:1024])  # IOV_MAX caps one writev
+        if sent == 0:
+            raise TransportError("peer closed")
+        # Drop fully-sent buffers; trim a partially-sent head.
+        i = 0
+        while i < len(bufs) and sent >= len(bufs[i]):
+            sent -= len(bufs[i])
+            i += 1
+        bufs = bufs[i:]
+        if sent and bufs:
+            bufs[0] = bufs[0][sent:]
 
 
-def _recv_msg(sock: socket.socket) -> tuple[int, bytes]:
+def _recv_msg(sock: socket.socket) -> tuple[int, bytearray]:
     tag, length = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    payload = _recv_exact(sock, length) if length else b""
+    payload = _recv_exact(sock, length) if length else bytearray()
     return tag, payload
+
+
+def _recv_into_exact(sock: socket.socket, view: memoryview) -> None:
+    got, n = 0, len(view)
+    while got < n:
+        k = sock.recv_into(view[got:], n - got)
+        if k == 0:
+            raise TransportError("peer closed")
+        got += k
+
+
+class _ConnRecvBuf:
+    """Per-connection reusable receive buffer for the server loop.
+
+    A 16-unroll PUT payload is ~9 MB; allocating (and first-touching)
+    a fresh bytearray per request was a measurable slice of the
+    host-side wire budget. Every server op copies what it keeps (queue
+    put / decode(copy=True)) before the next request is read, so the
+    buffer may be reused across requests of one connection."""
+
+    __slots__ = ("hdr", "buf")
+
+    def __init__(self):
+        self.hdr = bytearray(_HDR.size)
+        self.buf = bytearray(1 << 16)
+
+    def recv_msg(self, sock: socket.socket) -> tuple[int, memoryview]:
+        _recv_into_exact(sock, memoryview(self.hdr))
+        tag, length = _HDR.unpack(self.hdr)
+        if length > len(self.buf):
+            self.buf = bytearray(max(length, 2 * len(self.buf)))
+        view = memoryview(self.buf)[:length]
+        if length:
+            _recv_into_exact(sock, view)
+        return tag, view
 
 
 class TransportServer:
@@ -258,9 +309,10 @@ class TransportServer:
         return accepted
 
     def _serve_inner(self, conn: socket.socket) -> None:
+        rbuf = _ConnRecvBuf()  # reused across this connection's requests
         while not self._stop.is_set():
             try:
-                op, payload = _recv_msg(conn)
+                op, payload = rbuf.recv_msg(conn)
             except (TransportError, OSError):
                 return
             try:
