@@ -59,6 +59,11 @@ class ImpalaConfig:
     # full-frame elementwise normalize pass. Exact same math modulo one
     # rounding on the kernel; no-op for vector observations.
     fold_normalize: bool = False
+    # "nature" (reference parity, model/impala_actor_critic.py:4-10) or
+    # "resnet" — the IMPALA paper's deep torso, `torso_width`-multiplied
+    # channels (models/torso.py ResNetTorso, the MXU-dense variant).
+    torso: str = "nature"
+    torso_width: int = 1
 
 
 class ImpalaBatch(NamedTuple):
@@ -89,6 +94,7 @@ class ImpalaAgent:
         self.model = ImpalaActorCritic(
             num_actions=cfg.num_actions, lstm_size=cfg.lstm_size, dtype=cfg.dtype,
             fold_normalize=cfg.fold_normalize,
+            torso=cfg.torso, torso_width=cfg.torso_width,
         )
         self._schedule = common.polynomial_lr(
             cfg.start_learning_rate, cfg.end_learning_rate, cfg.learning_frame

@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 
 from distributed_reinforcement_learning_tpu.models.recurrent import LSTMCell
-from distributed_reinforcement_learning_tpu.models.torso import MLP, ActionEmbedding, NatureConv
+from distributed_reinforcement_learning_tpu.models.torso import (
+    MLP, ActionEmbedding, NatureConv, ResNetTorso)
 
 
 class ImpalaOutput(NamedTuple):
@@ -44,6 +45,10 @@ class ImpalaActorCritic(nn.Module):
     # Fold the /255 frame normalization into conv0's kernel: integer
     # frames flow in raw and the model owns the scaling (see NatureConv).
     fold_normalize: bool = False
+    # "nature" (reference parity) or "resnet" (the IMPALA paper's deep
+    # torso, width-multiplied — the MXU-dense variant; models/torso.py).
+    torso: str = "nature"
+    torso_width: int = 1
 
     @nn.compact
     def __call__(self, obs: jax.Array, prev_action: jax.Array, h: jax.Array, c: jax.Array) -> ImpalaOutput:
@@ -57,7 +62,11 @@ class ImpalaActorCritic(nn.Module):
                 if self.fold_normalize and jnp.issubdtype(obs.dtype, jnp.integer)
                 else None
             )
-            img = NatureConv(dtype=self.dtype, input_scale=scale, name="torso")(obs)
+            if self.torso == "resnet":
+                img = ResNetTorso(dtype=self.dtype, width=self.torso_width,
+                                  input_scale=scale, name="torso")(obs)
+            else:
+                img = NatureConv(dtype=self.dtype, input_scale=scale, name="torso")(obs)
         act = ActionEmbedding(self.num_actions, dtype=self.dtype, name="action_embed")(prev_action)
         z = jnp.concatenate([img, act], axis=-1)
         new_h, new_c = LSTMCell(self.lstm_size, dtype=self.dtype, name="lstm")(z, h, c)

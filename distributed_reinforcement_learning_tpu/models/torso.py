@@ -59,6 +59,61 @@ class NatureConv(nn.Module):
         return x.reshape((x.shape[0], -1))
 
 
+class ResNetTorso(nn.Module):
+    """IMPALA deep torso (Espeholt et al. 2018, fig. 3): three sections of
+    conv3x3 -> maxpool3x3/2 -> 2 residual blocks, then relu+flatten+Dense.
+
+    The reference never shipped the deep model; it exists here as the
+    MXU-dense IMPALA variant (VERDICT r3 item 8): `width` multiplies the
+    paper's (16, 32, 32) channels, so width=4 -> (64, 128, 128) — 3x3
+    contractions of 576/1152 and output channels of 64/128 that FILL the
+    128-wide MXU, unlike Nature-CNN's 32/64-channel quarter-fills. SAME
+    padding + pooling keep the spatial geometry analytically simple for
+    the roofline model (bench.py impala_roofline).
+
+    conv0 carries the folded `input_scale` exactly like `NatureConv`
+    (declared params, conv(x*s) == conv_{k*s}(x)).
+    """
+
+    dtype: jnp.dtype = jnp.float32
+    width: int = 1
+    input_scale: float | None = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        for s, base in enumerate((16, 32, 32)):
+            ch = base * self.width
+            if s == 0:
+                # Explicit params so the frame normalization can fold in.
+                k = self.param("conv0_kernel", _glorot, (3, 3, x.shape[-1], ch))
+                b = self.param("conv0_bias", nn.initializers.zeros_init(), (ch,))
+                kc = k.astype(self.dtype)
+                if self.input_scale is not None:
+                    kc = kc * jnp.asarray(self.input_scale, self.dtype)
+                x = jax.lax.conv_general_dilated(
+                    x, kc, window_strides=(1, 1), padding="SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC")) + b.astype(self.dtype)
+            else:
+                x = nn.Conv(ch, (3, 3), padding="SAME", kernel_init=_glorot,
+                            dtype=self.dtype, name=f"section{s}_conv")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            for r in range(2):
+                skip = x
+                y = nn.relu(x)
+                y = nn.Conv(ch, (3, 3), padding="SAME", kernel_init=_glorot,
+                            dtype=self.dtype, name=f"section{s}_res{r}_conv0")(y)
+                y = nn.relu(y)
+                y = nn.Conv(ch, (3, 3), padding="SAME", kernel_init=_glorot,
+                            dtype=self.dtype, name=f"section{s}_res{r}_conv1")(y)
+                x = skip + y
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(256, kernel_init=_glorot, dtype=self.dtype,
+                             name="trunk_out")(x))
+        return x
+
+
 def upgrade_nature_conv_params(tree):
     """Rewrite pre-r3 NatureConv param nesting to the explicit layout.
 

@@ -105,6 +105,8 @@ def load_config(path: str | Path, section: str):
             end_learning_rate=d.get("end_learning_rate", 0.0),
             learning_frame=int(d.get("learning_frame", 1e9)),
             fold_normalize=d.get("fold_normalize", False),
+            torso=d.get("torso", "nature"),
+            torso_width=d.get("torso_width", 1),
         )
     elif algorithm == "apex":
         agent_cfg = ApexConfig(

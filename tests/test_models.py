@@ -133,3 +133,76 @@ def test_models_have_gradients(rng):
     grads = jax.grad(loss)(params)
     total = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree.leaves(grads))
     assert np.isfinite(total) and total > 0
+
+
+class TestResNetTorso:
+    """IMPALA-paper deep torso (models/torso.py ResNetTorso): the
+    MXU-dense variant (VERDICT r3 item 8). CPU tests run width 1 on
+    small frames; the width-4 84x84 geometry is bench-only."""
+
+    def _agent(self, **kw):
+        from distributed_reinforcement_learning_tpu.agents.impala import (
+            ImpalaAgent, ImpalaConfig)
+
+        base = dict(obs_shape=(16, 16, 4), num_actions=4, trajectory=4,
+                    lstm_size=32, torso="resnet", torso_width=1,
+                    start_learning_rate=1e-3, learning_frame=10**6)
+        base.update(kw)
+        return ImpalaAgent(ImpalaConfig(**base))
+
+    def test_forward_and_learn(self):
+        from distributed_reinforcement_learning_tpu.utils.synthetic import (
+            synthetic_impala_batch)
+
+        agent = self._agent()
+        state = agent.init_state(jax.random.PRNGKey(0))
+        batch = synthetic_impala_batch(2, 4, (16, 16, 4), 4, 32)
+        state2, m = agent.learn(state, jax.tree.map(jnp.asarray, batch))
+        assert np.isfinite(float(m["total_loss"]))
+        assert float(m["grad_norm"]) > 0
+
+    def test_param_structure_has_residual_sections(self):
+        agent = self._agent()
+        state = agent.init_state(jax.random.PRNGKey(0))
+        torso = state.params["params"]["torso"]
+        # conv0 is explicit (foldable); sections carry residual convs.
+        assert "conv0_kernel" in torso
+        assert "section1_res0_conv0" in torso and "section2_res1_conv1" in torso
+        assert "trunk_out" in torso
+
+    def test_fold_normalize_equivalent_on_resnet(self):
+        """conv(x/255) == conv_{k/255}(x) holds for the deep torso's
+        explicit conv0 exactly as for NatureConv."""
+        from distributed_reinforcement_learning_tpu.utils.synthetic import (
+            synthetic_impala_batch)
+
+        plain = self._agent(fold_normalize=False)
+        folded = self._agent(fold_normalize=True)
+        state = plain.init_state(jax.random.PRNGKey(0))
+        batch = jax.tree.map(jnp.asarray, synthetic_impala_batch(2, 4, (16, 16, 4), 4, 32))
+        _, m_plain = plain.learn(state, batch)
+        state_f = folded.init_state(jax.random.PRNGKey(0))
+        _, m_fold = folded.learn(state_f, batch)
+        np.testing.assert_allclose(float(m_plain["total_loss"]),
+                                   float(m_fold["total_loss"]), rtol=2e-4)
+
+    def test_config_plumbs_torso(self, tmp_path):
+        import json as _json
+
+        from distributed_reinforcement_learning_tpu.utils.config import load_config
+
+        p = tmp_path / "c.json"
+        p.write_text(_json.dumps({"impala": {
+            "model_input": [84, 84, 4], "model_output": 18,
+            "env": ["BreakoutDeterministic-v4"], "available_action": [4],
+            "num_actors": 1, "torso": "resnet", "torso_width": 4,
+        }}))
+        cfg, _ = load_config(str(p), "impala")
+        assert cfg.torso == "resnet" and cfg.torso_width == 4
+
+    def test_repo_section_loads(self):
+        from distributed_reinforcement_learning_tpu.utils.config import load_config
+
+        cfg, rt = load_config("config.json", "impala_resnet")
+        assert cfg.torso == "resnet" and cfg.torso_width == 4
+        assert cfg.fold_normalize is True
