@@ -16,6 +16,8 @@ Re-design of `train_apex.py:82-231`:
 
 from __future__ import annotations
 
+from typing import Any
+
 import numpy as np
 
 import jax
@@ -187,6 +189,16 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
         self.publish_interval = max(1, publish_interval)
         self.ingested_unrolls = 0
         self.train_steps = 0
+        # One-deep ingest pipeline (VERDICT r3 item 3): batch k's H2D +
+        # TD forward are dispatched, then batch k-1's TD is materialized
+        # and replay-added — so the transfer/compute of k overlaps the
+        # host-side sum-tree work of k-1 instead of serializing behind a
+        # np.asarray() sync per batch. None = auto (on for single-device
+        # accelerators; off on mesh learners, whose batches need explicit
+        # sharding placement, and off on CPU where there is no transfer
+        # to hide).
+        self.ingest_pipeline: bool | None = None
+        self._pending_ingest: tuple[Any, Any, int] | None = None
         self.timer = StageTimer(self.logger)
         self._profiler = ProfilerSession.from_env()
         weights.publish(self.state.params, 0)
@@ -199,6 +211,7 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
         disableable via DRL_CKPT_REPLAY* (utils/checkpoint.py)."""
         from distributed_reinforcement_learning_tpu.utils.checkpoint import encode_replay_snapshot
 
+        self._flush_pending_ingest()  # snapshot must include in-flight unrolls
         blob = encode_replay_snapshot(self.replay)
         ckpt.save(self.train_steps, self.state, {
             "train_steps": self.train_steps,
@@ -245,18 +258,47 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
         the C++ sum-tree. K snaps down to a power of two so the forward
         compiles at most log2(max_unrolls)+1 distinct shapes.
         """
-        with self.timer.stage("ingest_dequeue"):
-            k = 1
-            while k * 2 <= min(self.queue.size(), max_unrolls):
-                k *= 2
-            stacked = self.queue.get_batch(k, timeout=timeout)
-        if stacked is None:
-            return 0
-        with self.timer.stage("ingest_td"):
-            # [K, U, ...] -> [K*U, ...]: one forward for all transitions.
-            flat = jax.tree.map(
-                lambda x: np.asarray(x).reshape(-1, *np.asarray(x).shape[2:]), stacked)
-            td = np.asarray(self.agent.td_error(self.state, flat))
+        pipeline = self.ingest_pipeline
+        if pipeline is None:  # auto: overlap only where there is a transfer
+            pipeline = (self._batch_sharding is None
+                        and jax.default_backend() not in ("cpu",))
+        # Pipelined mode loops until it can report >=1 COMPLETED unroll
+        # (or the queue is truly drained), preserving the
+        # `while ingest_many(): pass` contract: a zero return always
+        # means "nothing left anywhere" — never "progress in flight".
+        # The priming pass may therefore pop up to 2 chunks.
+        done = 0
+        while True:
+            with self.timer.stage("ingest_dequeue"):
+                k = 1
+                while k * 2 <= min(self.queue.size(), max_unrolls):
+                    k *= 2
+                stacked = self.queue.get_batch(k, timeout=timeout)
+            if stacked is None:
+                # Queue drained: complete whatever is still in flight.
+                return done + self._flush_pending_ingest()
+            with self.timer.stage("ingest_td"):
+                # [K, U, ...] -> [K*U, ...]: one forward for everything.
+                flat = jax.tree.map(
+                    lambda x: np.asarray(x).reshape(-1, *np.asarray(x).shape[2:]),
+                    stacked)
+                if pipeline:
+                    # Dispatch k's H2D + TD forward, then materialize
+                    # k-1's: the device works on k while the host
+                    # sum-tree adds k-1 (VERDICT r3 item 3).
+                    dev = jax.device_put(flat)
+                    td_dev = self.agent.td_error(self.state, dev)
+                    done += self._flush_pending_ingest()
+                    self._pending_ingest = (td_dev, flat, k)
+                    if done:
+                        return done
+                    continue  # primed the pipeline; pop the next chunk
+                td = np.asarray(self.agent.td_error(self.state, flat))
+            self._replay_add(td, flat)
+            self.ingested_unrolls += k
+            return done + k
+
+    def _replay_add(self, td: np.ndarray, flat) -> None:
         with self.timer.stage("ingest_replay_add"):
             if getattr(self.replay, "stacked_samples", False):
                 # SoA backend: one vectorized slice-assign per field —
@@ -266,6 +308,17 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
                 self.replay.add_batch(
                     td, [jax.tree.map(lambda x: x[i], flat) for i in range(len(td))]
                 )
+
+    def _flush_pending_ingest(self) -> int:
+        """Materialize the in-flight TD batch and add it to replay;
+        returns the number of unrolls completed (0 if none pending)."""
+        if self._pending_ingest is None:
+            return 0
+        td_dev, flat, k = self._pending_ingest
+        self._pending_ingest = None
+        with self.timer.stage("ingest_td_sync"):
+            td = np.asarray(td_dev)
+        self._replay_add(td, flat)
         self.ingested_unrolls += k
         return k
 

@@ -144,6 +144,25 @@ def test_apex_ingest_many_matches_per_unroll():
         np.testing.assert_array_equal(ia.state, ib.state)
         np.testing.assert_array_equal(ia.action, ib.action)
 
+    # Pipelined mode (one TD batch in flight, H2D overlapped): the drain
+    # loop must still ingest everything, same count/priorities/contents.
+    c = make_learner()
+    c.ingest_pipeline = True  # auto would disable it on CPU
+    total = 0
+    while True:
+        got = c.ingest_many(max_unrolls=2, timeout=0.0)
+        if not got:
+            break
+        total += got
+    assert total == 4 and c.ingested_unrolls == 4
+    assert c._pending_ingest is None  # zero return implies fully flushed
+    snap_c = c.replay.snapshot()
+    np.testing.assert_allclose(snap_a["priorities"], snap_c["priorities"],
+                               rtol=1e-6)
+    for ia, ic in zip(_snapshot_items(snap_a), _snapshot_items(snap_c)):
+        np.testing.assert_array_equal(ia.state, ic.state)
+        np.testing.assert_array_equal(ia.action, ic.action)
+
 
 def test_r2d2_trains_cartpole_pomdp():
     cfg = R2D2Config(obs_shape=(2,), num_actions=2, seq_len=10, burn_in=5,
