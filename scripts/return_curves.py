@@ -147,6 +147,14 @@ FAMILIES = {
     "apex_breakout_sim": lambda s, seed=0: _config_family(
         "apex", int(2000 * s), seed=seed,
         batch_size=8, num_actors=1, queue_size=64),
+    # IMPALA on the Pong simulator (short curve: ~100k frames shows the
+    # mechanics + early trend only — Pong needs ~1M+ frames to go
+    # positive; the -21..-18 band with a rising trend is the expected
+    # signature at this budget).
+    "impala_pong_sim": lambda s, seed=0: _config_family(
+        "impala", int(600 * s), seed=seed,
+        envs=("PongDeterministic-v4",), available_action=(6,),
+        batch_size=8, num_actors=1, queue_size=64),
 }
 
 
