@@ -31,10 +31,12 @@ PANELS = [
     ("impala_cartpole", "IMPALA — CartPole", "#2a78d6"),
     ("apex_cartpole", "Ape-X — CartPole", "#eb6834"),
     ("r2d2_cartpole_pomdp", "R2D2 — CartPole POMDP", "#1baf7a"),
+    ("r2d2_cartpole_pomdp_stable", "R2D2 stable mode (eta-priority + eps floor)", "#0d7d6c"),
     ("xformer_cartpole_pomdp", "Transformer-R2D2 — CartPole POMDP", "#eda100"),
     ("ximpala_cartpole", "Transformer-IMPALA — CartPole", "#e87ba4"),
     ("impala_breakout_sim", "IMPALA — Breakout-sim (pixels)", "#008300"),
     ("apex_breakout_sim", "Ape-X — Breakout-sim (pixels)", "#4a3aa7"),
+    ("impala_pong_sim", "IMPALA — Pong-sim (pixels, short)", "#9c27b0"),
 ]
 
 INK = "#0b0b0b"
@@ -57,13 +59,19 @@ def _downsample(y: np.ndarray, max_pts: int = 1500):
 
 
 def main() -> None:
-    fig, axes = plt.subplots(2, 4, figsize=(16, 6.5), facecolor=SURFACE)
-    axes = axes.ravel()
+    rows = (len(PANELS) + 3) // 4
+    fig, axes = plt.subplots(rows, 4, figsize=(16, 3.25 * rows),
+                             facecolor=SURFACE)
+    axes = np.asarray(axes).ravel()
     for ax in axes[len(PANELS):]:
         ax.set_visible(False)
 
     for ax, (stem, title, color) in zip(axes, PANELS):
-        rows = [json.loads(l) for l in open(os.path.join(CURVES, f"{stem}.jsonl"))]
+        path = os.path.join(CURVES, f"{stem}.jsonl")
+        if not os.path.exists(path):  # family not yet run: leave blank
+            ax.set_visible(False)
+            continue
+        rows = [json.loads(l) for l in open(path)]
         rets = np.array([r["return"] for r in rows[1:]], float)
         ax.set_facecolor(SURFACE)
         # Raw per-episode trace: same entity, lighter tint as context.
