@@ -45,6 +45,10 @@ class R2D2Config:
     # a float (paper: 0.9) = eta*max|TD| + (1-eta)*mean|TD| stable mode
     # (common.SequenceReplayLearnMixin._seq_priority).
     priority_eta: float | None = None
+    # None = the reference's plain unclipped Adam (`agent/r2d2.py:91-92`);
+    # a float adds global-norm clipping in front (stable mode — the
+    # unclipped TD spikes at target syncs are a collapse driver).
+    gradient_clip_norm: float | None = None
 
 
 class R2D2Batch(NamedTuple):
@@ -63,7 +67,8 @@ class R2D2Agent(common.SequenceReplayLearnMixin):
     def __init__(self, cfg: R2D2Config):
         self.cfg = cfg
         self.model = R2D2Net(num_actions=cfg.num_actions, lstm_size=cfg.lstm_size, dtype=cfg.dtype)
-        self.tx = common.adam_with_clip(cfg.learning_rate, clip_norm=None)
+        self.tx = common.adam_with_clip(cfg.learning_rate,
+                                        clip_norm=cfg.gradient_clip_norm)
         self.act = jax.jit(self._act)
         self.td_error = jax.jit(self._td_error)
         self.learn = jax.jit(self._learn, donate_argnums=(0,))

@@ -130,6 +130,12 @@ def load_config(path: str | Path, section: str):
             discount_factor=d.get("discount_factor", 0.997),
             learning_rate=d.get("start_learning_rate", 1e-4),
             priority_eta=d.get("priority_eta", None),
+            # NOT the section's `gradient_clip_norm`: the reference
+            # carries that key but never applies it to R2D2
+            # (`agent/r2d2.py:91-92`), and honoring it would silently
+            # change reference-config behavior. Stable mode opts in via
+            # the distinct `adam_clip_norm` key.
+            gradient_clip_norm=d.get("adam_clip_norm", None),
         )
     elif algorithm == "xformer":
         agent_cfg = XformerConfig(
