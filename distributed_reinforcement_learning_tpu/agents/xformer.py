@@ -91,6 +91,9 @@ class XformerConfig:
     # a float (paper: 0.9) = eta*max|TD| + (1-eta)*mean|TD| stable mode
     # (common.SequenceReplayLearnMixin._seq_priority).
     priority_eta: float | None = None
+    # None = plain unclipped Adam (R2D2-family reference parity); a float
+    # adds global-norm clipping (stable mode, config key adam_clip_norm).
+    gradient_clip_norm: float | None = None
 
 
 class XformerBatch(NamedTuple):
@@ -231,7 +234,8 @@ class XformerAgent(common.SequenceReplayLearnMixin):
         self._mesh = mesh
         self.model, self._dense_model = build_transformer_models(
             cfg, mesh, seq_len=cfg.seq_len)
-        self.tx = common.adam_with_clip(cfg.learning_rate, clip_norm=None)
+        self.tx = common.adam_with_clip(cfg.learning_rate,
+                                        clip_norm=cfg.gradient_clip_norm)
         self.act = jax.jit(self._act)
         self.td_error = jax.jit(self._td_error)
         self.learn = jax.jit(self._learn, donate_argnums=(0,))

@@ -158,6 +158,7 @@ def load_config(path: str | Path, section: str):
             pipeline_stages=d.get("pipeline_stages", 0),
             remat=d.get("remat", False),
             priority_eta=d.get("priority_eta", None),
+            gradient_clip_norm=d.get("adam_clip_norm", None),
         )
     elif algorithm == "ximpala":
         from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaConfig
