@@ -124,9 +124,12 @@ class ImpalaActor:
             self._c = np.asarray(out.c) * keep
             self._prev_action = np.where(done, 0, actions).astype(np.int32)
             self._obs = next_obs
+            # No positivity filter: Pong-class envs finish with NEGATIVE
+            # returns, and a 0-point Breakout episode is still an episode
+            # (the old `ret > 0` guard silently recorded "no episodes" on
+            # Pong and inflated Breakout stats).
             for ret in completed_returns(infos, done):
-                if ret > 0:
-                    self.episode_returns.append(float(ret))
+                self.episode_returns.append(float(ret))
 
         put_round(self.queue, acc.extract())
         return n * cfg.trajectory

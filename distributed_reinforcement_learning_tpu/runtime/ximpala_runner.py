@@ -150,9 +150,10 @@ class XImpalaActor:
             self._win_done[:, -1] = done  # now known; future windows see it
             self._prev_action = np.where(done, 0, action).astype(np.int32)
             self._obs = next_obs
+            # No positivity filter (see impala_runner): negative-return
+            # episodes (Pong) are episodes too.
             for ret in completed_returns(infos, done):
-                if ret > 0:
-                    self.episode_returns.append(float(ret))
+                self.episode_returns.append(float(ret))
 
         put_round(self.queue, acc.extract())
         return n * cfg.trajectory

@@ -255,3 +255,39 @@ def test_impala_publish_interval_still_learns():
     returns = result["episode_returns"]
     late = np.mean(returns[-20:])
     assert late > 60, f"late mean return {late}"
+
+
+def test_impala_actor_records_negative_episode_returns():
+    """Pong-class envs end episodes with NEGATIVE totals; the actor's
+    episode bookkeeping must record them (a `ret > 0` filter silently
+    reported zero episodes on Pong — round-4 regression test)."""
+    from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue as TQ
+
+    class MinusOneEnv:
+        """2 envs, 3-step episodes, reward -1 each step."""
+        num_envs, num_actions = 2, 2
+
+        def __init__(self):
+            self._t = np.zeros(2, np.int64)
+
+        def reset(self):
+            return np.zeros((2, 4), np.float32)
+
+        def step(self, actions):
+            self._t += 1
+            done = self._t >= 3
+            rets = np.where(done, -3.0, 0.0)
+            self._t[done] = 0
+            infos = {"episode_return": rets, "lives": np.full(2, -1)}
+            return (np.zeros((2, 4), np.float32),
+                    np.full(2, -1.0, np.float32), done, infos)
+
+    cfg = ImpalaConfig(obs_shape=(4,), num_actions=2, trajectory=6, lstm_size=16)
+    agent = ImpalaAgent(cfg)
+    queue = TQ(capacity=64)
+    weights = WeightStore()
+    weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+    actor = impala_runner.ImpalaActor(agent, MinusOneEnv(), queue, weights, seed=0)
+    actor.run_unroll()
+    assert actor.episode_returns, "negative-return episodes were dropped"
+    assert all(r == -3.0 for r in actor.episode_returns)
