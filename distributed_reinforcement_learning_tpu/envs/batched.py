@@ -45,7 +45,7 @@ class BatchedEnv:
         return np.stack([env.reset() for env in self.envs])
 
     def step(self, actions: np.ndarray):
-        obs_list, rewards, dones, lives = [], [], [], []
+        obs_list, rewards, dones, lives, truncs = [], [], [], [], []
         episode_returns = np.zeros(self.num_envs, np.float64)
         episode_lengths = np.zeros(self.num_envs, np.int64)
         for i, env in enumerate(self.envs):
@@ -61,11 +61,13 @@ class BatchedEnv:
             obs_list.append(obs)
             rewards.append(r)
             dones.append(done)
+            truncs.append(bool(info.get("truncated", False)))
             lives.append(info.get("lives", -1))
         infos = {
             "episode_return": episode_returns,
             "episode_length": episode_lengths,
             "lives": np.asarray(lives),
+            "truncated": np.asarray(truncs, bool),
         }
         return (
             np.stack(obs_list),

@@ -65,10 +65,12 @@ class CartPoleEnv:
         self._state = _physics_step(self._state[None], np.asarray([action]))[0]
         self._steps += 1
         x, _, theta, _ = self._state
-        done = bool(
-            abs(x) > _X_LIMIT or abs(theta) > _THETA_LIMIT or self._steps >= self._max_steps
-        )
-        return self._state.astype(np.float32), 1.0, done, {}
+        failed = abs(x) > _X_LIMIT or abs(theta) > _THETA_LIMIT
+        done = bool(failed or self._steps >= self._max_steps)
+        # Time-limit truncation vs real termination (gymnasium semantics):
+        # the cap ending an otherwise-alive episode is `truncated`.
+        return (self._state.astype(np.float32), 1.0, done,
+                {"truncated": bool(done and not failed)})
 
 
 class VectorCartPole:
@@ -103,11 +105,9 @@ class VectorCartPole:
         self._returns += 1.0
         x = self._state[:, 0]
         theta = self._state[:, 2]
-        done = (
-            (np.abs(x) > _X_LIMIT)
-            | (np.abs(theta) > _THETA_LIMIT)
-            | (self._steps >= self._max_steps)
-        )
+        failed = (np.abs(x) > _X_LIMIT) | (np.abs(theta) > _THETA_LIMIT)
+        done = failed | (self._steps >= self._max_steps)
+        truncated = done & ~failed  # time-limit cap, not a real terminal
         reward = np.ones(self.num_envs, np.float32)
         episode_returns = np.where(done, self._returns, 0.0)
         if done.any():
@@ -115,7 +115,8 @@ class VectorCartPole:
             self._state[idx] = self._rng.uniform(-0.05, 0.05, size=(len(idx), 4))
             self._steps[idx] = 0
             self._returns[idx] = 0
-        infos = {"episode_return": episode_returns, "done_mask": done.copy()}
+        infos = {"episode_return": episode_returns, "done_mask": done.copy(),
+                 "truncated": truncated}
         return self._state.astype(np.float32), reward, done, infos
 
 

@@ -72,7 +72,7 @@ class GymnasiumEnv:
         if obs.dtype != np.uint8:
             obs = obs.astype(np.float32)
         done = bool(terminated or truncated)
-        out_info: dict[str, Any] = {}
+        out_info: dict[str, Any] = {"truncated": bool(truncated and not terminated)}
         if "lives" in info:
             out_info["lives"] = int(info["lives"])
         return obs, float(reward), done, out_info

@@ -201,10 +201,11 @@ def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, w
     if algo == "xformer":
         return xformer_runner.XformerActor(
             agent, env, queue, weights, seed=seed, obs_transform=transform,
-            remote_act=remote_act)
+            timeout_nonterminal=rt.timeout_nonterminal, remote_act=remote_act)
     return r2d2_runner.R2D2Actor(
         agent, env, queue, weights, seed=seed, obs_transform=transform,
-        epsilon_floor=rt.epsilon_floor, remote_act=remote_act)
+        epsilon_floor=rt.epsilon_floor,
+        timeout_nonterminal=rt.timeout_nonterminal, remote_act=remote_act)
 
 
 _RUN_SYNC = {

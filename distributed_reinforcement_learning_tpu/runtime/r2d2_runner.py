@@ -43,6 +43,15 @@ class R2D2Actor:
         # residual exploration floor (stable mode, VERDICT r3 item 5 —
         # `1/(0.1*ep+1)` decays to ~0 and the greedy policy then feeds
         # replay nothing but its own on-policy loop)
+        timeout_nonterminal: bool = False,  # stable mode: record a
+        # TIME-LIMIT truncation (env info `truncated`) as non-terminal —
+        # done stays False in the recorded stream (LSTM carries and
+        # prev_action continue across the env's silent reset, exactly as
+        # if the episode had kept going). Measured on CartPole-POMDP:
+        # recording the 200-cap as a true terminal aliases "about to time
+        # out" with "just started" states and drives the periodic
+        # collapse-recover cycle (time-limit aliasing, Pardo et al. 2018);
+        # this option removes the collapse. False = reference parity.
         obs_transform=None,  # e.g. envs.cartpole.pomdp_project
         remote_act=None,  # SEED-style: RemoteInference; no weight pulls at all
     ):
@@ -52,6 +61,7 @@ class R2D2Actor:
         self.weights = weights
         self.epsilon_decay = epsilon_decay
         self.epsilon_floor = epsilon_floor
+        self.timeout_nonterminal = timeout_nonterminal
         self.obs_transform = obs_transform or (lambda x: x)
         self.remote_act = remote_act
 
@@ -105,20 +115,27 @@ class R2D2Actor:
             next_obs_raw, reward, done, infos = self.env.step(action)
             next_obs = self.obs_transform(next_obs_raw)
 
+            # Stable mode: a time-limit truncation is recorded (and
+            # carried) as if the episode continued — see __init__.
+            rec_done = done
+            if self.timeout_nonterminal:
+                trunc = np.asarray(infos.get("truncated", np.zeros_like(done)))
+                rec_done = done & ~trunc
+
             acc.append(
                 state=self._obs,
                 previous_action=self._prev_action,
                 action=action,
                 reward=reward.astype(np.float32),
-                done=done,
+                done=rec_done,
             )
 
-            keep = (~done).astype(np.float32)[:, None]
+            keep = (~rec_done).astype(np.float32)[:, None]
             self._h = np.asarray(h) * keep
             self._c = np.asarray(c) * keep
-            self._prev_action = np.where(done, 0, action).astype(np.int32)
+            self._prev_action = np.where(rec_done, 0, action).astype(np.int32)
             self._obs = next_obs
-            self._episodes += done
+            self._episodes += done  # exploration anneals per TRUE episode
             for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 

@@ -47,6 +47,9 @@ class XformerActor:
         seed: int = 0,
         epsilon_decay: float = 0.1,  # `train_r2d2.py:221`
         epsilon_floor: float = 0.15,
+        timeout_nonterminal: bool = False,  # stable mode: record time-limit
+        # truncations as non-terminal (see R2D2Actor — same time-limit
+        # aliasing pathology, same fix). False = reference parity.
         obs_transform=None,  # e.g. envs.cartpole.pomdp_project
         remote_act=None,  # SEED-style: RemoteInference; no weight pulls at all
     ):
@@ -63,6 +66,7 @@ class XformerActor:
         # epsilons (`train_apex.py:229`) — keeps the data stream
         # informative until the attention features settle.
         self.epsilon_floor = epsilon_floor
+        self.timeout_nonterminal = timeout_nonterminal
         self.obs_transform = obs_transform or (lambda x: x)
         self.remote_act = remote_act
 
@@ -127,18 +131,25 @@ class XformerActor:
             next_obs_raw, reward, done, infos = self.env.step(action)
             next_obs = self.obs_transform(next_obs_raw)
 
+            # Stable mode: a time-limit truncation is recorded (and
+            # windowed) as if the episode continued — see R2D2Actor.
+            rec_done = done
+            if self.timeout_nonterminal:
+                trunc = np.asarray(infos.get("truncated", np.zeros_like(done)))
+                rec_done = done & ~trunc
+
             acc.append(
                 state=self._obs,
                 previous_action=self._prev_action,
                 action=action,
                 reward=reward.astype(np.float32),
-                done=done,
+                done=rec_done,
             )
 
-            self._win_done[:, -1] = done  # now known; future windows see it
-            self._prev_action = np.where(done, 0, action).astype(np.int32)
+            self._win_done[:, -1] = rec_done  # now known; future windows see it
+            self._prev_action = np.where(rec_done, 0, action).astype(np.int32)
             self._obs = next_obs
-            self._episodes += done
+            self._episodes += done  # exploration anneals per TRUE episode
             for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 

@@ -42,6 +42,9 @@ class RuntimeConfig:
     expert_parallel: int = 1  # xformer MoE: devices carving the `expert` axis
     epsilon_floor: float = 0.0  # r2d2 actors: residual exploration floor
     # (0 = reference-parity decay to ~greedy; stable mode uses e.g. 0.02)
+    timeout_nonterminal: bool = False  # r2d2/xformer actors: record
+    # time-limit truncations as non-terminal (stable mode; removes the
+    # time-limit-aliasing collapse cycle. False = reference parity)
 
 
 def check_config(rt: RuntimeConfig, num_actions: int) -> None:
@@ -74,6 +77,7 @@ def _runtime_from_section(algo: str, d: dict[str, Any]) -> RuntimeConfig:
         seq_parallel=d.get("seq_parallel", 1),
         expert_parallel=d.get("expert_parallel", 1),
         epsilon_floor=d.get("epsilon_floor", 0.0),
+        timeout_nonterminal=d.get("timeout_nonterminal", False),
     )
 
 

@@ -129,12 +129,17 @@ FAMILIES = {
     "apex_cartpole": lambda s, seed=0: run_apex_cartpole(int(2500 * s), seed=seed),
     "r2d2_cartpole_pomdp": lambda s, seed=0: _config_family(
         "r2d2", int(2000 * s), seed=seed),
-    # Stable mode (VERDICT r3 item 5): the R2D2 paper's eta-mixture
-    # sequence priority + a residual epsilon floor; defaults elsewhere
-    # stay reference-faithful. Expectation: no replay-collapse cycles.
+    # Stable mode (VERDICT r3 item 5): the full recipe — eta-mixture
+    # sequence priority, Adam global-norm clip, residual epsilon floor,
+    # and TIME-LIMIT NON-TERMINAL recording. Ablations (r4 probes):
+    # eta/clip/floor/epsilon-ladder each still cycle
+    # (15->160->15->...); flipping the 200-cap truncation to
+    # non-terminal removes the collapse — the cycle driver is
+    # time-limit aliasing, not priorities or exploration.
     "r2d2_cartpole_pomdp_stable": lambda s, seed=0: _config_family(
         "r2d2", int(2000 * s), seed=seed,
-        agent_overrides={"priority_eta": 0.9}, epsilon_floor=0.02),
+        agent_overrides={"priority_eta": 0.9, "gradient_clip_norm": 40.0},
+        epsilon_floor=0.02, timeout_nonterminal=True),
     "xformer_cartpole_pomdp": lambda s, seed=0: _config_family(
         "xformer", int(2000 * s), seed=seed),
     "ximpala_cartpole": lambda s, seed=0: _config_family(
