@@ -135,7 +135,14 @@ class R2D2Actor:
             self._c = np.asarray(c) * keep
             self._prev_action = np.where(rec_done, 0, action).astype(np.int32)
             self._obs = next_obs
-            self._episodes += done  # exploration anneals per TRUE episode
+            # Exploration anneals per RECORDED episode: under
+            # timeout_nonterminal a truncation does not advance the
+            # schedule, so epsilon keeps decaying while the agent fails
+            # but FREEZES once episodes run to the cap — residual
+            # exploration persists exactly when the replay is at its most
+            # uniform (the measured collapse window). With the option off
+            # rec_done == done: reference parity.
+            self._episodes += rec_done
             for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 

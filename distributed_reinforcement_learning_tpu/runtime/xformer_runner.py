@@ -149,7 +149,9 @@ class XformerActor:
             self._win_done[:, -1] = rec_done  # now known; future windows see it
             self._prev_action = np.where(rec_done, 0, action).astype(np.int32)
             self._obs = next_obs
-            self._episodes += done  # exploration anneals per TRUE episode
+            # Anneal exploration per RECORDED episode (see R2D2Actor:
+            # freezes epsilon at the cap under timeout_nonterminal).
+            self._episodes += rec_done
             for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 

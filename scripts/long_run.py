@@ -61,13 +61,19 @@ def main() -> None:
     p.add_argument("--max-updates", type=int, default=12000)
     p.add_argument("--checkpoint-interval", type=int, default=250)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint dir (default: derived from --out, so two "
+                        "runs with different out files never share a "
+                        "checkpoint — restoring another config's params is "
+                        "silent nonsense)")
     args = p.parse_args()
 
     out_dir = os.path.dirname(os.path.abspath(args.out))
     os.makedirs(out_dir, exist_ok=True)
-    ckpt_dir = os.path.join(out_dir, "ckpt_" + args.section)
+    stem = os.path.splitext(os.path.basename(args.out))[0]
+    ckpt_dir = args.ckpt_dir or os.path.join(out_dir, "ckpt_" + stem)
     stop_file = os.path.join(out_dir, "STOP")
-    tmp_out = os.path.join(out_dir, ".chunk_result.json")
+    tmp_out = os.path.join(out_dir, f".chunk_result_{stem}.json")
 
     # Resume the DRIVER too: continue from the updates already recorded.
     done_updates = 0

@@ -416,7 +416,10 @@ class TestTruncationInfo:
 
         dones_ref, actor_ref = run(False)
         assert dones_ref.any(), "cap at 3 must record dones in parity mode"
+        assert (actor_ref._episodes > 0).all()  # parity: anneal per done
         dones_stable, actor_stable = run(True)
         assert not dones_stable.any(), "truncations must record done=False"
-        # True episodes still drive exploration annealing in both modes.
-        assert (actor_stable._episodes > 0).all()
+        # Exploration anneals per RECORDED episode: all endings here were
+        # truncations, so epsilon is frozen (stays high at the cap —
+        # the collapse-window exploration property).
+        assert (actor_stable._episodes == 0).all()
