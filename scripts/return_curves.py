@@ -139,7 +139,7 @@ FAMILIES = {
     "r2d2_cartpole_pomdp_stable": lambda s, seed=0: _config_family(
         "r2d2", int(2000 * s), seed=seed,
         agent_overrides={"priority_eta": 0.9, "gradient_clip_norm": 40.0},
-        epsilon_floor=0.02, timeout_nonterminal=True),
+        epsilon_floor=0.10, timeout_nonterminal=True),
     "xformer_cartpole_pomdp": lambda s, seed=0: _config_family(
         "xformer", int(2000 * s), seed=seed),
     "ximpala_cartpole": lambda s, seed=0: _config_family(
