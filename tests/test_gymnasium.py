@@ -68,10 +68,15 @@ class TestAdapterContract:
 
         monkeypatch.delenv("DRL_SYNTHETIC_ATARI", raising=False)
         monkeypatch.setattr(registry, "_warned_synthetic", set())
+        # Seaquest has no in-tree simulator -> SyntheticAtari fallback.
+        # (Pong routes to the real Pong sim since r4, Breakout since r3.)
+        make_env("SeaquestDeterministic-v4", seed=0, num_actions=18)
+        make_env("SeaquestDeterministic-v4", seed=1, num_actions=18)
         make_env("PongDeterministic-v4", seed=0, num_actions=6)
         make_env("PongDeterministic-v4", seed=1, num_actions=6)
         err = capsys.readouterr().err
         assert err.count("SyntheticAtari") == 1  # once per name, not per env
+        assert err.count("Pong simulator") == 1  # sim fallback warns too
 
 
 def test_impala_learns_on_gymnasium_cartpole():
