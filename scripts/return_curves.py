@@ -206,6 +206,7 @@ def main() -> None:
         merged.update(summaries)
         with open(path, "w") as f:
             json.dump(merged, f, indent=2)
+            f.write("\n")
     print(json.dumps(summaries))
 
 
