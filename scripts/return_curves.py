@@ -143,6 +143,13 @@ FAMILIES = {
         agent_overrides={"priority_eta": 0.9}, epsilon_floor=0.02),
     "xformer_cartpole_pomdp": lambda s, seed=0: _config_family(
         "xformer", int(2000 * s), seed=seed),
+    # Transformer-R2D2 stable mode: same shared-mixin knobs as R2D2
+    # (the xformer actor already ships a 0.15 epsilon floor by default;
+    # this adds the eta priority + Adam clip). r3's reference-mode curve
+    # was the weakest of the five families (late-20 38.8, peak 168).
+    "xformer_cartpole_pomdp_stable": lambda s, seed=0: _config_family(
+        "xformer", int(2000 * s), seed=seed,
+        agent_overrides={"priority_eta": 0.9, "gradient_clip_norm": 40.0}),
     "ximpala_cartpole": lambda s, seed=0: _config_family(
         "ximpala", int(2000 * s), seed=seed),
     # IMPALA/Ape-X on the Breakout simulator (conv path; batch reduced so
