@@ -1,0 +1,351 @@
+"""Breakout as pure-JAX functions: the on-device (Anakin) pixel env.
+
+Same game as `envs.breakout_sim.BreakoutCore` (the faithful ALE-spec
+proxy — see its fidelity notes), re-expressed as jittable pure functions
+over a batch of N games so whole collect+learn loops run inside one
+compiled TPU program (the Podracer "Anakin" pattern, arXiv:2104.06272).
+This is the configuration that makes a DECISIVE Breakout score reachable
+in this image: the host loop tops out at a few hundred frames/s on the
+single CPU core (`benchmarks/longrun/ANALYSIS.md`), while this path
+collects and learns at chip rate.
+
+Dynamics parity: constants and update order are imported from / mirror
+`breakout_sim.py` line for line (paddle ±4/frame, 2 collision substeps,
+hit-position steering, row-scored bricks, 5 lives, frameskip held
+action). Divergences, all deliberate and documented:
+
+- float32 instead of Python float64 physics (TPU-native; positions are
+  halves so most arithmetic is exact anyway);
+- the launch velocity draw uses `jax.random` instead of
+  `np.random.RandomState` — same support {-2,-1,1,2}, different stream;
+- the score strip and lives indicator are NOT rendered: the reference
+  crop (`wrappers.py:74`, rows 18:102 of the 110-row resize = source
+  scanlines ~34..195) removes scanlines 0..34 entirely, so those pixels
+  can never reach an observation;
+- no fire-reset wrapper: the 4-action set includes FIRE and the policy
+  learns to serve (standard for vectorized ALE training loops); a lost
+  life is surfaced as `done` to the learner (the reference's life-loss
+  shaping, `train_impala.py:149-154`) while the game only restarts on
+  a true game-over, exactly the EpisodicLife semantics the reference's
+  shaping approximates.
+
+The observation pipeline runs on-device and matches
+`envs.atari.AtariPreprocessor` stage for stage: 2-frame max over
+consecutive post-frameskip raw frames -> luma -> INTER_AREA resize to
+110x84 (the separable overlap weights of `atari.area_resize`, folded to
+an 84x210 matrix by pre-cropping the row weights) -> [84, 84] uint8 ->
+4-frame newest-last stack. The resize is two small matmuls per frame —
+MXU work, which is the point of doing it on-device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.envs import breakout_sim as sim
+from distributed_reinforcement_learning_tpu.envs.atari import _area_weights
+
+NUM_ACTIONS = sim.BreakoutCore.num_actions  # NOOP / FIRE / RIGHT / LEFT
+OBS_SHAPE = (84, 84, 4)
+
+H, W = sim.H, sim.W
+_BALL = sim.BALL_SIZE
+
+# -- static render tables ---------------------------------------------------
+
+_YS = np.arange(H)[:, None]  # [210, 1]
+_XS = np.arange(W)[None, :]  # [1, 160]
+
+# Walls (drawn below everything else, exactly breakout_sim.render's order).
+_BASE = np.zeros((H, W, 3), np.uint8)
+_BASE[sim.WALL_TOP:sim.WALL_TOP + 4, :] = sim.WALL
+_BASE[sim.WALL_TOP:, :sim.WALL_SIDE] = sim.WALL
+_BASE[sim.WALL_TOP:, W - sim.WALL_SIDE:] = sim.WALL
+
+# Per-pixel brick coordinates: which (row, col) a pixel belongs to, and
+# whether it is inside the brick field at all.
+_ROW_IDX = np.clip((_YS - sim.BRICK_TOP) // sim.BRICK_H, 0, 5)  # [210, 1]
+_COL_IDX = np.clip((_XS - sim.WALL_SIDE) // sim.BRICK_W, 0, 17)  # [1, 160]
+_IN_FIELD = (
+    (_YS >= sim.BRICK_TOP) & (_YS < sim.BRICK_TOP + 6 * sim.BRICK_H)
+    & (_XS >= sim.WALL_SIDE) & (_XS < sim.WALL_SIDE + 18 * sim.BRICK_W)
+)  # [210, 160]
+_ROW_RGB = np.asarray(sim.ROW_COLORS, np.uint8)  # [6, 3]
+_SPRITE = np.asarray(sim.SPRITE, np.uint8)
+_ROW_POINTS = np.asarray(sim.ROW_POINTS, np.float32)
+
+# Preprocessing weights (`atari.preprocess_frame` parity): resize rows
+# 210 -> 110 then crop [18:102] == one 84x210 matrix; cols 160 -> 84.
+_WH_CROP = np.asarray(_area_weights(H, 110))[18:102, :]  # [84, 210]
+_WW_T = np.asarray(_area_weights(W, 84)).T  # [160, 84]
+_LUMA = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+class BreakoutState(NamedTuple):
+    """Batched game + observation-pipeline state (`[N, ...]` leaves)."""
+
+    bricks: jax.Array      # [N, 6, 18] bool
+    lives: jax.Array       # [N] i32
+    frames: jax.Array      # [N] i32 emulated frames this episode
+    paddle_x: jax.Array    # [N] f32 (integer-valued)
+    ball_dead: jax.Array   # [N] bool — awaiting FIRE
+    ball_x: jax.Array      # [N] f32
+    ball_y: jax.Array      # [N] f32
+    vx: jax.Array          # [N] f32
+    vy: jax.Array          # [N] f32
+    prev_raw: jax.Array    # [N, 210, 160, 3] u8 — last adapter-step frame
+    stack: jax.Array       # [N, 84, 84, 4] u8 — current observation
+    returns: jax.Array     # [N] f32 raw (unclipped) episode return
+
+
+# -- rendering + preprocessing (single env; vmapped) ------------------------
+
+
+def _render(bricks, paddle_x, ball_dead, ball_x, ball_y) -> jax.Array:
+    """`[210, 160, 3]` uint8 frame, `breakout_sim.render` draw order."""
+    f = jnp.asarray(_BASE)
+    alive = bricks[jnp.asarray(_ROW_IDX[:, 0])][:, jnp.asarray(_COL_IDX[0, :])]
+    brick_mask = alive & jnp.asarray(_IN_FIELD)
+    row_colors = jnp.asarray(_ROW_RGB)[jnp.asarray(_ROW_IDX[:, 0])]  # [210, 3]
+    f = jnp.where(brick_mask[:, :, None], row_colors[:, None, :], f)
+
+    px = paddle_x.astype(jnp.int32)
+    ys, xs = jnp.asarray(_YS), jnp.asarray(_XS)
+    paddle = (
+        (ys >= sim.PADDLE_Y) & (ys < sim.PADDLE_Y + sim.PADDLE_H)
+        & (xs >= px) & (xs < px + sim.PADDLE_W)
+    )
+    f = jnp.where(paddle[:, :, None], jnp.asarray(_SPRITE), f)
+
+    by = ball_y.astype(jnp.int32)
+    bx = ball_x.astype(jnp.int32)
+    ball = (
+        (~ball_dead)
+        & (ys >= by) & (ys < by + _BALL)
+        & (xs >= bx) & (xs < bx + _BALL)
+    )
+    return jnp.where(ball[:, :, None], jnp.asarray(_SPRITE), f)
+
+
+def _preprocess(maxed_rgb: jax.Array) -> jax.Array:
+    """`[210, 160, 3]` u8 -> `[84, 84]` u8 (luma, area-resize, crop)."""
+    luma = maxed_rgb.astype(jnp.float32) @ jnp.asarray(_LUMA)  # [210, 160]
+    resized = jnp.asarray(_WH_CROP) @ luma @ jnp.asarray(_WW_T)  # [84, 84]
+    return resized.astype(jnp.uint8)
+
+
+# -- physics (single env; vmapped) ------------------------------------------
+
+
+def _collide(bricks, paddle_x, lives, x, y, vx, vy, dead, reward):
+    """One `breakout_sim._collide` pass; returns updated running values."""
+    # Side walls.
+    x = jnp.clip(x, sim.WALL_SIDE, W - sim.WALL_SIDE - _BALL)
+    vx = jnp.where(x <= sim.WALL_SIDE, jnp.abs(vx), vx)
+    vx = jnp.where(x >= W - sim.WALL_SIDE - _BALL, -jnp.abs(vx), vx)
+    # Top wall.
+    vy = jnp.where(y <= sim.WALL_TOP + 4, jnp.abs(vy), vy)
+    y = jnp.maximum(y, jnp.float32(sim.WALL_TOP + 4))
+    # Bricks (the moving ball can hit at most one per substep).
+    row = jnp.floor((y - sim.BRICK_TOP) / sim.BRICK_H).astype(jnp.int32)
+    col = jnp.floor((x - sim.WALL_SIDE) / sim.BRICK_W).astype(jnp.int32)
+    rc = jnp.clip(row, 0, 5)
+    cc = jnp.clip(col, 0, 17)
+    hit = (
+        (row >= 0) & (row < 6) & (col >= 0) & (col < 18)
+        & bricks[rc, cc] & ~dead
+    )
+    knock = hit & (jnp.arange(6)[:, None] == rc) & (jnp.arange(18)[None, :] == cc)
+    bricks = bricks & ~knock
+    reward = reward + jnp.where(hit, jnp.asarray(_ROW_POINTS)[rc], 0.0)
+    vy = jnp.where(hit, -vy, vy)
+    # Paddle (hit position steers, exactly the sim's formula).
+    on_paddle = (
+        (vy > 0)
+        & (y >= sim.PADDLE_Y - _BALL) & (y <= sim.PADDLE_Y + sim.PADDLE_H)
+        & (x >= paddle_x - _BALL) & (x <= paddle_x + sim.PADDLE_W)
+        & ~dead
+    )
+    off = (x + _BALL / 2 - paddle_x - sim.PADDLE_W / 2) / (sim.PADDLE_W / 2)
+    steered = jnp.clip(vx + 2.0 * off, -3.0, 3.0)
+    steered = jnp.where(
+        jnp.abs(steered) < 0.5, jnp.where(off >= 0, 0.5, -0.5), steered)
+    vx = jnp.where(on_paddle, steered, vx)
+    vy = jnp.where(on_paddle, -jnp.abs(vy), vy)
+    # Bottom: life lost.
+    lost = (y >= H - _BALL) & ~dead
+    lives = lives - lost.astype(jnp.int32)
+    dead = dead | lost
+    return bricks, lives, x, y, vx, vy, dead, reward
+
+
+def _emulate_frame(carry, action, launch_vx, max_frames):
+    """One emulated frame under a held action (`_emulate_frame` parity).
+
+    `carry` holds the running per-env scalars plus `halted` — set once
+    the episode ended mid-frameskip, freezing the remaining frames the
+    way the numpy loop's `break` does.
+    """
+    (bricks, lives, frames, paddle_x, dead, x, y, vx, vy, reward,
+     halted) = carry
+    live = ~halted
+    frames = frames + live.astype(jnp.int32)
+
+    paddle_x = jnp.where(
+        live & (action == sim.RIGHT),
+        jnp.minimum(jnp.float32(W - sim.WALL_SIDE - sim.PADDLE_W), paddle_x + 4),
+        paddle_x)
+    paddle_x = jnp.where(
+        live & (action == sim.LEFT),
+        jnp.maximum(jnp.float32(sim.WALL_SIDE), paddle_x - 4),
+        paddle_x)
+    fire = live & (action == sim.FIRE) & dead & (lives > 0)
+    x = jnp.where(fire, paddle_x + sim.PADDLE_W // 2, x)
+    y = jnp.where(fire, jnp.float32(sim.PADDLE_Y - 8), y)
+    vx = jnp.where(fire, launch_vx, vx)
+    vy = jnp.where(fire, jnp.float32(-3.0), vy)
+    dead = dead & ~fire
+
+    # Two collision substeps (anti-tunnelling, `breakout_sim.py:130-140`).
+    for _ in range(2):
+        moving = live & ~dead
+        x = x + jnp.where(moving, vx / 2.0, 0.0)
+        y = y + jnp.where(moving, vy / 2.0, 0.0)
+        bricks2, lives2, x2, y2, vx2, vy2, dead2, reward2 = _collide(
+            bricks, paddle_x, lives, x, y, vx, vy, dead, reward)
+        keep = moving  # scalar under vmap: broadcasts over every shape
+        bricks = jnp.where(keep, bricks2, bricks)
+        lives = jnp.where(keep, lives2, lives)
+        x = jnp.where(keep, x2, x)
+        y = jnp.where(keep, y2, y)
+        vx = jnp.where(keep, vx2, vx)
+        vy = jnp.where(keep, vy2, vy)
+        dead = jnp.where(keep, dead2, dead)
+        reward = jnp.where(keep, reward2, reward)
+
+    game_over = (lives <= 0) | ~bricks.any() | (frames >= max_frames)
+    halted = halted | (live & game_over)
+    return (bricks, lives, frames, paddle_x, dead, x, y, vx, vy, reward,
+            halted)
+
+
+# -- public API (cartpole_jax contract) -------------------------------------
+
+
+def _reset_fields(n: int):
+    return dict(
+        bricks=jnp.ones((n, 6, 18), bool),
+        lives=jnp.full((n,), 5, jnp.int32),
+        frames=jnp.zeros((n,), jnp.int32),
+        paddle_x=jnp.full((n,), float((W - sim.PADDLE_W) // 2), jnp.float32),
+        ball_dead=jnp.ones((n,), bool),
+        ball_x=jnp.zeros((n,), jnp.float32),
+        ball_y=jnp.zeros((n,), jnp.float32),
+        vx=jnp.zeros((n,), jnp.float32),
+        vy=jnp.zeros((n,), jnp.float32),
+        returns=jnp.zeros((n,), jnp.float32),
+    )
+
+
+def reset(rng: jax.Array, num_envs: int) -> tuple[BreakoutState, jax.Array]:
+    """-> (state, obs `[N, 84, 84, 4]` u8). `rng` unused (reset is
+    deterministic: centered paddle, dead ball awaiting FIRE), kept for
+    the cartpole_jax signature."""
+    del rng
+    f = _reset_fields(num_envs)
+    raw = jax.vmap(_render)(
+        f["bricks"], f["paddle_x"], f["ball_dead"], f["ball_x"], f["ball_y"])
+    frame = jax.vmap(_preprocess)(raw)  # 1-frame buffer on reset
+    stack = jnp.zeros((num_envs, 84, 84, 4), jnp.uint8)
+    stack = stack.at[..., -1].set(frame)
+    state = BreakoutState(prev_raw=raw, stack=stack, **f)
+    return state, state.stack
+
+
+@functools.partial(jax.jit, static_argnames=("frameskip", "max_frames",
+                                             "life_loss"))
+def step(
+    state: BreakoutState,
+    actions: jax.Array,
+    rng: jax.Array,
+    frameskip: int = 4,
+    max_frames: int = 10_000,
+    life_loss: bool = True,
+) -> tuple[BreakoutState, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """-> (state', obs', reward, done, episode_return).
+
+    Contract matches `cartpole_jax.step`: `obs'` holds the RESET
+    observation for game-over slots, `episode_return` is the completed
+    raw return where the game ended else 0. `done` is the TRAINING
+    signal: game-over or (with `life_loss`) a lost life — the
+    reference's shaping (`train_impala.py:149-154`).
+    """
+    n = state.lives.shape[0]
+    lives_before = state.lives
+    # One launch-velocity draw per emulated frame, like the sim's
+    # per-launch `choice` — only consumed by a FIRE on a dead ball.
+    draws = jax.random.randint(rng, (frameskip, n), 0, 4)
+    launch_vx = jnp.asarray([-2.0, -1.0, 1.0, 2.0], jnp.float32)[draws]
+
+    carry = (state.bricks, state.lives, state.frames, state.paddle_x,
+             state.ball_dead, state.ball_x, state.ball_y, state.vx, state.vy,
+             jnp.zeros((n,), jnp.float32), jnp.zeros((n,), bool))
+    actions = actions.astype(jnp.int32)
+    emulate = jax.vmap(_emulate_frame, in_axes=(0, 0, 0, None))
+    for i in range(frameskip):  # static unroll: action held, break-on-done
+        carry = emulate(carry, actions, launch_vx[i], max_frames)
+    (bricks, lives, frames, paddle_x, ball_dead, ball_x, ball_y, vx, vy,
+     reward, game_over) = carry
+
+    raw = jax.vmap(_render)(bricks, paddle_x, ball_dead, ball_x, ball_y)
+    maxed = jnp.maximum(raw, state.prev_raw)
+    frame = jax.vmap(_preprocess)(maxed)
+    stack = jnp.concatenate([state.stack[..., 1:], frame[..., None]], axis=-1)
+
+    returns = state.returns + reward
+    episode_return = jnp.where(game_over, returns, 0.0)
+    lost_life = lives < lives_before
+    done = (game_over | lost_life) if life_loss else game_over
+
+    # Auto-reset game-over slots (fresh board; obs = reset observation).
+    fresh = _reset_fields(n)
+    raw0 = jax.vmap(_render)(
+        fresh["bricks"], fresh["paddle_x"], fresh["ball_dead"],
+        fresh["ball_x"], fresh["ball_y"])
+    frame0 = jax.vmap(_preprocess)(raw0)
+    stack0 = jnp.zeros_like(stack).at[..., -1].set(frame0)
+
+    def pick(reset_val, cont_val):
+        mask = game_over.reshape((n,) + (1,) * (cont_val.ndim - 1))
+        return jnp.where(mask, reset_val, cont_val)
+
+    new_state = BreakoutState(
+        bricks=pick(fresh["bricks"], bricks),
+        lives=pick(fresh["lives"], lives),
+        frames=pick(fresh["frames"], frames),
+        paddle_x=pick(fresh["paddle_x"], paddle_x),
+        ball_dead=pick(fresh["ball_dead"], ball_dead),
+        ball_x=pick(fresh["ball_x"], ball_x),
+        ball_y=pick(fresh["ball_y"], ball_y),
+        vx=pick(fresh["vx"], vx),
+        vy=pick(fresh["vy"], vy),
+        prev_raw=pick(raw0, raw),
+        stack=pick(stack0, stack),
+        returns=pick(fresh["returns"], returns),
+    )
+    return new_state, new_state.stack, reward, done, episode_return
+
+
+def completed_episode_mask(done: jax.Array, new_state: BreakoutState) -> jax.Array:
+    """Which `done` slots ended a GAME (vs a life-loss boundary).
+
+    The auto-reset restores 5 lives; a life-loss done leaves <=4. Lets
+    callers count true episodes (including zero-return ones, which
+    `episode_return != 0` would miss) without a second done channel.
+    """
+    return done & (new_state.lives == 5)

@@ -92,3 +92,10 @@ def step(
     )
     reward = jnp.ones(physics.shape[0], jnp.float32)
     return new_state, new_state.physics, reward, done, episode_return
+
+
+def completed_episode_mask(done: jax.Array, new_state: CartPoleState) -> jax.Array:
+    """Every CartPole `done` is a completed episode (no life-loss
+    boundaries); part of the jittable-env contract (`breakout_jax`)."""
+    del new_state
+    return done

@@ -23,7 +23,7 @@ Semantics per update, matching `runtime/impala_runner.py`:
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -35,8 +35,8 @@ from distributed_reinforcement_learning_tpu.envs import cartpole_jax
 
 class AnakinState(NamedTuple):
     train: TrainState
-    env: cartpole_jax.CartPoleState
-    obs: jax.Array  # [B, 4]
+    env: Any  # the env module's own state NamedTuple
+    obs: jax.Array  # [B, *obs_shape]
     prev_action: jax.Array  # [B] i32
     h: jax.Array  # [B, H]
     c: jax.Array  # [B, H]
@@ -44,16 +44,29 @@ class AnakinState(NamedTuple):
 
 
 class AnakinImpala:
-    """IMPALA over the pure-JAX CartPole, everything on-device.
+    """IMPALA over a pure-JAX env, everything on-device.
 
+    `env` is any module following the `cartpole_jax` contract
+    (`OBS_SHAPE`, `NUM_ACTIONS`, `reset(rng, n) -> (state, obs)`,
+    `step(state, actions, rng) -> (state, obs, reward, done, ep_ret)`) —
+    `envs.cartpole_jax` (default) or `envs.breakout_jax`, the pixel env
+    that makes chip-rate Breakout training possible in this image.
     `num_envs` is the batch dim B; `agent.cfg.trajectory` the unroll T.
+    A policy head wider than the env's action set is aliased with
+    `action % NUM_ACTIONS`, the reference's convention
+    (`train_impala.py:145`).
     """
 
-    def __init__(self, agent: ImpalaAgent, num_envs: int, mesh=None):
-        if agent.cfg.obs_shape != cartpole_jax.OBS_SHAPE:
+    def __init__(self, agent: ImpalaAgent, num_envs: int, mesh=None, env=None):
+        self.env = env if env is not None else cartpole_jax
+        if tuple(agent.cfg.obs_shape) != tuple(self.env.OBS_SHAPE):
             raise ValueError(
-                f"AnakinImpala runs the JAX CartPole (obs {cartpole_jax.OBS_SHAPE}); "
-                f"config has obs_shape={agent.cfg.obs_shape}")
+                f"env obs shape {self.env.OBS_SHAPE} != "
+                f"config obs_shape={agent.cfg.obs_shape}")
+        if agent.cfg.num_actions < self.env.NUM_ACTIONS:
+            raise ValueError(
+                f"policy head ({agent.cfg.num_actions}) narrower than the "
+                f"env's action set ({self.env.NUM_ACTIONS})")
         self.agent = agent
         self.num_envs = num_envs
         self.mesh = mesh
@@ -82,9 +95,11 @@ class AnakinImpala:
                     f"({mesh.shape.get('data', 1)})")
             abstract = jax.eval_shape(agent.init_state, jax.random.PRNGKey(0))
             train_sh = train_state_sharding(mesh, abstract)
+            env_abstract, _ = jax.eval_shape(
+                lambda k: self.env.reset(k, num_envs), jax.random.PRNGKey(0))
             self._state_sharding = AnakinState(
                 train=train_sh,
-                env=cartpole_jax.CartPoleState(physics=data, steps=data, returns=data),
+                env=jax.tree.map(lambda _: data, env_abstract),
                 obs=data, prev_action=data, h=data, c=data, rng=repl,
             )
             self.train_chunk = jax.jit(
@@ -92,6 +107,7 @@ class AnakinImpala:
                 in_shardings=(self._state_sharding,),
                 out_shardings=(self._state_sharding, repl),
             )
+        self._greedy_eval_jit = jax.jit(self._greedy_eval, static_argnums=(1, 2))
 
     def init(self, rng: jax.Array) -> AnakinState:
         # Three distinct streams: params init, env reset, and the ongoing
@@ -99,7 +115,7 @@ class AnakinImpala:
         # key collide with the env-reset key under partitionable threefry).
         k_train, k_env, k_run = jax.random.split(rng, 3)
         train = self.agent.init_state(k_train)
-        env, obs = cartpole_jax.reset(k_env, self.num_envs)
+        env, obs = self.env.reset(k_env, self.num_envs)
         h, c = self.agent.initial_lstm_state(self.num_envs)
         state = AnakinState(
             train=train,
@@ -114,12 +130,20 @@ class AnakinImpala:
             state = jax.device_put(state, self._state_sharding)
         return state
 
+    def _env_action(self, action: jax.Array) -> jax.Array:
+        """Alias a wider policy head onto the env's action set
+        (`action % available_action`, `train_impala.py:145`)."""
+        if self.agent.cfg.num_actions != self.env.NUM_ACTIONS:
+            return action % self.env.NUM_ACTIONS
+        return action
+
     # -- one env step (scanned T times per update) -----------------------
     def _env_step(self, params, carry, _):
         env, obs, prev_action, h, c, rng = carry
         rng, k_act, k_env = jax.random.split(rng, 3)
         out = self.agent._act(params, obs, prev_action, h, c, k_act)
-        env, next_obs, reward, done, ep_ret = cartpole_jax.step(env, out.action, k_env)
+        env, next_obs, reward, done, ep_ret = self.env.step(
+            env, self._env_action(out.action), k_env)
         record = dict(
             state=obs,
             reward=reward,
@@ -164,3 +188,48 @@ class AnakinImpala:
     def _train_chunk(self, state: AnakinState, num_updates: int):
         """U updates in one compiled program -> (state, stacked metrics)."""
         return jax.lax.scan(self._update, state, None, length=num_updates)
+
+    # -- greedy evaluation (argmax policy, fresh envs, all on-device) ----
+    def _greedy_eval(self, params, num_envs: int, num_steps: int, rng):
+        k_reset, k_run = jax.random.split(rng)
+        env, obs = self.env.reset(k_reset, num_envs)
+        h, c = self.agent.initial_lstm_state(num_envs)
+        pa = jnp.zeros(num_envs, jnp.int32)
+        mask_fn = getattr(self.env, "completed_episode_mask",
+                          lambda done, _state: done)
+
+        def step_fn(carry, k):
+            env, obs, pa, h, c = carry
+            out = self.agent.model.apply(
+                params, self.agent._prep_obs(obs), pa, h, c)
+            action = jnp.argmax(out.policy, axis=-1).astype(jnp.int32)
+            env, next_obs, _r, done, ep = self.env.step(
+                env, self._env_action(action), k)
+            keep = (~done).astype(out.h.dtype)[:, None]
+            carry = (env, next_obs, jnp.where(done, 0, action),
+                     out.h * keep, out.c * keep)
+            return carry, (ep, mask_fn(done, env))
+
+        keys = jax.random.split(k_run, num_steps)
+        _, (eps, completed) = jax.lax.scan(
+            step_fn, (env, obs, pa, h, c), keys)
+        return {
+            "return_sum": (eps * completed.astype(jnp.float32)).sum(),
+            "episodes": completed.sum().astype(jnp.int32),
+        }
+
+    def greedy_eval(self, params, num_envs: int, num_steps: int, rng) -> dict:
+        """Deterministic (argmax) policy score on fresh envs.
+
+        -> {"mean_return", "episodes"}: completed-episode mean over a
+        `num_steps`-step rollout of `num_envs` parallel games — the
+        ground-truth score metric the behavior-policy return curves
+        approximate (`benchmarks/longrun/ANALYSIS.md` showed best-window
+        behavior returns can be pure order-statistic noise).
+        """
+        out = self._greedy_eval_jit(params, num_envs, num_steps, rng)
+        episodes = int(out["episodes"])
+        return {
+            "mean_return": float(out["return_sum"]) / max(episodes, 1),
+            "episodes": episodes,
+        }

@@ -198,14 +198,18 @@ def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, w
             available_action=rt.available_action[task % len(rt.available_action)],
             life_loss_shaping=atari, obs_transform=transform,
             remote_act=remote_act)
+    # None = keep the actor family's own epsilon-floor default (r2d2 0.0
+    # reference parity, xformer 0.15) instead of overriding it.
+    floor = {} if rt.epsilon_floor is None else {"epsilon_floor": rt.epsilon_floor}
     if algo == "xformer":
         return xformer_runner.XformerActor(
             agent, env, queue, weights, seed=seed, obs_transform=transform,
-            timeout_nonterminal=rt.timeout_nonterminal, remote_act=remote_act)
+            timeout_nonterminal=rt.timeout_nonterminal, remote_act=remote_act,
+            **floor)
     return r2d2_runner.R2D2Actor(
         agent, env, queue, weights, seed=seed, obs_transform=transform,
-        epsilon_floor=rt.epsilon_floor,
-        timeout_nonterminal=rt.timeout_nonterminal, remote_act=remote_act)
+        timeout_nonterminal=rt.timeout_nonterminal, remote_act=remote_act,
+        **floor)
 
 
 _RUN_SYNC = {

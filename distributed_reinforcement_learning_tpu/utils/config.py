@@ -40,8 +40,10 @@ class RuntimeConfig:
     updates_per_call: int = 1  # K optimizer steps per learn_many dispatch (all families)
     seq_parallel: int = 1  # xformer: devices carving the mesh's `seq` axis
     expert_parallel: int = 1  # xformer MoE: devices carving the `expert` axis
-    epsilon_floor: float = 0.0  # r2d2 actors: residual exploration floor
-    # (0 = reference-parity decay to ~greedy; stable mode uses e.g. 0.02)
+    epsilon_floor: float | None = None  # r2d2/xformer actors: residual
+    # exploration floor. None = each family's own default (r2d2 0.0 =
+    # reference-parity decay to ~greedy, xformer 0.15); stable-R2D2 mode
+    # uses e.g. 0.02.
     timeout_nonterminal: bool = False  # r2d2/xformer actors: record
     # time-limit truncations as non-terminal (stable mode; removes the
     # time-limit-aliasing collapse cycle. False = reference parity)
@@ -76,7 +78,7 @@ def _runtime_from_section(algo: str, d: dict[str, Any]) -> RuntimeConfig:
         updates_per_call=d.get("updates_per_call", 1),
         seq_parallel=d.get("seq_parallel", 1),
         expert_parallel=d.get("expert_parallel", 1),
-        epsilon_floor=d.get("epsilon_floor", 0.0),
+        epsilon_floor=d.get("epsilon_floor"),
         timeout_nonterminal=d.get("timeout_nonterminal", False),
     )
 

@@ -32,7 +32,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+# The image's sitecustomize pins the axon platform before env vars are
+# read, so mirror the (possibly user-set) env var into the live config.
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import numpy as np
 
@@ -44,7 +46,9 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--priority-eta", type=float, default=None)
     p.add_argument("--adam-clip", type=float, default=None)
-    p.add_argument("--epsilon-floor", type=float, default=0.0)
+    p.add_argument("--epsilon-floor", type=float, default=None,
+                   help="residual exploration floor; default keeps each "
+                        "family's own (r2d2 0.0, xformer 0.15)")
     p.add_argument("--timeout-nonterminal", action="store_true")
     p.add_argument("--target-sync", type=int, default=None)
     p.add_argument("--replay-capacity", type=int, default=None)
@@ -63,8 +67,9 @@ def main() -> None:
         agent_over["gradient_clip_norm"] = args.adam_clip
     if agent_over:
         agent_cfg = dataclasses.replace(agent_cfg, **agent_over)
-    rt_over = {"epsilon_floor": args.epsilon_floor,
-               "timeout_nonterminal": args.timeout_nonterminal}
+    rt_over = {"timeout_nonterminal": args.timeout_nonterminal}
+    if args.epsilon_floor is not None:
+        rt_over["epsilon_floor"] = args.epsilon_floor
     if args.target_sync is not None:
         rt_over["target_sync_interval"] = args.target_sync
     if args.replay_capacity is not None:

@@ -1,0 +1,197 @@
+"""JAX Breakout (`envs.breakout_jax`) parity + Anakin integration tests.
+
+The numpy simulator (`envs.breakout_sim`) plus the host preprocessing
+pipeline (`envs.atari.AtariPreprocessor`) is the semantics source; the
+JAX env must reproduce frames, physics, rewards, and the stacked
+observation stream from a matched state.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+from distributed_reinforcement_learning_tpu.envs import breakout_jax, breakout_sim
+from distributed_reinforcement_learning_tpu.envs.atari import AtariPreprocessor, preprocess_frame
+from distributed_reinforcement_learning_tpu.envs.breakout_sim import BreakoutSimRaw
+from distributed_reinforcement_learning_tpu.runtime.anakin import AnakinImpala
+
+
+def launched(core: breakout_sim.BreakoutCore, x=80.0, y=150.0, vx=1.0, vy=-3.0):
+    """Put a numpy core into a deterministic post-launch state."""
+    core._ball_dead = False
+    core.ball_x, core.ball_y = x, y
+    core.vx, core.vy = vx, vy
+
+
+def jax_launched(state, x=80.0, y=150.0, vx=1.0, vy=-3.0):
+    n = state.lives.shape[0]
+    return state._replace(
+        ball_dead=jnp.zeros(n, bool),
+        ball_x=jnp.full(n, x, jnp.float32),
+        ball_y=jnp.full(n, y, jnp.float32),
+        vx=jnp.full(n, vx, jnp.float32),
+        vy=jnp.full(n, vy, jnp.float32),
+    )
+
+
+class TestRenderParity:
+    def test_frame_matches_numpy_render_below_score_strip(self):
+        core = breakout_sim.BreakoutCore(seed=3)
+        core.reset()
+        core.bricks[2, 5] = False
+        core.bricks[0, :4] = False
+        core.paddle_x = 40
+        launched(core, x=100.0, y=120.0)
+        want = core.render()
+
+        state, _ = breakout_jax.reset(jax.random.PRNGKey(0), 1)
+        state = state._replace(
+            bricks=jnp.asarray(core.bricks)[None],
+            paddle_x=jnp.asarray([40.0], jnp.float32))
+        state = jax_launched(state, x=100.0, y=120.0)
+        got = np.asarray(jax.vmap(breakout_jax._render)(
+            state.bricks, state.paddle_x, state.ball_dead,
+            state.ball_x, state.ball_y))[0]
+
+        # The score strip (scanlines < WALL_TOP) is deliberately unrendered:
+        # the crop removes it from every observation.
+        np.testing.assert_array_equal(got[breakout_sim.WALL_TOP:],
+                                      want[breakout_sim.WALL_TOP:])
+        assert (got[:breakout_sim.WALL_TOP] == 0).all()
+
+    def test_preprocess_matches_host_pipeline(self):
+        """luma+resize+crop on device == `atari.preprocess_frame` (u8 +-1
+        from float-association differences in the resize matmuls)."""
+        core = breakout_sim.BreakoutCore(seed=5)
+        core.reset()
+        launched(core)
+        frame = core.render()
+        want = preprocess_frame(frame).astype(np.int32)
+        got = np.asarray(breakout_jax._preprocess(jnp.asarray(frame))).astype(np.int32)
+        assert np.abs(got - want).max() <= 1
+
+
+class TestDynamicsParity:
+    def test_tracks_host_pipeline_for_40_steps(self):
+        """Same launched state + same actions -> same rewards, lives, and
+        stacked observations as BreakoutSimRaw under AtariPreprocessor."""
+        pre = AtariPreprocessor(BreakoutSimRaw(seed=0, frameskip=4),
+                                fire_reset=False)
+        obs_h = pre.reset()
+        core = pre.env._core
+        launched(core)
+
+        state, obs_j = breakout_jax.reset(jax.random.PRNGKey(0), 1)
+        state = jax_launched(state)
+        assert np.abs(np.asarray(obs_j[0], np.int32)
+                      - obs_h.astype(np.int32)).max() <= 1
+
+        rng = np.random.default_rng(7)
+        actions = rng.choice([breakout_sim.NOOP, breakout_sim.RIGHT,
+                              breakout_sim.LEFT], size=40)
+        total_h = total_j = 0.0
+        for t, a in enumerate(actions):
+            obs_h, r_h, done_h, info_h = pre.step(int(a))
+            state, obs_j, r_j, done_j, _ = breakout_jax.step(
+                state, jnp.asarray([a]), jax.random.PRNGKey(100 + t),
+                life_loss=False)
+            total_h += r_h
+            total_j += float(r_j[0])
+            assert float(r_j[0]) == r_h, f"step {t}: reward {r_j[0]} != {r_h}"
+            assert int(state.lives[0]) == info_h["lives"], f"step {t}"
+            assert bool(done_j[0]) == done_h, f"step {t}"
+            assert np.abs(np.asarray(obs_j[0], np.int32)
+                          - obs_h.astype(np.int32)).max() <= 1, f"step {t}"
+            if done_h:
+                break
+        assert total_j == total_h
+        assert total_j > 0, "pattern never hit a brick; test is vacuous"
+        np.testing.assert_array_equal(np.asarray(state.bricks[0]), core.bricks)
+
+
+class TestEpisodeSemantics:
+    def _about_to_die(self, n=1, lives=1):
+        state, _ = breakout_jax.reset(jax.random.PRNGKey(0), n)
+        state = jax_launched(state, x=80.0, y=200.0, vx=0.0, vy=3.0)
+        return state._replace(
+            lives=jnp.full(n, lives, jnp.int32),
+            returns=jnp.full(n, 11.0, jnp.float32))
+
+    def test_life_loss_surfaces_done_without_reset(self):
+        state = self._about_to_die(lives=3)
+        bricks_before = np.asarray(state.bricks[0]).copy()
+        state, obs, r, done, ep = breakout_jax.step(
+            state, jnp.asarray([breakout_sim.NOOP]), jax.random.PRNGKey(1))
+        assert bool(done[0])
+        assert float(ep[0]) == 0.0  # not a real game over
+        assert int(state.lives[0]) == 2
+        assert bool(state.ball_dead[0])
+        np.testing.assert_array_equal(np.asarray(state.bricks[0]), bricks_before)
+
+    def test_game_over_resets_and_reports_return(self):
+        state = self._about_to_die(lives=1)
+        state = state._replace(bricks=state.bricks.at[0, 2, 5].set(False))
+        state, obs, r, done, ep = breakout_jax.step(
+            state, jnp.asarray([breakout_sim.NOOP]), jax.random.PRNGKey(1))
+        assert bool(done[0])
+        assert float(ep[0]) == 11.0
+        assert int(state.lives[0]) == 5
+        assert bool(np.asarray(state.bricks).all())
+        assert float(state.returns[0]) == 0.0
+        # The observation is the RESET observation: newest frame live,
+        # older stack slots zeroed.
+        assert (np.asarray(obs[0, :, :, :3]) == 0).all()
+        assert np.asarray(obs[0, :, :, 3]).any()
+
+    def test_life_loss_flag_off_mirrors_raw_done(self):
+        state = self._about_to_die(lives=3)
+        _, _, _, done, _ = breakout_jax.step(
+            state, jnp.asarray([breakout_sim.NOOP]), jax.random.PRNGKey(1),
+            life_loss=False)
+        assert not bool(done[0])
+
+    def test_fire_relaunches_after_life_loss(self):
+        state = self._about_to_die(lives=3)
+        state, *_ = breakout_jax.step(
+            state, jnp.asarray([breakout_sim.NOOP]), jax.random.PRNGKey(1))
+        assert bool(state.ball_dead[0])
+        state, *_ = breakout_jax.step(
+            state, jnp.asarray([breakout_sim.FIRE]), jax.random.PRNGKey(2))
+        assert not bool(state.ball_dead[0])
+        assert float(state.vy[0]) < 0
+
+
+class TestAnakinBreakout:
+    def cfg(self, **kw):
+        base = dict(obs_shape=(84, 84, 4), num_actions=4, trajectory=5,
+                    lstm_size=16, entropy_coef=0.01,
+                    start_learning_rate=1e-3, end_learning_rate=1e-3,
+                    fold_normalize=True)
+        base.update(kw)
+        return ImpalaConfig(**base)
+
+    def test_train_chunk_runs_and_is_finite(self):
+        anakin = AnakinImpala(ImpalaAgent(self.cfg()), num_envs=2,
+                              env=breakout_jax)
+        st = anakin.init(jax.random.PRNGKey(0))
+        st, m = anakin.train_chunk(st, 2)
+        assert int(st.train.step) == 2
+        assert np.isfinite(np.asarray(m["total_loss"])).all()
+        assert st.obs.dtype == jnp.uint8
+
+    def test_aliased_18_way_head(self):
+        """A reference-style 18-way head drives the 4-action env via
+        `action %% 4` (train_impala.py:145 parity)."""
+        anakin = AnakinImpala(ImpalaAgent(self.cfg(num_actions=18)),
+                              num_envs=2, env=breakout_jax)
+        st = anakin.init(jax.random.PRNGKey(0))
+        st, m = anakin.train_chunk(st, 1)
+        assert np.isfinite(np.asarray(m["total_loss"])).all()
+
+    def test_obs_shape_guard(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            AnakinImpala(ImpalaAgent(self.cfg(obs_shape=(4,), num_actions=4)),
+                         2, env=breakout_jax)
