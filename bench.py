@@ -364,6 +364,64 @@ def bench_anakin(num_envs: int, chunk: int, iters: int) -> dict:
     return out
 
 
+def bench_anakin_breakout(num_envs: int, chunk: int, iters: int) -> dict:
+    """Anakin over the PIXEL env (envs/breakout_jax.py): game dynamics,
+    sprite rendering, the full Atari preprocessing pipeline (2-frame
+    max, luma, INTER_AREA-resize matmuls, crop, 4-stack), act, and the
+    V-trace learn step — all inside one compiled scan. This answers the
+    e2e feed question for Atari-CLASS observations, not just vector
+    CartPole: frames/s here are 84x84x4 uint8 frames rendered,
+    preprocessed, collected, and learned on without touching the host.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+    from distributed_reinforcement_learning_tpu.envs import breakout_jax
+    from distributed_reinforcement_learning_tpu.runtime.anakin import AnakinImpala
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    cfg = ImpalaConfig(obs_shape=breakout_jax.OBS_SHAPE, num_actions=4,
+                       trajectory=20, lstm_size=256,
+                       entropy_coef=0.01, baseline_loss_coef=0.5,
+                       start_learning_rate=6e-4, end_learning_rate=6e-4,
+                       learning_frame=10**9, fold_normalize=True,
+                       dtype=jnp.bfloat16 if on_accel else jnp.float32)
+    anakin = AnakinImpala(ImpalaAgent(cfg), num_envs=num_envs,
+                          env=breakout_jax)
+    state = anakin.init(jax.random.PRNGKey(0))
+
+    t0 = time.perf_counter()
+    state, m = anakin.train_chunk(state, chunk)
+    float(m["total_loss"][-1])
+    compile_s = time.perf_counter() - t0
+    box = {"state": state}
+
+    def window(n):
+        t0 = time.perf_counter()
+        state = box["state"]
+        for _ in range(n):
+            state, m = anakin.train_chunk(state, chunk)
+        box["loss"] = float(m["total_loss"][-1])
+        box["state"] = state
+        return time.perf_counter() - t0
+
+    call_s, stats = _marginal_step_s(window, iters)
+    update_s = call_s / chunk
+    frames = num_envs * cfg.trajectory
+    out = {
+        "num_envs": num_envs, "trajectory": cfg.trajectory, "chunk": chunk,
+        "updates_per_s": round(1.0 / update_s, 1),
+        "frames_per_s": round(frames / update_s, 1),
+        "compile_s": round(compile_s, 1), "timing": stats,
+        "last_loss": round(box.get("loss", float("nan")), 3),
+    }
+    print(f"[bench] anakin_breakout B={num_envs}: {1e3*update_s:.3f}ms/update "
+          f"= {frames / update_s:,.0f} on-device pixel frames/s "
+          f"(iqr {stats['iqr_rel']:.0%})", file=sys.stderr)
+    return out
+
+
 def _pad_util(n: int, q: int = 128) -> float:
     """Fraction of a q-wide MXU dimension a size-n operand actually fills."""
     import math
@@ -1557,6 +1615,19 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["anakin"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] anakin failed: {e}", file=sys.stderr)
+
+    # Pixel-env Anakin: accelerator-default (a conv learn step per update
+    # on the 1-core host is minutes; the CPU artifact documents the
+    # schema at a size the host can time).
+    if os.environ.get("BENCH_ANAKIN_BREAKOUT", "1" if on_accel else "0") == "1":
+        try:
+            extra["anakin_breakout"] = bench_anakin_breakout(
+                int(os.environ.get("BENCH_AB_ENVS", "256" if on_accel else "4")),
+                int(os.environ.get("BENCH_AB_CHUNK", "20" if on_accel else "2")),
+                max(iters // 30, 3))
+        except Exception as e:  # noqa: BLE001
+            extra["anakin_breakout"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] anakin_breakout failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_LONG_CONTEXT", "1" if on_accel else "0") == "1":
         try:

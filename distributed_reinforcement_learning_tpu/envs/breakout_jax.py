@@ -48,7 +48,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_reinforcement_learning_tpu.envs import breakout_sim as sim
-from distributed_reinforcement_learning_tpu.envs.atari import _area_weights
+from distributed_reinforcement_learning_tpu.envs import pixel_jax
+from distributed_reinforcement_learning_tpu.envs.pixel_jax import preprocess as _preprocess
 
 NUM_ACTIONS = sim.BreakoutCore.num_actions  # NOOP / FIRE / RIGHT / LEFT
 OBS_SHAPE = (84, 84, 4)
@@ -78,13 +79,6 @@ _IN_FIELD = (
 _ROW_RGB = np.asarray(sim.ROW_COLORS, np.uint8)  # [6, 3]
 _SPRITE = np.asarray(sim.SPRITE, np.uint8)
 _ROW_POINTS = np.asarray(sim.ROW_POINTS, np.float32)
-
-# Preprocessing weights (`atari.preprocess_frame` parity): resize rows
-# 210 -> 110 then crop [18:102] == one 84x210 matrix; cols 160 -> 84.
-_WH_CROP = np.asarray(_area_weights(H, 110))[18:102, :]  # [84, 210]
-_WW_T = np.asarray(_area_weights(W, 84)).T  # [160, 84]
-_LUMA = np.array([0.299, 0.587, 0.114], np.float32)
-
 
 class BreakoutState(NamedTuple):
     """Batched game + observation-pipeline state (`[N, ...]` leaves)."""
@@ -130,13 +124,6 @@ def _render(bricks, paddle_x, ball_dead, ball_x, ball_y) -> jax.Array:
         & (xs >= bx) & (xs < bx + _BALL)
     )
     return jnp.where(ball[:, :, None], jnp.asarray(_SPRITE), f)
-
-
-def _preprocess(maxed_rgb: jax.Array) -> jax.Array:
-    """`[210, 160, 3]` u8 -> `[84, 84]` u8 (luma, area-resize, crop)."""
-    luma = maxed_rgb.astype(jnp.float32) @ jnp.asarray(_LUMA)  # [210, 160]
-    resized = jnp.asarray(_WH_CROP) @ luma @ jnp.asarray(_WW_T)  # [84, 84]
-    return resized.astype(jnp.uint8)
 
 
 # -- physics (single env; vmapped) ------------------------------------------
@@ -260,10 +247,7 @@ def reset(rng: jax.Array, num_envs: int) -> tuple[BreakoutState, jax.Array]:
     f = _reset_fields(num_envs)
     raw = jax.vmap(_render)(
         f["bricks"], f["paddle_x"], f["ball_dead"], f["ball_x"], f["ball_y"])
-    frame = jax.vmap(_preprocess)(raw)  # 1-frame buffer on reset
-    stack = jnp.zeros((num_envs, 84, 84, 4), jnp.uint8)
-    stack = stack.at[..., -1].set(frame)
-    state = BreakoutState(prev_raw=raw, stack=stack, **f)
+    state = BreakoutState(prev_raw=raw, stack=pixel_jax.reset_stack(raw), **f)
     return state, state.stack
 
 
@@ -303,9 +287,7 @@ def step(
      reward, game_over) = carry
 
     raw = jax.vmap(_render)(bricks, paddle_x, ball_dead, ball_x, ball_y)
-    maxed = jnp.maximum(raw, state.prev_raw)
-    frame = jax.vmap(_preprocess)(maxed)
-    stack = jnp.concatenate([state.stack[..., 1:], frame[..., None]], axis=-1)
+    stack = pixel_jax.observe(raw, state.prev_raw, state.stack)
 
     returns = state.returns + reward
     episode_return = jnp.where(game_over, returns, 0.0)
@@ -317,13 +299,9 @@ def step(
     raw0 = jax.vmap(_render)(
         fresh["bricks"], fresh["paddle_x"], fresh["ball_dead"],
         fresh["ball_x"], fresh["ball_y"])
-    frame0 = jax.vmap(_preprocess)(raw0)
-    stack0 = jnp.zeros_like(stack).at[..., -1].set(frame0)
+    stack0 = pixel_jax.reset_stack(raw0)
 
-    def pick(reset_val, cont_val):
-        mask = game_over.reshape((n,) + (1,) * (cont_val.ndim - 1))
-        return jnp.where(mask, reset_val, cont_val)
-
+    pick = pixel_jax.make_pick(game_over)
     new_state = BreakoutState(
         bricks=pick(fresh["bricks"], bricks),
         lives=pick(fresh["lives"], lives),

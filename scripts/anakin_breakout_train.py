@@ -32,13 +32,17 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 def parse_args() -> argparse.Namespace:
     p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     p.add_argument("--out", required=True)
+    p.add_argument("--env", default="breakout", choices=["breakout", "pong"],
+                   help="which on-device pixel env to train "
+                        "(envs/breakout_jax.py / envs/pong_jax.py)")
     p.add_argument("--num-envs", type=int, default=128)
     p.add_argument("--trajectory", type=int, default=20)
     p.add_argument("--updates-per-chunk", type=int, default=50)
     p.add_argument("--total-frames", type=int, default=50_000_000,
                    help="env frames (post-frameskip actions x num_envs)")
-    p.add_argument("--num-actions", type=int, default=4,
-                   help="policy head width; >4 exercises the reference's "
+    p.add_argument("--num-actions", type=int, default=None,
+                   help="policy head width (default: the env's own action "
+                        "count); wider exercises the reference's "
                         "action %% available_action aliasing")
     p.add_argument("--lstm", type=int, default=256)
     p.add_argument("--entropy", type=float, default=0.01)
@@ -59,9 +63,10 @@ def parse_args() -> argparse.Namespace:
     p.add_argument("--eval-every", type=int, default=10,
                    help="chunks between greedy evals (0 = never)")
     p.add_argument("--eval-envs", type=int, default=32)
-    p.add_argument("--eval-steps", type=int, default=3000,
-                   help="adapter steps per eval rollout (2500 covers the "
-                        "10k-emulated-frame episode cap at frameskip 4)")
+    p.add_argument("--eval-steps", type=int, default=None,
+                   help="adapter steps per eval rollout (default: the "
+                        "env's episode frame cap / frameskip + slack, so "
+                        "even a cap-length game completes inside the eval)")
     p.add_argument("--resume", action="store_true")
     return p.parse_args()
 
@@ -76,9 +81,15 @@ def main() -> None:
     import jax.numpy as jnp
 
     from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
-    from distributed_reinforcement_learning_tpu.envs import breakout_jax
+    from distributed_reinforcement_learning_tpu.envs import breakout_jax, pong_jax
     from distributed_reinforcement_learning_tpu.runtime.anakin import AnakinImpala
     from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+    env_mod = {"breakout": breakout_jax, "pong": pong_jax}[args.env]
+    if args.eval_steps is None:
+        # Episode frame caps baked into each env's step() default.
+        cap = {"breakout": 10_000, "pong": 20_000}[args.env]
+        args.eval_steps = cap // 4 + 500
 
     platform = jax.default_backend()
     on_accel = platform not in ("cpu",)
@@ -93,8 +104,8 @@ def main() -> None:
     horizon_updates = max(
         1, (args.learning_frames or args.total_frames) // frames_per_update)
     cfg = ImpalaConfig(
-        obs_shape=breakout_jax.OBS_SHAPE,
-        num_actions=args.num_actions,
+        obs_shape=env_mod.OBS_SHAPE,
+        num_actions=args.num_actions or env_mod.NUM_ACTIONS,
         trajectory=args.trajectory,
         lstm_size=args.lstm,
         entropy_coef=args.entropy,
@@ -107,7 +118,7 @@ def main() -> None:
         fold_normalize=True,  # frames stay uint8 through the whole loop
     )
     agent = ImpalaAgent(cfg)
-    anakin = AnakinImpala(agent, num_envs=args.num_envs, env=breakout_jax)
+    anakin = AnakinImpala(agent, num_envs=args.num_envs, env=env_mod)
 
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
