@@ -144,6 +144,8 @@ class AnakinImpala:
         out = self.agent._act(params, obs, prev_action, h, c, k_act)
         env, next_obs, reward, done, ep_ret = self.env.step(
             env, self._env_action(out.action), k_env)
+        mask_fn = getattr(self.env, "completed_episode_mask",
+                          lambda done, _state: done)
         record = dict(
             state=obs,
             reward=reward,
@@ -154,6 +156,9 @@ class AnakinImpala:
             initial_h=h,
             initial_c=c,
             episode_return=ep_ret,
+            # True episode ends (life-loss `done`s excluded), so chunk
+            # metrics can report a real mean completed-episode return.
+            episode_completed=mask_fn(done, env),
         )
         keep = (~done).astype(out.h.dtype)[:, None]
         carry = (env, next_obs, jnp.where(done, 0, out.action).astype(jnp.int32),
@@ -181,7 +186,10 @@ class AnakinImpala:
         )
         train, metrics = self.agent._learn(state.train, batch)
         metrics["episode_return_sum"] = rec["episode_return"].sum()
-        metrics["episodes_done"] = rec["done"].sum().astype(jnp.float32)
+        # Real episode ends; for life-loss envs rec["done"] also fires on
+        # boundaries, which would skew a mean-return-per-episode metric.
+        metrics["episodes_done"] = rec["episode_completed"].sum().astype(jnp.float32)
+        metrics["boundaries_done"] = rec["done"].sum().astype(jnp.float32)
         new_state = AnakinState(train, env, obs, prev_action, h, c, rng)
         return new_state, metrics
 

@@ -152,10 +152,8 @@ def main() -> None:
         chunk += 1
         frames += frames_per_chunk
 
-        episodes = float(m["episode_return_sum"].sum())
-        # Boundary count includes life losses; real-episode stats come
-        # from the greedy eval below.
-        boundaries = float(m["episodes_done"].sum())
+        return_sum = float(m["episode_return_sum"].sum())
+        episodes = float(m["episodes_done"].sum())  # true game ends
         row = {
             "chunk": chunk,
             "updates": int(state.train.step),
@@ -166,8 +164,10 @@ def main() -> None:
             "entropy": round(float(m["entropy"][-1]), 4),
             "grad_norm": round(float(m["grad_norm"][-1]), 4),
             "lr": float(m["learning_rate"][-1]),
-            "return_sum": round(episodes, 1),
-            "boundaries": boundaries,
+            "return_sum": round(return_sum, 1),
+            "episodes": episodes,
+            "mean_return": round(return_sum / max(episodes, 1.0), 2),
+            "boundaries": float(m["boundaries_done"].sum()),
             "wall_s": round(time.monotonic() - t_start, 1),
         }
 
