@@ -260,8 +260,19 @@ def train_anakin(config_path: str, section: str, num_updates: int,
         raise ValueError("anakin mode currently runs the IMPALA family")
     from distributed_reinforcement_learning_tpu.runtime.anakin import AnakinImpala
 
+    # Route the section's env onto its on-device implementation: the
+    # pixel games run as jittable envs (envs/{breakout,pong}_jax.py),
+    # everything else defaults to the JAX CartPole.
+    env_mod = None
+    env_name = rt.envs[0] if rt.envs else ""
+    if env_name.startswith("Breakout"):
+        from distributed_reinforcement_learning_tpu.envs import breakout_jax as env_mod
+    elif env_name.startswith("Pong"):
+        from distributed_reinforcement_learning_tpu.envs import pong_jax as env_mod
+
     agent = ImpalaAgent(agent_cfg)
-    anakin = AnakinImpala(agent, num_envs or rt.num_actors * rt.envs_per_actor)
+    anakin = AnakinImpala(agent, num_envs or rt.num_actors * rt.envs_per_actor,
+                          env=env_mod)
     state = anakin.init(jax.random.PRNGKey(seed))
     ckpt = None
     if checkpoint_dir:

@@ -38,6 +38,9 @@ def main() -> None:
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. 'cpu'); actors default to cpu "
                         "so they never grab the TPU chip")
+    p.add_argument("--anakin_envs", type=int, default=None,
+                   help="anakin mode: parallel on-device envs (default "
+                        "num_actors * envs_per_actor from the section)")
     p.add_argument("--serve_inference", action="store_true",
                    help="learner mode: serve SEED-style centralized inference "
                         "(actors send observations, the TPU acts for them)")
@@ -56,6 +59,7 @@ def main() -> None:
         from distributed_reinforcement_learning_tpu.runtime.launch import train_anakin
 
         print(train_anakin(args.config, args.section, args.updates, seed=args.seed,
+                           num_envs=args.anakin_envs,
                            checkpoint_dir=args.checkpoint_dir))
         return
     if args.mode == "local":
