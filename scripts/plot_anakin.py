@@ -1,0 +1,87 @@
+"""Render Anakin training runs (progress.jsonl) to one committed SVG.
+
+Two series per run panel: the per-chunk behavior mean return (light) and
+the greedy-eval mean return (dark markers) — the eval is the honest
+score signal (`benchmarks/longrun/ANALYSIS.md`). X is env frames.
+
+    python scripts/plot_anakin.py runs/anakin_breakout [...more run dirs]
+        --out benchmarks/anakin/curves.svg
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+INK = "#0b0b0b"
+INK2 = "#52514e"
+GRID = "#e4e3df"
+SURFACE = "#fcfcfb"
+# Fixed categorical slots (same validated palette as plot_curves.py).
+COLORS = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4", "#4a3aa7"]
+
+
+def load_run(run_dir: str) -> dict:
+    rows = []
+    with open(os.path.join(run_dir, "progress.jsonl")) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    name = os.path.basename(os.path.normpath(run_dir))
+    cfg_path = os.path.join(run_dir, "config.json")
+    if os.path.exists(cfg_path):
+        cfg = json.loads(open(cfg_path).read())
+        name = f"{cfg.get('env', 'breakout')} B={cfg.get('num_envs')} ({name})"
+    return {"name": name, "rows": rows}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("runs", nargs="+", help="run directories with progress.jsonl")
+    p.add_argument("--out", default=os.path.join("benchmarks", "anakin", "curves.svg"))
+    args = p.parse_args()
+
+    runs = [load_run(r) for r in args.runs]
+    n = len(runs)
+    fig, axes = plt.subplots(n, 1, figsize=(7.2, 2.6 * n), squeeze=False,
+                             facecolor=SURFACE)
+    for i, run in enumerate(runs):
+        ax = axes[i][0]
+        color = COLORS[i % len(COLORS)]
+        rows = run["rows"]
+        frames = np.array([r["frames"] for r in rows], float) / 1e6
+        beh = np.array([r.get("mean_return", float("nan")) for r in rows], float)
+        ax.plot(frames, beh, color=color, alpha=0.35, lw=1.0,
+                label="behavior mean return / chunk")
+        ev = [(r["frames"] / 1e6, r["eval_mean_return"]) for r in rows
+              if "eval_mean_return" in r and r.get("eval_episodes", 0) > 0]
+        if ev:
+            ex, ey = zip(*ev)
+            ax.plot(ex, ey, color=color, lw=2.0, marker="o", ms=3.5,
+                    label="greedy eval")
+        ax.set_title(run["name"], fontsize=10, color=INK, loc="left")
+        ax.set_facecolor(SURFACE)
+        ax.grid(color=GRID, lw=0.6)
+        for s in ("top", "right"):
+            ax.spines[s].set_visible(False)
+        for s in ("left", "bottom"):
+            ax.spines[s].set_color(GRID)
+        ax.tick_params(colors=INK2, labelsize=8)
+        ax.legend(fontsize=7, frameon=False, labelcolor=INK2)
+    axes[-1][0].set_xlabel("env frames (millions)", fontsize=9, color=INK2)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    fig.savefig(args.out, format="svg", facecolor=SURFACE)
+    print(f"wrote {args.out} ({n} run panel(s))")
+
+
+if __name__ == "__main__":
+    main()
