@@ -79,6 +79,15 @@ class TestAnakinImpala:
         st, _ = anakin.train_chunk(st, 3)
         assert int(st.train.step) == 6
 
+    def test_greedy_eval_counts_episodes(self):
+        """Argmax rollout on fresh envs: completed episodes counted, mean
+        inside CartPole's return range."""
+        anakin = AnakinImpala(ImpalaAgent(anakin_cfg()), num_envs=8)
+        st = anakin.init(jax.random.PRNGKey(0))
+        ev = anakin.greedy_eval(st.train.params, 8, 250, jax.random.PRNGKey(5))
+        assert ev["episodes"] > 0
+        assert 0 < ev["mean_return"] <= 200
+
     def test_rejects_non_cartpole_obs(self):
         import pytest
 
