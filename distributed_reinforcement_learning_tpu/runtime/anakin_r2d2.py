@@ -1,0 +1,295 @@
+"""Anakin R2D2: recurrent replay training entirely on-device.
+
+`runtime/anakin.py` fuses the ON-POLICY family (IMPALA) into one
+compiled program; this module does the same for the replay family. The
+host topology's queue + SumTree + learner loop
+(`runtime/r2d2_runner.py`, `data/replay.py`) becomes a fixed-capacity
+ring of sequences living in HBM with prioritized sampling *inside* the
+jit — nothing crosses the host boundary between env step and optimizer
+update. This is the TPU-native expression of the reference's
+`train_r2d2.py` stack for jittable envs; the socket topology remains
+for everything else.
+
+Replay semantics mirror `data/replay.py` (itself the re-design of
+`distributed_queue/buffer_queue.py:256-346`):
+- priority `(|err| + 0.001) ** 0.6`, stratified sampling over `total/n`
+  segments, IS weights `(N * p) ** -beta` batch-max-normalized, beta
+  annealed 0.4 -> 1.0 by 0.001 per sample;
+- new sequences scored with `agent.td_error` under the current params
+  (what the host learner does at ingest, `runtime/r2d2_runner.py:274`);
+- every sampled index's priority updated after the step (the
+  `update_batch` fix of `train_r2d2.py:159`).
+
+Actor semantics mirror `R2D2Actor`: per-episode epsilon decay
+`1/(0.1*episodes+1)` with an optional floor, stored sequence-start LSTM
+state, done-masked carries, prev-action reset.
+
+Differences from the host stack, by construction:
+- the ring overwrites oldest entries FIFO (the SumTree does too);
+- collection and training interleave at a fixed `updates_per_collect`
+  ratio instead of queue backpressure;
+- insert-time TD scores use the learner's own current params (the
+  distributed path scores with possibly-stale actor weights).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Batch
+from distributed_reinforcement_learning_tpu.envs import cartpole_jax
+
+PER_EPS = 0.001
+PER_ALPHA = 0.6
+BETA0 = 0.4
+BETA_INCREMENT = 0.001
+
+
+class DeviceReplay(NamedTuple):
+    """Fixed-capacity prioritized sequence ring in device memory."""
+
+    storage: R2D2Batch  # leaves [capacity, ...]
+    priorities: jax.Array  # [capacity] f32, alpha-transformed; 0 = empty slot
+    ptr: jax.Array  # i32 next write slot (multiple of the write width)
+    size: jax.Array  # i32 filled count
+    beta: jax.Array  # f32 annealed IS exponent
+
+
+class AnakinR2D2State(NamedTuple):
+    train: Any  # common.TargetTrainState
+    replay: DeviceReplay
+    env: Any
+    obs: jax.Array
+    prev_action: jax.Array
+    h: jax.Array
+    c: jax.Array
+    episodes: jax.Array  # [B] i32 recorded episodes (epsilon schedule)
+    last_sync: jax.Array  # i32 train step of the last target sync
+    rng: jax.Array
+
+
+def _priority(err: jax.Array) -> jax.Array:
+    return jnp.power(jnp.abs(err) + PER_EPS, PER_ALPHA)
+
+
+class AnakinR2D2:
+    """R2D2 over a pure-JAX env with on-device prioritized replay.
+
+    `num_envs` parallel envs collect one `seq_len` sequence each per
+    update; `updates_per_collect` prioritized batches of `batch_size`
+    train per collect. `capacity` must be a multiple of `num_envs` (ring
+    writes stay aligned, no wrap-around split).
+    """
+
+    def __init__(self, agent: R2D2Agent, num_envs: int, batch_size: int = 32,
+                 capacity: int = 4096, target_sync_interval: int = 100,
+                 updates_per_collect: int = 1, epsilon_decay: float = 0.1,
+                 epsilon_floor: float = 0.0, env=None, obs_transform=None):
+        self.env = env if env is not None else cartpole_jax
+        self.agent = agent
+        self.num_envs = num_envs
+        self.batch_size = batch_size
+        if capacity % num_envs != 0:
+            raise ValueError(f"capacity ({capacity}) must be a multiple of "
+                             f"num_envs ({num_envs})")
+        self.capacity = capacity
+        self.target_sync_interval = target_sync_interval
+        if updates_per_collect > target_sync_interval:
+            # Mirror of replay_train._init_stride: the learn scan cannot
+            # target-sync mid-call, so K must not swallow whole intervals.
+            raise ValueError(
+                f"updates_per_collect ({updates_per_collect}) must not "
+                f"exceed target_sync_interval ({target_sync_interval})")
+        self.updates_per_collect = updates_per_collect
+        self.epsilon_decay = epsilon_decay
+        self.epsilon_floor = epsilon_floor
+        self.obs_transform = obs_transform or (lambda x: x)
+        if agent.cfg.num_actions < self.env.NUM_ACTIONS:
+            raise ValueError(
+                f"Q head ({agent.cfg.num_actions}) narrower than the env's "
+                f"action set ({self.env.NUM_ACTIONS})")
+        self.train_chunk = jax.jit(self._train_chunk, static_argnums=(1,))
+        self.collect_chunk = jax.jit(self._collect_chunk, static_argnums=(1,))
+
+    # -- init ------------------------------------------------------------
+    def init(self, rng: jax.Array) -> AnakinR2D2State:
+        k_train, k_env, k_run = jax.random.split(rng, 3)
+        train = self.agent.init_state(k_train)
+        env, obs = self.env.reset(k_env, self.num_envs)
+        obs = self.obs_transform(obs)
+        h, c = self.agent.initial_lstm_state(self.num_envs)
+        replay = DeviceReplay(
+            storage=self._zero_sequences(),
+            priorities=jnp.zeros((self.capacity,), jnp.float32),
+            ptr=jnp.int32(0),
+            size=jnp.int32(0),
+            beta=jnp.float32(BETA0),
+        )
+        return AnakinR2D2State(
+            train=train, replay=replay, env=env, obs=obs,
+            prev_action=jnp.zeros(self.num_envs, jnp.int32),
+            h=h, c=c,
+            episodes=jnp.zeros(self.num_envs, jnp.int32),
+            last_sync=jnp.int32(0),
+            rng=k_run,
+        )
+
+    def _zero_sequences(self) -> R2D2Batch:
+        cfg = self.agent.cfg
+        obs0 = self.obs_transform(
+            jnp.zeros((1, *self.env.OBS_SHAPE),
+                      jnp.uint8 if len(self.env.OBS_SHAPE) == 3 else jnp.float32))
+        C, T = self.capacity, cfg.seq_len
+        return R2D2Batch(
+            state=jnp.zeros((C, T, *obs0.shape[1:]), obs0.dtype),
+            previous_action=jnp.zeros((C, T), jnp.int32),
+            action=jnp.zeros((C, T), jnp.int32),
+            reward=jnp.zeros((C, T), jnp.float32),
+            done=jnp.zeros((C, T), bool),
+            initial_h=jnp.zeros((C, cfg.lstm_size), jnp.float32),
+            initial_c=jnp.zeros((C, cfg.lstm_size), jnp.float32),
+        )
+
+    # -- collection ------------------------------------------------------
+    def _epsilon(self, episodes: jax.Array) -> jax.Array:
+        return jnp.maximum(1.0 / (self.epsilon_decay * episodes + 1.0),
+                           self.epsilon_floor)
+
+    def _env_step(self, params, carry, _):
+        env, obs, prev_action, h, c, episodes, rng = carry
+        rng, k_act, k_env = jax.random.split(rng, 3)
+        action, _q, new_h, new_c = self.agent._act(
+            params, obs, h, c, prev_action, self._epsilon(episodes), k_act)
+        env_action = (action % self.env.NUM_ACTIONS
+                      if self.agent.cfg.num_actions != self.env.NUM_ACTIONS
+                      else action)
+        env, next_obs, reward, done, ep_ret = self.env.step(env, env_action, k_env)
+        mask_fn = getattr(self.env, "completed_episode_mask",
+                          lambda done, _state: done)
+        record = dict(
+            state=obs, previous_action=prev_action, action=action,
+            reward=reward, done=done, episode_return=ep_ret,
+            episode_completed=mask_fn(done, env),
+        )
+        keep = (~done).astype(new_h.dtype)[:, None]
+        carry = (env, self.obs_transform(next_obs),
+                 jnp.where(done, 0, action).astype(jnp.int32),
+                 new_h * keep, new_c * keep,
+                 episodes + done.astype(jnp.int32), rng)
+        return carry, record
+
+    def _collect(self, state: AnakinR2D2State):
+        """One seq_len unroll from all envs -> (state', R2D2Batch [B, T],
+        episode stats)."""
+        cfg = self.agent.cfg
+        h0, c0 = state.h, state.c  # sequence-start stored state
+        carry = (state.env, state.obs, state.prev_action, state.h, state.c,
+                 state.episodes, state.rng)
+        carry, rec = jax.lax.scan(
+            functools.partial(self._env_step, state.train.params), carry,
+            None, length=cfg.seq_len)
+        env, obs, prev_action, h, c, episodes, rng = carry
+        bt = lambda name: jnp.swapaxes(rec[name], 0, 1)
+        batch = R2D2Batch(
+            state=bt("state"), previous_action=bt("previous_action"),
+            action=bt("action"), reward=bt("reward"), done=bt("done"),
+            initial_h=h0, initial_c=c0,
+        )
+        stats = {
+            "episode_return_sum": rec["episode_return"].sum(),
+            "episodes_done": rec["episode_completed"].sum().astype(jnp.float32),
+            "boundaries_done": rec["done"].sum().astype(jnp.float32),
+        }
+        new_state = state._replace(env=env, obs=obs, prev_action=prev_action,
+                                   h=h, c=c, episodes=episodes, rng=rng)
+        return new_state, batch, stats
+
+    def _ingest(self, train, replay: DeviceReplay, batch: R2D2Batch
+                ) -> DeviceReplay:
+        """Score + write B new sequences into the ring at `ptr`."""
+        errs = self.agent._td_error(train, batch)  # [B]
+        B = self.num_envs
+        storage = jax.tree.map(
+            lambda ring, new: jax.lax.dynamic_update_slice(
+                ring, new.astype(ring.dtype),
+                (replay.ptr,) + (0,) * (ring.ndim - 1)),
+            replay.storage, batch)
+        priorities = jax.lax.dynamic_update_slice(
+            replay.priorities, _priority(errs), (replay.ptr,))
+        return replay._replace(
+            storage=storage,
+            priorities=priorities,
+            ptr=(replay.ptr + B) % self.capacity,
+            size=jnp.minimum(replay.size + B, self.capacity),
+        )
+
+    # -- prioritized sampling (data/replay.py math, vectorized) ----------
+    def _sample(self, replay: DeviceReplay, rng: jax.Array):
+        n = self.batch_size
+        p = replay.priorities  # zeros beyond `size`: never sampled
+        cum = jnp.cumsum(p)
+        total = cum[-1]
+        seg = total / n
+        u = (jnp.arange(n, dtype=jnp.float32) + jax.random.uniform(rng, (n,))) * seg
+        idx = jnp.clip(jnp.searchsorted(cum, u, side="right"), 0,
+                       self.capacity - 1)
+        probs = p[idx] / total
+        weights = jnp.power(replay.size.astype(jnp.float32) * probs,
+                            -replay.beta)
+        weights = weights / jnp.max(weights)
+        batch = jax.tree.map(lambda ring: ring[idx], replay.storage)
+        new_replay = replay._replace(
+            beta=jnp.minimum(1.0, replay.beta + BETA_INCREMENT))
+        return new_replay, batch, idx, weights.astype(jnp.float32)
+
+    # -- one update: collect, ingest, K prioritized steps ----------------
+    def _update(self, state: AnakinR2D2State, _):
+        state, seqs, stats = self._collect(state)
+        replay = self._ingest(state.train, state.replay, seqs)
+        train = state.train
+
+        def one_learn(carry, _):
+            train, replay, rng = carry
+            rng, k = jax.random.split(rng)
+            replay, batch, idx, weights = self._sample(replay, k)
+            train, new_err, metrics = self.agent._learn(train, batch, weights)
+            replay = replay._replace(
+                priorities=replay.priorities.at[idx].set(_priority(new_err)))
+            return (train, replay, rng), metrics
+
+        rng, k_learn = jax.random.split(state.rng)
+        (train, replay, _), metrics = jax.lax.scan(
+            one_learn, (train, replay, k_learn), None,
+            length=self.updates_per_collect)
+        metrics = jax.tree.map(lambda m: m[-1], metrics)
+
+        # Target sync on a steps-since-last cadence (the host stack's
+        # replay_train._finish_train_call: a modulo misfires when K does
+        # not divide the interval).
+        do_sync = (train.step - state.last_sync) >= self.target_sync_interval
+        train = jax.lax.cond(do_sync, lambda t: t.sync_target(), lambda t: t,
+                             train)
+        last_sync = jnp.where(do_sync, train.step, state.last_sync)
+        metrics.update(stats)
+        metrics["replay_size"] = replay.size.astype(jnp.float32)
+        metrics["epsilon_mean"] = self._epsilon(state.episodes).mean()
+        return state._replace(train=train, replay=replay, rng=rng,
+                              last_sync=last_sync), metrics
+
+    def _train_chunk(self, state: AnakinR2D2State, num_updates: int):
+        """U x (collect + K prioritized learns) in one compiled program."""
+        return jax.lax.scan(self._update, state, None, length=num_updates)
+
+    def _collect_only(self, state: AnakinR2D2State, _):
+        state, seqs, stats = self._collect(state)
+        replay = self._ingest(state.train, state.replay, seqs)
+        return state._replace(replay=replay), stats
+
+    def _collect_chunk(self, state: AnakinR2D2State, num_collects: int):
+        """Warm-up: fill the ring without training (the host learner's
+        `train_start_factor` gate, expressed as an explicit phase)."""
+        return jax.lax.scan(self._collect_only, state, None, length=num_collects)

@@ -243,6 +243,39 @@ def build_local(agent_cfg: Any, rt: RuntimeConfig, run_dir: str | None = None, s
     return learner, actors, _RUN_SYNC[algo]
 
 
+def _jittable_env_for(agent_cfg, rt):
+    """-> (env_module | None, obs_transform | None) for the anakin modes.
+
+    Pixel sections route to the on-device game implementations; vector
+    sections default to the JAX CartPole (module None), with the POMDP
+    projection when the agent observes the 2-feature view."""
+    env_name = rt.envs[0] if rt.envs else ""
+    if env_name.startswith("Breakout"):
+        from distributed_reinforcement_learning_tpu.envs import breakout_jax
+
+        return breakout_jax, None
+    if env_name.startswith("Pong"):
+        from distributed_reinforcement_learning_tpu.envs import pong_jax
+
+        return pong_jax, None
+    if tuple(agent_cfg.obs_shape) == (2,):
+        return None, pomdp_project  # jnp-compatible slicing + scale
+    return None, None
+
+
+def _restore_train(checkpoint_dir, train):
+    """-> (Checkpointer | None, train) with the latest checkpoint loaded."""
+    if not checkpoint_dir:
+        return None, train
+    from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(checkpoint_dir)
+    got = ckpt.restore(train)
+    if got is not None:
+        train = got[0]
+    return ckpt, train
+
+
 def train_anakin(config_path: str, section: str, num_updates: int,
                  chunk: int = 50, seed: int = 0, num_envs: int | None = None,
                  checkpoint_dir: str | None = None) -> dict:
@@ -260,28 +293,13 @@ def train_anakin(config_path: str, section: str, num_updates: int,
         raise ValueError("anakin mode currently runs the IMPALA family")
     from distributed_reinforcement_learning_tpu.runtime.anakin import AnakinImpala
 
-    # Route the section's env onto its on-device implementation: the
-    # pixel games run as jittable envs (envs/{breakout,pong}_jax.py),
-    # everything else defaults to the JAX CartPole.
-    env_mod = None
-    env_name = rt.envs[0] if rt.envs else ""
-    if env_name.startswith("Breakout"):
-        from distributed_reinforcement_learning_tpu.envs import breakout_jax as env_mod
-    elif env_name.startswith("Pong"):
-        from distributed_reinforcement_learning_tpu.envs import pong_jax as env_mod
-
+    env_mod, _ = _jittable_env_for(agent_cfg, rt)
     agent = ImpalaAgent(agent_cfg)
     anakin = AnakinImpala(agent, num_envs or rt.num_actors * rt.envs_per_actor,
                           env=env_mod)
     state = anakin.init(jax.random.PRNGKey(seed))
-    ckpt = None
-    if checkpoint_dir:
-        from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
-
-        ckpt = Checkpointer(checkpoint_dir)
-        got = ckpt.restore(state.train)
-        if got is not None:
-            state = state._replace(train=got[0])
+    ckpt, train = _restore_train(checkpoint_dir, state.train)
+    state = state._replace(train=train)
     chunk = max(1, min(chunk, num_updates))
     returns = []
     while int(state.train.step) < num_updates:
@@ -296,6 +314,70 @@ def train_anakin(config_path: str, section: str, num_updates: int,
             ckpt.save(int(state.train.step), state.train, {})
     return {
         "frames": int(state.train.step) * anakin.num_envs * agent_cfg.trajectory,
+        "chunk_mean_returns": [round(r, 2) for r in returns],
+        "mean_return_last_chunk": round(returns[-1], 2) if returns else None,
+    }
+
+
+def train_anakin_r2d2(config_path: str, section: str, num_updates: int,
+                      chunk: int = 50, seed: int = 0,
+                      num_envs: int | None = None,
+                      capacity: int | None = None,
+                      checkpoint_dir: str | None = None) -> dict:
+    """Fully on-device R2D2 (runtime/anakin_r2d2.py): collect, the
+    prioritized replay ring, and training all inside compiled chunks.
+    Jittable envs only (CartPole-family sections via the POMDP
+    projection, pixel sections via envs/{breakout,pong}_jax). `capacity`
+    defaults to min(replay_capacity, 4096) sequences — the ring lives in
+    device memory, so the host topology's 100k default would swamp HBM
+    for pixel observations."""
+    import numpy as np
+
+    agent_cfg, rt = load_config(config_path, section)
+    if _algo_of(agent_cfg) != "r2d2":
+        raise ValueError("anakin-r2d2 mode runs the R2D2 family")
+    from distributed_reinforcement_learning_tpu.runtime.anakin_r2d2 import AnakinR2D2
+
+    env_mod, obs_transform = _jittable_env_for(agent_cfg, rt)
+    agent = R2D2Agent(agent_cfg)
+    n = num_envs or rt.num_actors * rt.envs_per_actor
+    cap = capacity or min(rt.replay_capacity, 4096)
+    cap = max(n, cap - cap % n)  # ring writes stay n-aligned
+    anakin = AnakinR2D2(
+        agent, num_envs=n, batch_size=rt.batch_size, capacity=cap,
+        target_sync_interval=rt.target_sync_interval,
+        updates_per_collect=rt.updates_per_call,
+        epsilon_floor=rt.epsilon_floor or 0.0,
+        env=env_mod, obs_transform=obs_transform)
+    state = anakin.init(jax.random.PRNGKey(seed))
+    ckpt, train = _restore_train(checkpoint_dir, state.train)
+    state = state._replace(train=train)
+    # Warm-up: the host learner's train-start gate (queue > factor*batch
+    # sequences) expressed as explicit collect-only chunks.
+    warm = -(-rt.train_start_factor * rt.batch_size // n)
+    state, _ = anakin.collect_chunk(state, warm)
+    # `num_updates` counts OPTIMIZER steps; each train_chunk update is
+    # one collect + K learns (K = updates_per_call), so chunk sizing and
+    # the frame count are in collect-updates. The final chunk may
+    # overshoot by up to K-1 optimizer steps.
+    K = anakin.updates_per_collect
+    collects = warm
+    returns = []
+    while int(state.train.step) < num_updates:
+        remaining_steps = num_updates - int(state.train.step)
+        u = max(1, min(chunk, -(-remaining_steps // K)))
+        state, m = anakin.train_chunk(state, u)
+        collects += u
+        eps = float(np.asarray(m["episodes_done"]).sum())
+        mean_ret = float(np.asarray(m["episode_return_sum"]).sum()) / max(eps, 1.0)
+        returns.append(mean_ret)
+        print(f"[anakin-r2d2] step {int(state.train.step)}: mean_return "
+              f"{mean_ret:.1f} ({eps:.0f} episodes, loss "
+              f"{float(m['loss'][-1]):.4f}, eps {float(m['epsilon_mean'][-1]):.3f})")
+        if ckpt is not None:
+            ckpt.save(int(state.train.step), state.train, {})
+    return {
+        "frames": collects * n * agent_cfg.seq_len,
         "chunk_mean_returns": [round(r, 2) for r in returns],
         "mean_return_last_chunk": round(returns[-1], 2) if returns else None,
     }
