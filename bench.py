@@ -422,6 +422,60 @@ def bench_anakin_breakout(num_envs: int, chunk: int, iters: int) -> dict:
     return out
 
 
+def bench_anakin_r2d2(num_envs: int, chunk: int, iters: int) -> dict:
+    """Fully on-device REPLAY-family training (runtime/anakin_r2d2.py):
+    collect, the prioritized sequence ring, sampling, recurrent learn,
+    and target syncs all inside one compiled scan. frames/s are env
+    frames collected while training at updates_per_collect=1 — the
+    on-device expression of the reference's train_r2d2.py stack."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Config
+    from distributed_reinforcement_learning_tpu.envs.cartpole import pomdp_project
+    from distributed_reinforcement_learning_tpu.runtime.anakin_r2d2 import AnakinR2D2
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    cfg = R2D2Config(obs_shape=(2,), num_actions=2, seq_len=10, burn_in=5,
+                     lstm_size=256,
+                     dtype=jnp.bfloat16 if on_accel else jnp.float32)
+    anakin = AnakinR2D2(R2D2Agent(cfg), num_envs=num_envs, batch_size=64,
+                        capacity=max(4096 - 4096 % num_envs, num_envs),
+                        epsilon_floor=0.02, obs_transform=pomdp_project)
+    state = anakin.init(jax.random.PRNGKey(0))
+    state, _ = anakin.collect_chunk(state, -(-3 * 64 // num_envs))
+
+    t0 = time.perf_counter()
+    state, m = anakin.train_chunk(state, chunk)
+    float(m["loss"][-1])
+    compile_s = time.perf_counter() - t0
+    box = {"state": state}
+
+    def window(n):
+        t0 = time.perf_counter()
+        state = box["state"]
+        for _ in range(n):
+            state, m = anakin.train_chunk(state, chunk)
+        box["loss"] = float(m["loss"][-1])
+        box["state"] = state
+        return time.perf_counter() - t0
+
+    call_s, stats = _marginal_step_s(window, iters)
+    update_s = call_s / chunk
+    frames = num_envs * cfg.seq_len
+    out = {
+        "num_envs": num_envs, "seq_len": cfg.seq_len, "chunk": chunk,
+        "updates_per_s": round(1.0 / update_s, 1),
+        "frames_per_s": round(frames / update_s, 1),
+        "compile_s": round(compile_s, 1), "timing": stats,
+        "last_loss": round(box.get("loss", float("nan")), 5),
+    }
+    print(f"[bench] anakin_r2d2 B={num_envs}: {1e3*update_s:.3f}ms/update = "
+          f"{frames / update_s:,.0f} on-device frames/s "
+          f"(iqr {stats['iqr_rel']:.0%})", file=sys.stderr)
+    return out
+
+
 def _pad_util(n: int, q: int = 128) -> float:
     """Fraction of a q-wide MXU dimension a size-n operand actually fills."""
     import math
@@ -1628,6 +1682,16 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["anakin_breakout"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] anakin_breakout failed: {e}", file=sys.stderr)
+
+    if os.environ.get("BENCH_ANAKIN_R2D2", "1") == "1":
+        try:
+            extra["anakin_r2d2"] = bench_anakin_r2d2(
+                int(os.environ.get("BENCH_AR_ENVS", "256" if on_accel else "16")),
+                int(os.environ.get("BENCH_AR_CHUNK", "50" if on_accel else "5")),
+                max(iters // 30, 3))
+        except Exception as e:  # noqa: BLE001
+            extra["anakin_r2d2"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] anakin_r2d2 failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_LONG_CONTEXT", "1" if on_accel else "0") == "1":
         try:

@@ -92,6 +92,26 @@ class TestAnakinR2D2:
         jax.tree.map(lambda a, b: np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b)), tp, p)
 
+    def test_updates_per_collect_syncs_on_interval(self):
+        """K=2 with interval 3: the steps-since-last cadence still syncs
+        (a naive step-modulo would wait for step 6)."""
+        an = make(updates_per_collect=2, target_sync_interval=3)
+        st = an.init(jax.random.PRNGKey(0))
+        st, _ = an.collect_chunk(st, 4)
+        st, m = an.train_chunk(st, 2)  # steps 2, 4: since-last 4 >= 3 at 4
+        assert int(st.train.step) == 4
+        assert int(st.last_sync) == 4
+        tp = jax.device_get(st.train.target_params)
+        p = jax.device_get(st.train.params)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), tp, p)
+
+    def test_k_exceeding_interval_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            make(updates_per_collect=8, target_sync_interval=4)
+
     def test_epsilon_decays_per_episode(self):
         an = make(epsilon_floor=0.02)
         st = an.init(jax.random.PRNGKey(0))
