@@ -476,6 +476,70 @@ def bench_anakin_r2d2(num_envs: int, chunk: int, iters: int) -> dict:
     return out
 
 
+def bench_anakin_apex(num_envs: int, chunk: int, iters: int) -> dict:
+    """Fully on-device Ape-X over the PIXEL env: dueling-conv double-DQN
+    with the uint8 transition ring, prioritized sampling, IS weights,
+    and target syncs all inside one compiled scan
+    (runtime/anakin_apex.py + envs/breakout_jax.py). frames/s are env
+    frames collected while training; the emitted `sampled_ratio` is the
+    sampled-to-collected ratio the run actually trained at.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexConfig
+    from distributed_reinforcement_learning_tpu.envs import breakout_jax
+    from distributed_reinforcement_learning_tpu.runtime.anakin_apex import AnakinApex
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    cfg = ApexConfig(obs_shape=breakout_jax.OBS_SHAPE, num_actions=4,
+                     fold_normalize=True,
+                     dtype=jnp.bfloat16 if on_accel else jnp.float32)
+    steps = 16 if on_accel else 4
+    width = num_envs * steps
+    cap = max(width, 32768 - 32768 % width) if on_accel else width * 2
+    anakin = AnakinApex(ApexAgent(cfg), num_envs=num_envs,
+                        batch_size=128 if on_accel else 8,
+                        capacity=cap, steps_per_collect=steps,
+                        updates_per_collect=2, epsilon_floor=0.02,
+                        env=breakout_jax)
+    state = anakin.init(jax.random.PRNGKey(0))
+    state, _ = anakin.collect_chunk(state, 1)
+
+    t0 = time.perf_counter()
+    state, m = anakin.train_chunk(state, chunk)
+    float(m["loss"][-1])
+    compile_s = time.perf_counter() - t0
+    box = {"state": state}
+
+    def window(n):
+        t0 = time.perf_counter()
+        state = box["state"]
+        for _ in range(n):
+            state, m = anakin.train_chunk(state, chunk)
+        box["loss"] = float(m["loss"][-1])
+        box["state"] = state
+        return time.perf_counter() - t0
+
+    call_s, stats = _marginal_step_s(window, iters)
+    update_s = call_s / chunk
+    frames = width
+    out = {
+        "num_envs": num_envs, "steps_per_collect": steps, "chunk": chunk,
+        "capacity": cap,
+        "sampled_ratio": round(
+            anakin.updates_per_collect * anakin.batch_size / width, 3),
+        "updates_per_s": round(1.0 / update_s, 1),
+        "frames_per_s": round(frames / update_s, 1),
+        "compile_s": round(compile_s, 1), "timing": stats,
+        "last_loss": round(box.get("loss", float("nan")), 5),
+    }
+    print(f"[bench] anakin_apex B={num_envs}: {1e3*update_s:.3f}ms/update = "
+          f"{frames / update_s:,.0f} on-device pixel frames/s "
+          f"(iqr {stats['iqr_rel']:.0%})", file=sys.stderr)
+    return out
+
+
 def _pad_util(n: int, q: int = 128) -> float:
     """Fraction of a q-wide MXU dimension a size-n operand actually fills."""
     import math
@@ -1682,6 +1746,16 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["anakin_breakout"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] anakin_breakout failed: {e}", file=sys.stderr)
+
+    if os.environ.get("BENCH_ANAKIN_APEX", "1" if on_accel else "0") == "1":
+        try:
+            extra["anakin_apex"] = bench_anakin_apex(
+                int(os.environ.get("BENCH_AA_ENVS", "64" if on_accel else "2")),
+                int(os.environ.get("BENCH_AA_CHUNK", "10" if on_accel else "2")),
+                max(iters // 30, 3))
+        except Exception as e:  # noqa: BLE001
+            extra["anakin_apex"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] anakin_apex failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_ANAKIN_R2D2", "1") == "1":
         try:

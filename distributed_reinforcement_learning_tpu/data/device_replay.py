@@ -1,0 +1,101 @@
+"""Prioritized replay as a device-resident ring (shared machinery).
+
+The host topology keeps replay on the host (`data/replay.py` SumTree —
+the re-design of `distributed_queue/buffer_queue.py:256-346`); the
+Anakin runtimes keep it in device memory so sampling happens INSIDE the
+compiled program. This module is the storage-agnostic core used by both
+on-device replay families (`runtime/anakin_r2d2.py` sequences,
+`runtime/anakin_apex.py` transitions): `storage` is any pytree whose
+leaves are `[capacity, ...]` rings.
+
+Math parity with `data/replay.py`: priority `(|err| + 0.001) ** 0.6`,
+stratified sampling over `total/n` segments, IS weights `(N * p) **
+-beta` batch-max-normalized, beta annealed 0.4 -> 1.0 by 0.001 per
+sample. Writes are `write_width`-aligned (capacity must be a multiple),
+overwriting oldest entries FIFO like the SumTree's write pointer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+PER_EPS = 0.001
+PER_ALPHA = 0.6
+BETA0 = 0.4
+BETA_INCREMENT = 0.001
+
+
+class DeviceReplay(NamedTuple):
+    storage: Any  # pytree of [capacity, ...] rings
+    priorities: jax.Array  # [capacity] f32, alpha-transformed; 0 = empty
+    ptr: jax.Array  # i32 next write slot (write_width-aligned)
+    size: jax.Array  # i32 filled count
+    beta: jax.Array  # f32 annealed IS exponent
+
+
+def priority(err: jax.Array) -> jax.Array:
+    """`(|err| + eps) ** alpha` (`data/replay.py` PrioritizedReplay)."""
+    return jnp.power(jnp.abs(err) + PER_EPS, PER_ALPHA)
+
+
+def make(storage_zeros: Any, capacity: int) -> DeviceReplay:
+    return DeviceReplay(
+        storage=storage_zeros,
+        priorities=jnp.zeros((capacity,), jnp.float32),
+        ptr=jnp.int32(0),
+        size=jnp.int32(0),
+        beta=jnp.float32(BETA0),
+    )
+
+
+def ingest(replay: DeviceReplay, batch: Any, errs: jax.Array) -> DeviceReplay:
+    """Write `W` new entries (the leading dim of `batch`'s leaves) at
+    `ptr` with priorities from raw errors `errs [W]`. Capacity is the
+    ring's own (priorities.shape[0], static under jit) — never passed,
+    so it cannot disagree with the arrays."""
+    capacity = replay.priorities.shape[0]
+    width = errs.shape[0]
+    storage = jax.tree.map(
+        lambda ring, new: jax.lax.dynamic_update_slice(
+            ring, new.astype(ring.dtype),
+            (replay.ptr,) + (0,) * (ring.ndim - 1)),
+        replay.storage, batch)
+    priorities = jax.lax.dynamic_update_slice(
+        replay.priorities, priority(errs), (replay.ptr,))
+    return replay._replace(
+        storage=storage,
+        priorities=priorities,
+        ptr=(replay.ptr + width) % capacity,
+        size=jnp.minimum(replay.size + width, capacity),
+    )
+
+
+def sample(replay: DeviceReplay, rng: jax.Array, n: int):
+    """-> (replay', batch, idx [n], is_weights [n]). Stratified over
+    `total/n` segments; empty slots carry zero priority and are never
+    drawn (the ring must hold at least one entry)."""
+    capacity = replay.priorities.shape[0]
+    p = replay.priorities
+    cum = jnp.cumsum(p)
+    total = cum[-1]
+    seg = total / n
+    u = (jnp.arange(n, dtype=jnp.float32) + jax.random.uniform(rng, (n,))) * seg
+    idx = jnp.clip(jnp.searchsorted(cum, u, side="right"), 0, capacity - 1)
+    probs = p[idx] / total
+    weights = jnp.power(replay.size.astype(jnp.float32) * probs, -replay.beta)
+    weights = weights / jnp.max(weights)
+    batch = jax.tree.map(lambda ring: ring[idx], replay.storage)
+    new_replay = replay._replace(
+        beta=jnp.minimum(1.0, replay.beta + BETA_INCREMENT))
+    return new_replay, batch, idx, weights.astype(jnp.float32)
+
+
+def update_priorities(replay: DeviceReplay, idx: jax.Array,
+                      errs: jax.Array) -> DeviceReplay:
+    """Refresh every sampled priority (the `update_batch` fix of
+    `train_r2d2.py:159`)."""
+    return replay._replace(
+        priorities=replay.priorities.at[idx].set(priority(errs)))

@@ -41,22 +41,17 @@ import jax
 import jax.numpy as jnp
 
 from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Batch
+from distributed_reinforcement_learning_tpu.data import device_replay
+from distributed_reinforcement_learning_tpu.data.device_replay import (
+    BETA0,
+    BETA_INCREMENT,
+    PER_ALPHA,
+    PER_EPS,
+    DeviceReplay,
+)
 from distributed_reinforcement_learning_tpu.envs import cartpole_jax
 
-PER_EPS = 0.001
-PER_ALPHA = 0.6
-BETA0 = 0.4
-BETA_INCREMENT = 0.001
-
-
-class DeviceReplay(NamedTuple):
-    """Fixed-capacity prioritized sequence ring in device memory."""
-
-    storage: R2D2Batch  # leaves [capacity, ...]
-    priorities: jax.Array  # [capacity] f32, alpha-transformed; 0 = empty slot
-    ptr: jax.Array  # i32 next write slot (multiple of the write width)
-    size: jax.Array  # i32 filled count
-    beta: jax.Array  # f32 annealed IS exponent
+_priority = device_replay.priority
 
 
 class AnakinR2D2State(NamedTuple):
@@ -70,10 +65,6 @@ class AnakinR2D2State(NamedTuple):
     episodes: jax.Array  # [B] i32 recorded episodes (epsilon schedule)
     last_sync: jax.Array  # i32 train step of the last target sync
     rng: jax.Array
-
-
-def _priority(err: jax.Array) -> jax.Array:
-    return jnp.power(jnp.abs(err) + PER_EPS, PER_ALPHA)
 
 
 class AnakinR2D2:
@@ -122,13 +113,7 @@ class AnakinR2D2:
         env, obs = self.env.reset(k_env, self.num_envs)
         obs = self.obs_transform(obs)
         h, c = self.agent.initial_lstm_state(self.num_envs)
-        replay = DeviceReplay(
-            storage=self._zero_sequences(),
-            priorities=jnp.zeros((self.capacity,), jnp.float32),
-            ptr=jnp.int32(0),
-            size=jnp.int32(0),
-            beta=jnp.float32(BETA0),
-        )
+        replay = device_replay.make(self._zero_sequences(), self.capacity)
         return AnakinR2D2State(
             train=train, replay=replay, env=env, obs=obs,
             prev_action=jnp.zeros(self.num_envs, jnp.int32),
@@ -212,39 +197,10 @@ class AnakinR2D2:
                 ) -> DeviceReplay:
         """Score + write B new sequences into the ring at `ptr`."""
         errs = self.agent._td_error(train, batch)  # [B]
-        B = self.num_envs
-        storage = jax.tree.map(
-            lambda ring, new: jax.lax.dynamic_update_slice(
-                ring, new.astype(ring.dtype),
-                (replay.ptr,) + (0,) * (ring.ndim - 1)),
-            replay.storage, batch)
-        priorities = jax.lax.dynamic_update_slice(
-            replay.priorities, _priority(errs), (replay.ptr,))
-        return replay._replace(
-            storage=storage,
-            priorities=priorities,
-            ptr=(replay.ptr + B) % self.capacity,
-            size=jnp.minimum(replay.size + B, self.capacity),
-        )
+        return device_replay.ingest(replay, batch, errs)
 
-    # -- prioritized sampling (data/replay.py math, vectorized) ----------
     def _sample(self, replay: DeviceReplay, rng: jax.Array):
-        n = self.batch_size
-        p = replay.priorities  # zeros beyond `size`: never sampled
-        cum = jnp.cumsum(p)
-        total = cum[-1]
-        seg = total / n
-        u = (jnp.arange(n, dtype=jnp.float32) + jax.random.uniform(rng, (n,))) * seg
-        idx = jnp.clip(jnp.searchsorted(cum, u, side="right"), 0,
-                       self.capacity - 1)
-        probs = p[idx] / total
-        weights = jnp.power(replay.size.astype(jnp.float32) * probs,
-                            -replay.beta)
-        weights = weights / jnp.max(weights)
-        batch = jax.tree.map(lambda ring: ring[idx], replay.storage)
-        new_replay = replay._replace(
-            beta=jnp.minimum(1.0, replay.beta + BETA_INCREMENT))
-        return new_replay, batch, idx, weights.astype(jnp.float32)
+        return device_replay.sample(replay, rng, self.batch_size)
 
     # -- one update: collect, ingest, K prioritized steps ----------------
     def _update(self, state: AnakinR2D2State, _):
@@ -257,8 +213,7 @@ class AnakinR2D2:
             rng, k = jax.random.split(rng)
             replay, batch, idx, weights = self._sample(replay, k)
             train, new_err, metrics = self.agent._learn(train, batch, weights)
-            replay = replay._replace(
-                priorities=replay.priorities.at[idx].set(_priority(new_err)))
+            replay = device_replay.update_priorities(replay, idx, new_err)
             return (train, replay, rng), metrics
 
         rng, k_learn = jax.random.split(state.rng)

@@ -319,6 +319,81 @@ def train_anakin(config_path: str, section: str, num_updates: int,
     }
 
 
+def _replay_chunk_loop(anakin, state, num_updates: int, chunk: int, ckpt,
+                       label: str, frames_per_collect: int, warm: int) -> dict:
+    """Shared warm-up + chunked train loop for the on-device replay
+    families (AnakinR2D2 / AnakinApex — same train_chunk/metrics
+    contract). `num_updates` counts OPTIMIZER steps; each chunk update is
+    one collect + K learns (K = updates_per_collect), so chunk sizing
+    and the frame count are in collect-updates and the final chunk may
+    overshoot by up to K-1 optimizer steps."""
+    import numpy as np
+
+    state, _ = anakin.collect_chunk(state, warm)
+    K = anakin.updates_per_collect
+    collects = warm
+    returns = []
+    while int(state.train.step) < num_updates:
+        remaining_steps = num_updates - int(state.train.step)
+        u = max(1, min(chunk, -(-remaining_steps // K)))
+        state, m = anakin.train_chunk(state, u)
+        collects += u
+        eps = float(np.asarray(m["episodes_done"]).sum())
+        mean_ret = float(np.asarray(m["episode_return_sum"]).sum()) / max(eps, 1.0)
+        returns.append(mean_ret)
+        print(f"[{label}] step {int(state.train.step)}: mean_return "
+              f"{mean_ret:.1f} ({eps:.0f} episodes, loss "
+              f"{float(m['loss'][-1]):.4f}, eps {float(m['epsilon_mean'][-1]):.3f})")
+        if ckpt is not None:
+            ckpt.save(int(state.train.step), state.train, {})
+    return {
+        "frames": collects * frames_per_collect,
+        "chunk_mean_returns": [round(r, 2) for r in returns],
+        "mean_return_last_chunk": round(returns[-1], 2) if returns else None,
+    }
+
+
+def train_anakin_apex(config_path: str, section: str, num_updates: int,
+                      chunk: int = 50, seed: int = 0,
+                      num_envs: int | None = None,
+                      capacity: int | None = None,
+                      checkpoint_dir: str | None = None) -> dict:
+    """Fully on-device Ape-X (runtime/anakin_apex.py): transition
+    collection, the prioritized ring, double-DQN training, and target
+    syncs inside compiled chunks. With a pixel section this trains the
+    dueling conv net on real game dynamics at chip rate.
+
+    `capacity` defaults to min(replay_capacity, 32768) transitions —
+    each pixel transition stores TWO 84x84x4 uint8 stacks (s and s',
+    ~56 KB), so the default ring costs ~1.8 GB of device memory; the
+    host topology's 100k default would triple that."""
+    agent_cfg, rt = load_config(config_path, section)
+    if _algo_of(agent_cfg) != "apex":
+        raise ValueError("anakin-apex mode runs the Ape-X family")
+    from distributed_reinforcement_learning_tpu.runtime.anakin_apex import AnakinApex
+
+    env_mod, obs_transform = _jittable_env_for(agent_cfg, rt)
+    agent = ApexAgent(agent_cfg)
+    n = num_envs or rt.num_actors * rt.envs_per_actor
+    steps = 16
+    width = n * steps
+    cap = capacity or min(rt.replay_capacity, 32768)
+    cap = max(width, cap - cap % width)  # ring writes stay width-aligned
+    anakin = AnakinApex(
+        agent, num_envs=n, batch_size=rt.batch_size, capacity=cap,
+        steps_per_collect=steps,
+        target_sync_interval=rt.target_sync_interval,
+        updates_per_collect=rt.updates_per_call,
+        epsilon_floor=rt.epsilon_floor or 0.0,
+        env=env_mod, obs_transform=obs_transform)
+    state = anakin.init(jax.random.PRNGKey(seed))
+    ckpt, train = _restore_train(checkpoint_dir, state.train)
+    state = state._replace(train=train)
+    warm = -(-rt.train_start_factor * rt.batch_size // width)
+    return _replay_chunk_loop(anakin, state, num_updates, chunk, ckpt,
+                              "anakin-apex", width, warm)
+
+
 def train_anakin_r2d2(config_path: str, section: str, num_updates: int,
                       chunk: int = 50, seed: int = 0,
                       num_envs: int | None = None,
@@ -331,8 +406,6 @@ def train_anakin_r2d2(config_path: str, section: str, num_updates: int,
     defaults to min(replay_capacity, 4096) sequences — the ring lives in
     device memory, so the host topology's 100k default would swamp HBM
     for pixel observations."""
-    import numpy as np
-
     agent_cfg, rt = load_config(config_path, section)
     if _algo_of(agent_cfg) != "r2d2":
         raise ValueError("anakin-r2d2 mode runs the R2D2 family")
@@ -355,32 +428,8 @@ def train_anakin_r2d2(config_path: str, section: str, num_updates: int,
     # Warm-up: the host learner's train-start gate (queue > factor*batch
     # sequences) expressed as explicit collect-only chunks.
     warm = -(-rt.train_start_factor * rt.batch_size // n)
-    state, _ = anakin.collect_chunk(state, warm)
-    # `num_updates` counts OPTIMIZER steps; each train_chunk update is
-    # one collect + K learns (K = updates_per_call), so chunk sizing and
-    # the frame count are in collect-updates. The final chunk may
-    # overshoot by up to K-1 optimizer steps.
-    K = anakin.updates_per_collect
-    collects = warm
-    returns = []
-    while int(state.train.step) < num_updates:
-        remaining_steps = num_updates - int(state.train.step)
-        u = max(1, min(chunk, -(-remaining_steps // K)))
-        state, m = anakin.train_chunk(state, u)
-        collects += u
-        eps = float(np.asarray(m["episodes_done"]).sum())
-        mean_ret = float(np.asarray(m["episode_return_sum"]).sum()) / max(eps, 1.0)
-        returns.append(mean_ret)
-        print(f"[anakin-r2d2] step {int(state.train.step)}: mean_return "
-              f"{mean_ret:.1f} ({eps:.0f} episodes, loss "
-              f"{float(m['loss'][-1]):.4f}, eps {float(m['epsilon_mean'][-1]):.3f})")
-        if ckpt is not None:
-            ckpt.save(int(state.train.step), state.train, {})
-    return {
-        "frames": collects * n * agent_cfg.seq_len,
-        "chunk_mean_returns": [round(r, 2) for r in returns],
-        "mean_return_last_chunk": round(returns[-1], 2) if returns else None,
-    }
+    return _replay_chunk_loop(anakin, state, num_updates, chunk, ckpt,
+                              "anakin-r2d2", n * agent_cfg.seq_len, warm)
 
 
 def train_local(config_path: str, section: str, num_updates: int,

@@ -13,7 +13,13 @@ def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", default="config.json")
     p.add_argument("--section", default="apex")
-    p.add_argument("--mode", default="local", choices=["local", "learner", "actor"])
+    p.add_argument("--mode", default="local",
+                   choices=["local", "learner", "actor", "anakin"])
+    p.add_argument("--anakin_envs", type=int, default=None,
+                   help="anakin mode: parallel on-device envs")
+    p.add_argument("--anakin_capacity", type=int, default=None,
+                   help="anakin mode: device transition-ring capacity "
+                        "(default min(replay_capacity, 32768))")
     p.add_argument("--task", type=int, default=-1)
     p.add_argument("--updates", type=int, default=1000)
     p.add_argument("--run_dir", default=None)
@@ -37,6 +43,15 @@ def main() -> None:
         import jax
         jax.config.update("jax_platforms", platform)
 
+    if args.mode == "anakin":
+        # On-device transition replay (runtime/anakin_apex.py).
+        from distributed_reinforcement_learning_tpu.runtime.launch import train_anakin_apex
+
+        print(train_anakin_apex(args.config, args.section, args.updates,
+                                seed=args.seed, num_envs=args.anakin_envs,
+                                capacity=args.anakin_capacity,
+                                checkpoint_dir=args.checkpoint_dir))
+        return
     if args.mode == "local":
         from distributed_reinforcement_learning_tpu.runtime.launch import train_local
 
