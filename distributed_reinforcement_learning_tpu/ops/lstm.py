@@ -21,10 +21,15 @@ measured pallas 128.0us vs scan 166.6us (kernel ahead), run 2 pallas
 149.6us vs scan 141.7us (kernel behind) — a spread inside the tunnel's
 noise floor, so the "fused pair wins" claim did not survive its own
 second measurement (VERDICT r2 "what's weak" #1; artifacts:
-benchmarks/r02_v5e_single_chip*.json `kernel_compare`). `auto`
-therefore resolves to the XLA scan until a stable two-artifact margin
-re-establishes the kernel; the V-trace kernel keeps its auto-enable
-(its ~4x margin was consistent across artifacts).
+benchmarks/r02_v5e_single_chip*.json `kernel_compare`). Round 4's
+re-adjudication on a healthy tunnel (VERDICT r3 item 7) CLOSES the
+question: 1.09x (r04_v5e_run1: 129.1 vs 140.4us) and 1.00x
+(r04_v5e_run2: 126.3 vs 125.9us), both stable-flagged — below the
+1.15x auto-enable bar in both artifacts. The kernel stays a documented,
+tested reference kernel (`tests/test_pallas.py` keeps it numerically
+matched to the scan); `auto` resolves to the XLA scan. The V-trace
+kernel keeps its auto-enable — its margin is stable across ALL
+committed artifacts (r3: 2.3/1.4x-5.0x; r4: 2.4 vs 4.6, 2.4 vs 4.9us).
 
 Gate math (TF1 `LSTMCell` parity, forget bias 1.0):
 
