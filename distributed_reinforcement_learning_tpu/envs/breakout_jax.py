@@ -293,6 +293,17 @@ def step(
     episode_return = jnp.where(game_over, returns, 0.0)
     lost_life = lives < lives_before
     done = (game_over | lost_life) if life_loss else game_over
+    if life_loss:
+        # The reference's life-loss shaping REPLACES the step reward with
+        # -1 on a lost life (host parity: `runtime/impala_runner.py`
+        # `rec_reward = where(lost, -1, r)`, from `train_impala.py:149-154`);
+        # true game-overs keep the raw reward, like the host path's
+        # `lost = ... & ~done`. Omitting this (pre-r4s3 versions of this
+        # env) makes ball loss nearly costless to the learner — the core
+        # keep-the-rally-alive incentive disappears. `returns` above is
+        # accumulated from the RAW reward, so episode_return stays the
+        # true game score.
+        reward = jnp.where(lost_life & ~game_over, -1.0, reward)
 
     # Auto-reset game-over slots (fresh board; obs = reset observation).
     fresh = _reset_fields(n)

@@ -53,6 +53,10 @@ def parse_args() -> argparse.Namespace:
                    help="LR-decay horizon in frames (0 = --total-frames)")
     p.add_argument("--reward-clip", default="abs_one",
                    choices=["abs_one", "soft_asymmetric", "none"])
+    p.add_argument("--clip-norm", type=float, default=40.0,
+                   help="global-norm gradient clip (reference 40; with "
+                        "SUM losses the norm scales with batch, so large "
+                        "--num-envs runs may want it raised)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. cpu for smoke tests)")
@@ -114,6 +118,7 @@ def main() -> None:
         end_learning_rate=args.end_lr,
         learning_frame=horizon_updates,
         reward_clipping=args.reward_clip,
+        gradient_clip_norm=args.clip_norm,
         dtype=dtype,
         fold_normalize=True,  # frames stay uint8 through the whole loop
     )

@@ -144,6 +144,22 @@ class TestEpisodeSemantics:
         assert (np.asarray(obs[0, :, :, :3]) == 0).all()
         assert np.asarray(obs[0, :, :, 3]).any()
 
+    def test_life_loss_replaces_reward_with_minus_one(self):
+        """Reference shaping (`train_impala.py:149-154`, host parity
+        `runtime/impala_runner.py`): a lost life records r=-1; a TRUE
+        game over keeps the raw reward."""
+        state = self._about_to_die(lives=3)
+        _, _, r, done, _ = breakout_jax.step(
+            state, jnp.asarray([breakout_sim.NOOP]), jax.random.PRNGKey(1))
+        assert bool(done[0])
+        assert float(r[0]) == -1.0
+        # Last life: game over, shaping must NOT apply.
+        state = self._about_to_die(lives=1)
+        _, _, r, done, _ = breakout_jax.step(
+            state, jnp.asarray([breakout_sim.NOOP]), jax.random.PRNGKey(1))
+        assert bool(done[0])
+        assert float(r[0]) == 0.0
+
     def test_life_loss_flag_off_mirrors_raw_done(self):
         state = self._about_to_die(lives=3)
         _, _, _, done, _ = breakout_jax.step(
