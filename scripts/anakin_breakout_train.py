@@ -57,6 +57,12 @@ def parse_args() -> argparse.Namespace:
                    help="global-norm gradient clip (reference 40; with "
                         "SUM losses the norm scales with batch, so large "
                         "--num-envs runs may want it raised)")
+    p.add_argument("--torso", default="nature", choices=["nature", "resnet"],
+                   help="conv torso: reference Nature-CNN, or the IMPALA "
+                        "paper's deep ResNet (the MXU-dense variant)")
+    p.add_argument("--torso-width", type=int, default=1,
+                   help="ResNet channel multiplier (bench's MXU-dense "
+                        "configuration uses 4)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. cpu for smoke tests)")
@@ -77,6 +83,9 @@ def parse_args() -> argparse.Namespace:
 
 def main() -> None:
     args = parse_args()
+    if args.torso != "resnet" and args.torso_width != 1:
+        sys.exit("--torso-width only applies to --torso resnet "
+                 "(the Nature CNN has fixed channel counts)")
     if args.platform:
         import jax
 
@@ -119,6 +128,8 @@ def main() -> None:
         learning_frame=horizon_updates,
         reward_clipping=args.reward_clip,
         gradient_clip_norm=args.clip_norm,
+        torso=args.torso,
+        torso_width=args.torso_width,
         dtype=dtype,
         fold_normalize=True,  # frames stay uint8 through the whole loop
     )
