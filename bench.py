@@ -16,9 +16,14 @@ a 1-core host binds the pipeline), and (d) the Pallas-vs-XLA kernel
 comparison for the V-trace recursion and the fused LSTM, with
 two-window stability checks on every estimate.
 
-Prints ONE JSON line on stdout (headline = best e2e frames/s; learn
-step, budget and kernels under "extra"); diagnostics go to stderr; the
-full detail is also written to bench_artifacts/bench_detail.json.
+Prints the headline JSON line on stdout (consumers take the LAST line):
+the headline section runs FIRST and emits a parsed line immediately, and
+a second, enriched line is emitted after the remaining sections — so a
+driver timeout mid-run still leaves a parsed headline. Sections are
+gated on a wall-clock budget (BENCH_TIME_BUDGET, default 2700 s);
+sections that would overrun are skipped and listed in
+extra["skipped_sections"]. Diagnostics go to stderr; the full detail is
+also written to bench_artifacts/bench_detail.json.
 
 Hardened for the axon TPU tunnel (which wedges after killed clients): the
 backend is probed with a trivial jitted op in a SUBPROCESS under a hard
@@ -1540,8 +1545,62 @@ def main() -> None:
     cfg = ImpalaConfig(dtype=dtype, remat=remat)
     extra: dict = {"platform": platform, "dtype": str(dtype.__name__), "remat": remat}
 
+    # Wall-clock budget (VERDICT r4 item 1): r4's driver run carried the
+    # repo's best numbers ever and still recorded `parsed: null` because
+    # the driver's timeout killed bench.py before its single end-of-run
+    # emit. Two defenses, both here: (a) the headline section runs FIRST
+    # and emits its parsed line IMMEDIATELY (the driver takes the last
+    # JSON line, so the enriched end-of-run emit supersedes it when it
+    # lands); (b) every later section is gated on a time budget — when
+    # the projected section would overrun, it is skipped and recorded in
+    # extra["skipped_sections"] so the final line still appears well
+    # inside the driver's timeout.
+    t_start = time.monotonic()
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", "2700"))
+    deadline = t_start + budget
+    skipped: list = []
+    extra["time_budget_s"] = budget
+
+    def _ok(name: str, est: float = 120.0) -> bool:
+        """True if `name` (rough cost `est` s) fits in the budget."""
+        if time.monotonic() + est <= deadline:
+            return True
+        skipped.append(name)
+        print(f"[bench] budget: skipping {name} "
+              f"({time.monotonic() - t_start:.0f}s elapsed of {budget:.0f}s)",
+              file=sys.stderr)
+        return False
+
+    # Headline section first (accelerator only — a conv learn step per
+    # update on the 1-core host is minutes). On success, emit the parsed
+    # headline NOW: even if the driver kills everything after this
+    # point, the artifact carries a real number.
+    ab_early: dict = {}
+    if os.environ.get("BENCH_ANAKIN_BREAKOUT", "1" if on_accel else "0") == "1":
+        try:
+            ab_early = bench_anakin_breakout(
+                int(os.environ.get("BENCH_AB_ENVS", "256" if on_accel else "4")),
+                int(os.environ.get("BENCH_AB_CHUNK", "20" if on_accel else "2")),
+                max(iters // 30, 3))
+            extra["anakin_breakout"] = ab_early
+        except Exception as e:  # noqa: BLE001
+            extra["anakin_breakout"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] anakin_breakout failed: {e}", file=sys.stderr)
+    if on_accel and ab_early.get("frames_per_s", 0) > 0:
+        extra["headline"] = ("anakin_breakout: on-device pixel-env "
+                             "training, frames collected AND learned per "
+                             "second; host-loop e2e + stage budget in "
+                             "e2e_pipeline_*/stage_budget")
+        _emit(ab_early["frames_per_s"],
+              {**extra, "partial": "headline-only early emit; "
+               "the full-detail line (if present below) supersedes this"},
+              metric="anakin_breakout_env_frames_per_s")
+        sys.stdout.flush()
+
     results = []
     for B in sweep:
+        if not _ok(f"learn_step_B{B}", 90.0):
+            continue
         try:
             results.append(bench_learn_step(cfg, B, iters))
         except Exception as e:  # noqa: BLE001 — an unmeasurable B is excluded, not 1e-9
@@ -1550,8 +1609,16 @@ def main() -> None:
     extra["learn_step_sweep"] = results
     valid = [r for r in results if "frames_per_s" in r]
     if not valid:
+        if ab_early.get("frames_per_s", 0) > 0:
+            # The headline already landed; finish with it rather than
+            # clobbering the round's number with a 0.0 error line.
+            extra["skipped_sections"] = skipped
+            extra["error_learn_step"] = "no learn-step measurement landed"
+            _emit(ab_early["frames_per_s"], extra,
+                  metric="anakin_breakout_env_frames_per_s")
+            return
         _emit(0.0, {**extra, "error": "no learn-step measurement landed",
-                    "phase": "learn_step"})
+                    "phase": "learn_step", "skipped_sections": skipped})
         return
     best = max(valid, key=lambda r: r["frames_per_s"])
 
@@ -1560,7 +1627,7 @@ def main() -> None:
     # updates_per_call=K actually sustains). Accelerator-default: XLA
     # CPU runs while-loop bodies single-threaded, so a CPU scan-of-learn
     # measures that quirk (~60x slow), not the framework.
-    if os.environ.get("BENCH_SCAN", "1" if on_accel else "0") == "1":
+    if os.environ.get("BENCH_SCAN", "1" if on_accel else "0") == "1" and _ok("learn_scan", 90):
         try:
             extra["learn_scan"] = bench_learn_scan(
                 cfg, best["B"], int(os.environ.get("BENCH_SCAN_K", "8")),
@@ -1572,7 +1639,7 @@ def main() -> None:
             print(f"[bench] learn_scan failed: {e}", file=sys.stderr)
 
     # Folded /255 path: same math, minus the full-frame normalize pass.
-    if os.environ.get("BENCH_FOLD", "1") == "1":
+    if os.environ.get("BENCH_FOLD", "1") == "1" and _ok("fold_normalize", 90):
         try:
             import dataclasses as _dc
 
@@ -1604,7 +1671,7 @@ def main() -> None:
     # Nature-CNN's low MFU is its 32/64-channel geometry, not dispatch.
     # Accelerator-only: a width-4 ResNet learn step on 1 CPU core is
     # minutes per step.
-    if os.environ.get("BENCH_RESNET", "1" if on_accel else "0") == "1":
+    if os.environ.get("BENCH_RESNET", "1" if on_accel else "0") == "1" and _ok("resnet", 300):
         try:
             import dataclasses as _dc
 
@@ -1646,6 +1713,8 @@ def main() -> None:
         e2e_B = int(os.environ.get("BENCH_E2E_BATCH", str(best["B"] if on_accel else 8)))
         e2e_updates = int(os.environ.get("BENCH_E2E_UPDATES", "30" if on_accel else "3"))
         for mode in ("shm", "tcp"):
+            if not _ok(f"e2e_{mode}", 420):
+                continue
             try:
                 r = bench_e2e(cfg, e2e_B, e2e_updates, mode=mode)
                 extra[f"e2e_pipeline_{mode}"] = r
@@ -1654,7 +1723,7 @@ def main() -> None:
                 extra[f"e2e_pipeline_{mode}"] = {"error": f"{type(e).__name__}: {e}"}
                 print(f"[bench] e2e[{mode}] failed: {e}", file=sys.stderr)
 
-    if os.environ.get("BENCH_BUDGET", "1") == "1":
+    if os.environ.get("BENCH_BUDGET", "1") == "1" and _ok("stage_budget", 420):
         try:
             extra["stage_budget"] = bench_stage_budget(
                 cfg, int(os.environ.get("BENCH_BUDGET_BATCH",
@@ -1664,7 +1733,7 @@ def main() -> None:
             extra["stage_budget"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] stage budget failed: {e}", file=sys.stderr)
 
-    if os.environ.get("BENCH_KERNELS", "1") == "1":
+    if os.environ.get("BENCH_KERNELS", "1") == "1" and _ok("kernel_compare", 240):
         try:
             extra["kernel_compare"] = bench_kernels(
                 ImpalaConfig(), int(os.environ.get("BENCH_KERNEL_BATCH", "256")),
@@ -1673,7 +1742,7 @@ def main() -> None:
             extra["kernel_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] kernels failed: {e}", file=sys.stderr)
 
-    if os.environ.get("BENCH_R2D2", "1") == "1":
+    if os.environ.get("BENCH_R2D2", "1") == "1" and _ok("r2d2_learn", 120):
         try:
             # Default B=128: measured 860k frames/s on v5e vs 205-440k
             # across runs at the old B=64 (the fused LSTM amortizes much
@@ -1685,7 +1754,7 @@ def main() -> None:
             extra["r2d2_learn"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] r2d2 failed: {e}", file=sys.stderr)
 
-    if os.environ.get("BENCH_APEX", "1") == "1":
+    if os.environ.get("BENCH_APEX", "1") == "1" and _ok("apex_learn", 120):
         try:
             extra["apex_learn"] = bench_apex_learn(
                 int(os.environ.get("BENCH_APEX_BATCH", "256")),
@@ -1694,7 +1763,7 @@ def main() -> None:
             extra["apex_learn"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] apex failed: {e}", file=sys.stderr)
 
-    if os.environ.get("BENCH_XIMPALA", "1") == "1":
+    if os.environ.get("BENCH_XIMPALA", "1") == "1" and _ok("ximpala_learn", 120):
         try:
             extra["ximpala_learn"] = bench_ximpala_learn(
                 int(os.environ.get("BENCH_XIMPALA_BATCH", "64")),
@@ -1703,7 +1772,7 @@ def main() -> None:
             extra["ximpala_learn"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] ximpala failed: {e}", file=sys.stderr)
 
-    if os.environ.get("BENCH_APEX_INGEST", "1") == "1":
+    if os.environ.get("BENCH_APEX_INGEST", "1") == "1" and _ok("apex_ingest", 300):
         try:
             extra["apex_ingest"] = bench_apex_ingest(
                 int(os.environ.get("BENCH_APEX_INGEST_ITERS", "5")))
@@ -1711,7 +1780,7 @@ def main() -> None:
             extra["apex_ingest"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] apex ingest failed: {e}", file=sys.stderr)
 
-    if os.environ.get("BENCH_INGEST", "1") == "1":
+    if os.environ.get("BENCH_INGEST", "1") == "1" and _ok("ingest", 150):
         try:
             extra["ingest"] = bench_ingest(
                 int(os.environ.get("BENCH_INGEST_BATCH", "32")),
@@ -1720,7 +1789,7 @@ def main() -> None:
             extra["ingest"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] ingest failed: {e}", file=sys.stderr)
 
-    if os.environ.get("BENCH_ANAKIN", "1") == "1":
+    if os.environ.get("BENCH_ANAKIN", "1") == "1" and _ok("anakin", 240):
         try:
             # Accel sizing saturates the chip; the CPU artifact documents
             # the schema at a size the 1-core host can time.
@@ -1734,20 +1803,7 @@ def main() -> None:
             extra["anakin"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] anakin failed: {e}", file=sys.stderr)
 
-    # Pixel-env Anakin: accelerator-default (a conv learn step per update
-    # on the 1-core host is minutes; the CPU artifact documents the
-    # schema at a size the host can time).
-    if os.environ.get("BENCH_ANAKIN_BREAKOUT", "1" if on_accel else "0") == "1":
-        try:
-            extra["anakin_breakout"] = bench_anakin_breakout(
-                int(os.environ.get("BENCH_AB_ENVS", "256" if on_accel else "4")),
-                int(os.environ.get("BENCH_AB_CHUNK", "20" if on_accel else "2")),
-                max(iters // 30, 3))
-        except Exception as e:  # noqa: BLE001
-            extra["anakin_breakout"] = {"error": f"{type(e).__name__}: {e}"}
-            print(f"[bench] anakin_breakout failed: {e}", file=sys.stderr)
-
-    if os.environ.get("BENCH_ANAKIN_APEX", "1" if on_accel else "0") == "1":
+    if os.environ.get("BENCH_ANAKIN_APEX", "1" if on_accel else "0") == "1" and _ok("anakin_apex", 240):
         try:
             extra["anakin_apex"] = bench_anakin_apex(
                 int(os.environ.get("BENCH_AA_ENVS", "64" if on_accel else "2")),
@@ -1757,7 +1813,7 @@ def main() -> None:
             extra["anakin_apex"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] anakin_apex failed: {e}", file=sys.stderr)
 
-    if os.environ.get("BENCH_ANAKIN_R2D2", "1") == "1":
+    if os.environ.get("BENCH_ANAKIN_R2D2", "1") == "1" and _ok("anakin_r2d2", 240):
         try:
             extra["anakin_r2d2"] = bench_anakin_r2d2(
                 int(os.environ.get("BENCH_AR_ENVS", "256" if on_accel else "16")),
@@ -1767,7 +1823,7 @@ def main() -> None:
             extra["anakin_r2d2"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] anakin_r2d2 failed: {e}", file=sys.stderr)
 
-    if os.environ.get("BENCH_LONG_CONTEXT", "1" if on_accel else "0") == "1":
+    if os.environ.get("BENCH_LONG_CONTEXT", "1" if on_accel else "0") == "1" and _ok("long_context", 240):
         try:
             extra["long_context"] = bench_long_context(
                 int(os.environ.get("BENCH_LC_ITERS", "10")))
@@ -1775,6 +1831,8 @@ def main() -> None:
             extra["long_context"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] long-context failed: {e}", file=sys.stderr)
 
+    extra["skipped_sections"] = skipped
+    extra["elapsed_s"] = round(time.monotonic() - t_start, 1)
     ab = extra.get("anakin_breakout", {})
     if on_accel and ab.get("frames_per_s", 0) > 0:
         # The pixel-env Anakin row is the strongest HONEST end-to-end

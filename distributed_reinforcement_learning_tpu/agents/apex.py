@@ -134,10 +134,18 @@ class ApexAgent:
         loss = jnp.mean(td_sq * is_weight)
         return loss, dqn.td_error(tv, sav)
 
-    def _learn(self, state: common.TargetTrainState, batch: ApexBatch, is_weight):
+    def _learn(self, state: common.TargetTrainState, batch: ApexBatch, is_weight,
+               axis_name: str | None = None):
         (loss, td), grads = jax.value_and_grad(self._loss, has_aux=True)(
             state.params, state.target_params, batch, is_weight
         )
+        if axis_name is not None:
+            # shard_map data-parallel callers (runtime/anakin_apex.py mesh
+            # mode): each device grads its local prioritized batch; the
+            # pmean makes the applied update the global-batch gradient and
+            # keeps the replicated params bit-identical across devices.
+            grads = jax.lax.pmean(grads, axis_name)
+            loss = jax.lax.pmean(loss, axis_name)
         updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
         params = jax.tree.map(lambda p, u: p + u, state.params, updates)
         new_state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)

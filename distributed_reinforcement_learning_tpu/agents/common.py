@@ -272,10 +272,16 @@ class SequenceReplayLearnMixin:
         priorities = self._seq_priority(tv, sav)
         return loss, priorities
 
-    def _learn(self, state, batch, is_weight):
+    def _learn(self, state, batch, is_weight, axis_name: str | None = None):
         (loss, priorities), grads = jax.value_and_grad(self._loss, has_aux=True)(
             state.params, state.target_params, batch, is_weight
         )
+        if axis_name is not None:
+            # shard_map data-parallel callers (runtime/anakin_r2d2.py mesh
+            # mode): pmean turns per-shard gradients into the global-batch
+            # gradient so replicated params stay identical across devices.
+            grads = jax.lax.pmean(grads, axis_name)
+            loss = jax.lax.pmean(loss, axis_name)
         updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
         params = jax.tree.map(lambda p, u: p + u, state.params, updates)
         new_state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)

@@ -73,10 +73,18 @@ def ingest(replay: DeviceReplay, batch: Any, errs: jax.Array) -> DeviceReplay:
     )
 
 
-def sample(replay: DeviceReplay, rng: jax.Array, n: int):
+def sample(replay: DeviceReplay, rng: jax.Array, n: int,
+           axis_name: str | None = None):
     """-> (replay', batch, idx [n], is_weights [n]). Stratified over
     `total/n` segments; empty slots carry zero priority and are never
-    drawn (the ring must hold at least one entry)."""
+    drawn (the ring must hold at least one entry).
+
+    `axis_name`: set by shard_map callers holding PER-DEVICE replay
+    shards (the Anakin mesh runtimes). Sampling stays local — each shard
+    stratifies over its own priorities with its own size N, the correct
+    IS weight for the per-shard sampler — but the batch-max
+    normalization runs over the GLOBAL batch (pmax over the axis) so the
+    weight scale matches the single-device semantics."""
     capacity = replay.priorities.shape[0]
     p = replay.priorities
     cum = jnp.cumsum(p)
@@ -86,7 +94,10 @@ def sample(replay: DeviceReplay, rng: jax.Array, n: int):
     idx = jnp.clip(jnp.searchsorted(cum, u, side="right"), 0, capacity - 1)
     probs = p[idx] / total
     weights = jnp.power(replay.size.astype(jnp.float32) * probs, -replay.beta)
-    weights = weights / jnp.max(weights)
+    wmax = jnp.max(weights)
+    if axis_name is not None:
+        wmax = jax.lax.pmax(wmax, axis_name)
+    weights = weights / wmax
     batch = jax.tree.map(lambda ring: ring[idx], replay.storage)
     new_replay = replay._replace(
         beta=jnp.minimum(1.0, replay.beta + BETA_INCREMENT))

@@ -295,14 +295,17 @@ def step(
     done = (game_over | lost_life) if life_loss else game_over
     if life_loss:
         # The reference's life-loss shaping REPLACES the step reward with
-        # -1 on a lost life (host parity: `runtime/impala_runner.py`
-        # `rec_reward = where(lost, -1, r)`, from `train_impala.py:149-154`);
-        # true game-overs keep the raw reward, like the host path's
-        # `lost = ... & ~done`. Omitting this (pre-r4s3 versions of this
-        # env) makes ball loss nearly costless to the learner — the core
-        # keep-the-rally-alive incentive disappears. `returns` above is
-        # accumulated from the RAW reward, so episode_return stays the
-        # true game score.
+        # -1 on a lost life (`train_impala.py:149-154`). On the TERMINAL
+        # life the reference still records -1 (it keys on any lives
+        # change); here true game-overs keep the raw reward instead —
+        # a deliberate deviation matching this repo's host path
+        # (`runtime/impala_runner.py` `lost = ... & ~done`), so host and
+        # on-device runners see identical shaping rather than exact
+        # reference semantics on the final step. Omitting the -1 entirely
+        # (pre-r4s3 versions of this env) makes ball loss nearly costless
+        # to the learner — the core keep-the-rally-alive incentive
+        # disappears. `returns` above is accumulated from the RAW reward,
+        # so episode_return stays the true game score.
         reward = jnp.where(lost_life & ~game_over, -1.0, reward)
 
     # Auto-reset game-over slots (fresh board; obs = reset observation).

@@ -36,6 +36,12 @@ from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexBa
 from distributed_reinforcement_learning_tpu.data import device_replay
 from distributed_reinforcement_learning_tpu.data.device_replay import DeviceReplay
 from distributed_reinforcement_learning_tpu.envs import cartpole_jax
+from distributed_reinforcement_learning_tpu.runtime.anakin_mesh import (
+    DataMeshReplayMixin,
+    batched_specs,
+    replay_specs,
+)
+from distributed_reinforcement_learning_tpu.parallel.mesh import DATA_AXIS as _DATA_AXIS, P
 
 
 class AnakinApexState(NamedTuple):
@@ -49,7 +55,7 @@ class AnakinApexState(NamedTuple):
     rng: jax.Array
 
 
-class AnakinApex:
+class AnakinApex(DataMeshReplayMixin):
     """Ape-X over a pure-JAX env with on-device prioritized replay.
 
     Each update collects `steps_per_collect` transitions from all
@@ -62,7 +68,7 @@ class AnakinApex:
                  capacity: int = 8192, steps_per_collect: int = 16,
                  target_sync_interval: int = 100, updates_per_collect: int = 1,
                  epsilon_decay: float = 0.05, epsilon_floor: float = 0.0,
-                 env=None, obs_transform=None):
+                 env=None, obs_transform=None, mesh=None):
         self.env = env if env is not None else cartpole_jax
         self.agent = agent
         self.num_envs = num_envs
@@ -87,8 +93,34 @@ class AnakinApex:
             raise ValueError(
                 f"Q head ({agent.cfg.num_actions}) narrower than the env's "
                 f"action set ({self.env.NUM_ACTIONS})")
-        self.train_chunk = jax.jit(self._train_chunk, static_argnums=(1,))
-        self.collect_chunk = jax.jit(self._collect_chunk, static_argnums=(1,))
+        # Multi-chip: shard over the `data` axis ONLY, with PER-DEVICE
+        # replay shards (see _state_specs). The replay families scale by
+        # replicating the (small) dueling net and splitting envs + ring;
+        # a global prioritized sampler over a capacity-sharded ring would
+        # serialize every learn batch behind cross-chip gathers of frame
+        # stacks, so each device samples its own shard locally and only
+        # the GRADIENTS cross ICI (pmean in agents/apex.py _learn).
+        # Tensor/pipeline axes stay with the IMPALA/transformer families.
+        self._setup_mesh(mesh, num_envs=num_envs, batch_size=batch_size,
+                         capacity=capacity)
+        self.write_width_local = self.write_width // self.dshard
+
+    # -- sharding --------------------------------------------------------
+    def _state_specs(self) -> AnakinApexState:
+        """PartitionSpecs: per-env leaves and the replay rings shard over
+        `data`; the TrainState and ring bookkeeping replicate (see
+        runtime/anakin_mesh.py for the design argument)."""
+        train_abs = jax.eval_shape(self.agent.init_state, jax.random.PRNGKey(0))
+        env_abs, _ = jax.eval_shape(
+            lambda k: self.env.reset(k, self.num_envs), jax.random.PRNGKey(0))
+        return AnakinApexState(
+            train=jax.tree.map(lambda _: P(), train_abs),
+            replay=replay_specs(ApexBatch(0, 0, 0, 0, 0, 0)),
+            env=batched_specs(env_abs),
+            obs=P(_DATA_AXIS), prev_action=P(_DATA_AXIS),
+            episodes=P(_DATA_AXIS), last_sync=P(),
+            rng=P(_DATA_AXIS),
+        )
 
     # -- init ------------------------------------------------------------
     def init(self, rng: jax.Array) -> AnakinApexState:
@@ -97,13 +129,14 @@ class AnakinApex:
         env, obs = self.env.reset(k_env, self.num_envs)
         obs = self.obs_transform(obs)
         replay = device_replay.make(self._zero_transitions(obs), self.capacity)
-        return AnakinApexState(
+        state = AnakinApexState(
             train=train, replay=replay, env=env, obs=obs,
             prev_action=jnp.zeros(self.num_envs, jnp.int32),
             episodes=jnp.zeros(self.num_envs, jnp.int32),
             last_sync=jnp.int32(0),
             rng=k_run,
         )
+        return self._place_init(state, k_run)
 
     def _zero_transitions(self, obs: jax.Array) -> ApexBatch:
         C = self.capacity
@@ -144,14 +177,15 @@ class AnakinApex:
 
     def _collect(self, state: AnakinApexState):
         """steps_per_collect env steps -> (state', flat ApexBatch [W],
-        episode stats)."""
+        episode stats). Under a mesh this body runs per-device on the
+        local env shard, so the flat width is the LOCAL one."""
         carry = (state.env, state.obs, state.prev_action, state.episodes,
                  state.rng)
         carry, rec = jax.lax.scan(
             functools.partial(self._env_step, state.train.params), carry,
             None, length=self.steps_per_collect)
         env, obs, prev_action, episodes, rng = carry
-        flat = lambda name: rec[name].reshape((self.write_width,)
+        flat = lambda name: rec[name].reshape((self.write_width_local,)
                                               + rec[name].shape[2:])
         batch = ApexBatch(
             state=flat("state"), next_state=flat("next_state"),
@@ -182,8 +216,9 @@ class AnakinApex:
             train, replay, rng = carry
             rng, k = jax.random.split(rng)
             replay, batch, idx, weights = device_replay.sample(
-                replay, k, self.batch_size)
-            train, td, metrics = self.agent._learn(train, batch, weights)
+                replay, k, self.batch_local, axis_name=self._axis)
+            train, td, metrics = self.agent._learn(train, batch, weights,
+                                                   axis_name=self._axis)
             replay = device_replay.update_priorities(replay, idx, td)
             return (train, replay, rng), metrics
 
@@ -197,9 +232,10 @@ class AnakinApex:
         train = jax.lax.cond(do_sync, lambda t: t.sync_target(), lambda t: t,
                              train)
         last_sync = jnp.where(do_sync, train.step, state.last_sync)
-        metrics.update(stats)
-        metrics["replay_size"] = replay.size.astype(jnp.float32)
-        metrics["epsilon_mean"] = self._epsilon(state.episodes).mean()
+        metrics.update(self._psum(stats))
+        metrics["replay_size"] = self._psum(replay.size.astype(jnp.float32))
+        metrics["epsilon_mean"] = self._pmean(
+            self._epsilon(state.episodes).mean())
         return state._replace(train=train, replay=replay, rng=rng,
                               last_sync=last_sync), metrics
 
@@ -210,7 +246,7 @@ class AnakinApex:
     def _collect_only(self, state: AnakinApexState, _):
         state, trans, stats = self._collect(state)
         replay = self._ingest(state.train, state.replay, trans)
-        return state._replace(replay=replay), stats
+        return state._replace(replay=replay), self._psum(stats)
 
     def _collect_chunk(self, state: AnakinApexState, num_collects: int):
         """Warm-up: fill the ring without training."""

@@ -50,6 +50,12 @@ from distributed_reinforcement_learning_tpu.data.device_replay import (
     DeviceReplay,
 )
 from distributed_reinforcement_learning_tpu.envs import cartpole_jax
+from distributed_reinforcement_learning_tpu.parallel.mesh import DATA_AXIS as _DATA_AXIS, P
+from distributed_reinforcement_learning_tpu.runtime.anakin_mesh import (
+    DataMeshReplayMixin,
+    batched_specs,
+    replay_specs,
+)
 
 _priority = device_replay.priority
 
@@ -67,7 +73,7 @@ class AnakinR2D2State(NamedTuple):
     rng: jax.Array
 
 
-class AnakinR2D2:
+class AnakinR2D2(DataMeshReplayMixin):
     """R2D2 over a pure-JAX env with on-device prioritized replay.
 
     `num_envs` parallel envs collect one `seq_len` sequence each per
@@ -79,7 +85,8 @@ class AnakinR2D2:
     def __init__(self, agent: R2D2Agent, num_envs: int, batch_size: int = 32,
                  capacity: int = 4096, target_sync_interval: int = 100,
                  updates_per_collect: int = 1, epsilon_decay: float = 0.1,
-                 epsilon_floor: float = 0.0, env=None, obs_transform=None):
+                 epsilon_floor: float = 0.0, env=None, obs_transform=None,
+                 mesh=None):
         self.env = env if env is not None else cartpole_jax
         self.agent = agent
         self.num_envs = num_envs
@@ -103,8 +110,27 @@ class AnakinR2D2:
             raise ValueError(
                 f"Q head ({agent.cfg.num_actions}) narrower than the env's "
                 f"action set ({self.env.NUM_ACTIONS})")
-        self.train_chunk = jax.jit(self._train_chunk, static_argnums=(1,))
-        self.collect_chunk = jax.jit(self._collect_chunk, static_argnums=(1,))
+        # Multi-chip: data-axis shard_map with per-device replay shards —
+        # same design and argument as AnakinApex (runtime/anakin_mesh.py).
+        self._setup_mesh(mesh, num_envs=num_envs, batch_size=batch_size,
+                         capacity=capacity)
+
+    # -- sharding --------------------------------------------------------
+    def _state_specs(self) -> AnakinR2D2State:
+        """PartitionSpecs: per-env leaves and the sequence rings shard
+        over `data`; TrainState and ring bookkeeping replicate."""
+        train_abs = jax.eval_shape(self.agent.init_state, jax.random.PRNGKey(0))
+        env_abs, _ = jax.eval_shape(
+            lambda k: self.env.reset(k, self.num_envs), jax.random.PRNGKey(0))
+        return AnakinR2D2State(
+            train=jax.tree.map(lambda _: P(), train_abs),
+            replay=replay_specs(R2D2Batch(0, 0, 0, 0, 0, 0, 0)),
+            env=batched_specs(env_abs),
+            obs=P(_DATA_AXIS), prev_action=P(_DATA_AXIS),
+            h=P(_DATA_AXIS), c=P(_DATA_AXIS),
+            episodes=P(_DATA_AXIS), last_sync=P(),
+            rng=P(_DATA_AXIS),
+        )
 
     # -- init ------------------------------------------------------------
     def init(self, rng: jax.Array) -> AnakinR2D2State:
@@ -114,7 +140,7 @@ class AnakinR2D2:
         obs = self.obs_transform(obs)
         h, c = self.agent.initial_lstm_state(self.num_envs)
         replay = device_replay.make(self._zero_sequences(), self.capacity)
-        return AnakinR2D2State(
+        state = AnakinR2D2State(
             train=train, replay=replay, env=env, obs=obs,
             prev_action=jnp.zeros(self.num_envs, jnp.int32),
             h=h, c=c,
@@ -122,6 +148,7 @@ class AnakinR2D2:
             last_sync=jnp.int32(0),
             rng=k_run,
         )
+        return self._place_init(state, k_run)
 
     def _zero_sequences(self) -> R2D2Batch:
         cfg = self.agent.cfg
@@ -200,7 +227,8 @@ class AnakinR2D2:
         return device_replay.ingest(replay, batch, errs)
 
     def _sample(self, replay: DeviceReplay, rng: jax.Array):
-        return device_replay.sample(replay, rng, self.batch_size)
+        return device_replay.sample(replay, rng, self.batch_local,
+                                    axis_name=self._axis)
 
     # -- one update: collect, ingest, K prioritized steps ----------------
     def _update(self, state: AnakinR2D2State, _):
@@ -212,7 +240,8 @@ class AnakinR2D2:
             train, replay, rng = carry
             rng, k = jax.random.split(rng)
             replay, batch, idx, weights = self._sample(replay, k)
-            train, new_err, metrics = self.agent._learn(train, batch, weights)
+            train, new_err, metrics = self.agent._learn(train, batch, weights,
+                                                        axis_name=self._axis)
             replay = device_replay.update_priorities(replay, idx, new_err)
             return (train, replay, rng), metrics
 
@@ -229,9 +258,10 @@ class AnakinR2D2:
         train = jax.lax.cond(do_sync, lambda t: t.sync_target(), lambda t: t,
                              train)
         last_sync = jnp.where(do_sync, train.step, state.last_sync)
-        metrics.update(stats)
-        metrics["replay_size"] = replay.size.astype(jnp.float32)
-        metrics["epsilon_mean"] = self._epsilon(state.episodes).mean()
+        metrics.update(self._psum(stats))
+        metrics["replay_size"] = self._psum(replay.size.astype(jnp.float32))
+        metrics["epsilon_mean"] = self._pmean(
+            self._epsilon(state.episodes).mean())
         return state._replace(train=train, replay=replay, rng=rng,
                               last_sync=last_sync), metrics
 
@@ -242,7 +272,7 @@ class AnakinR2D2:
     def _collect_only(self, state: AnakinR2D2State, _):
         state, seqs, stats = self._collect(state)
         replay = self._ingest(state.train, state.replay, seqs)
-        return state._replace(replay=replay), stats
+        return state._replace(replay=replay), self._psum(stats)
 
     def _collect_chunk(self, state: AnakinR2D2State, num_collects: int):
         """Warm-up: fill the ring without training (the host learner's

@@ -204,6 +204,10 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
         # the environment per call and a malformed value fails at
         # construction, not mid-training.
         self.ingest_unrolls = int(os.environ.get("DRL_APEX_INGEST_UNROLLS", "1"))
+        if self.ingest_unrolls < 1:
+            raise ValueError(
+                "DRL_APEX_INGEST_UNROLLS must be >= 1, got "
+                f"{self.ingest_unrolls}")
         self._pending_ingest: tuple[Any, Any, int] | None = None
         self.timer = StageTimer(self.logger)
         self._profiler = ProfilerSession.from_env()
