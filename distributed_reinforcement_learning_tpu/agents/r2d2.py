@@ -49,6 +49,13 @@ class R2D2Config:
     # a float adds global-norm clipping in front (stable mode — the
     # unclipped TD spikes at target syncs are a collapse driver).
     gradient_clip_norm: float | None = None
+    # "mlp" = reference parity (its R2D2 is CartPole-only); "nature" /
+    # "resnet" = conv torsos for pixel envs (the R2D2 paper's Atari
+    # configuration — see models/r2d2_net.py).
+    torso: str = "mlp"
+    torso_width: int = 1
+    # Fold /255 into conv0 (conv torsos): uint8 frames feed the model raw.
+    fold_normalize: bool = False
 
 
 class R2D2Batch(NamedTuple):
@@ -66,7 +73,10 @@ class R2D2Batch(NamedTuple):
 class R2D2Agent(common.SequenceReplayLearnMixin):
     def __init__(self, cfg: R2D2Config):
         self.cfg = cfg
-        self.model = R2D2Net(num_actions=cfg.num_actions, lstm_size=cfg.lstm_size, dtype=cfg.dtype)
+        self.model = R2D2Net(num_actions=cfg.num_actions, lstm_size=cfg.lstm_size,
+                             dtype=cfg.dtype, torso=cfg.torso,
+                             torso_width=cfg.torso_width,
+                             fold_normalize=cfg.fold_normalize)
         self.tx = common.adam_with_clip(cfg.learning_rate,
                                         clip_norm=cfg.gradient_clip_norm)
         self.act = jax.jit(self._act)
@@ -78,11 +88,23 @@ class R2D2Agent(common.SequenceReplayLearnMixin):
         self.sync_target = jax.jit(lambda s: s.sync_target())
 
     def init_state(self, rng: jax.Array) -> common.TargetTrainState:
-        obs = jnp.zeros((1, *self.cfg.obs_shape), jnp.float32)
+        dtype = jnp.uint8 if self.cfg.fold_normalize else jnp.float32
+        obs = jnp.zeros((1, *self.cfg.obs_shape), dtype)
         pa = jnp.zeros((1,), jnp.int32)
         h = c = jnp.zeros((1, self.cfg.lstm_size), jnp.float32)
         params = self.model.init(rng, obs, pa, h, c)
         return common.TargetTrainState.create(params, self.tx)
+
+    def _prep_obs(self, obs):
+        """Normalize frames — or pass integer frames raw under
+        `fold_normalize` (the conv owns the /255; ApexAgent._prep_obs)."""
+        if (
+            self.cfg.fold_normalize
+            and len(self.cfg.obs_shape) == 3
+            and jnp.issubdtype(obs.dtype, jnp.integer)
+        ):
+            return obs
+        return common.normalize_obs(obs, self.cfg.dtype)
 
     def initial_lstm_state(self, batch_size: int) -> tuple[jax.Array, jax.Array]:
         z = jnp.zeros((batch_size, self.cfg.lstm_size), jnp.float32)
@@ -91,7 +113,7 @@ class R2D2Agent(common.SequenceReplayLearnMixin):
     # -- act -------------------------------------------------------------
     def _act(self, params, obs, h, c, prev_action, epsilon, rng):
         """Batched epsilon-greedy single step (`agent/r2d2.py:166-186`)."""
-        q, new_h, new_c = self.model.apply(params, common.normalize_obs(obs, self.cfg.dtype), prev_action, h, c)
+        q, new_h, new_c = self.model.apply(params, self._prep_obs(obs), prev_action, h, c)
         action = common.epsilon_greedy(q, epsilon, self.cfg.num_actions, rng)
         return action, q, new_h, new_c
 
@@ -101,7 +123,7 @@ class R2D2Agent(common.SequenceReplayLearnMixin):
     # in `common.sequence_double_q_td` (`agent/r2d2.py:64-87`).
     def _sequence_td(self, params, target_params, batch: R2D2Batch):
         cfg = self.cfg
-        obs = common.normalize_obs(batch.state, self.cfg.dtype)
+        obs = self._prep_obs(batch.state)
         unroll = lambda p: self.model.apply(
             p, obs, batch.previous_action, batch.done, batch.initial_h, batch.initial_c,
             method=self.model.unroll)

@@ -9,12 +9,13 @@ The reference resolves env names via `gym.make` (`train_impala.py:117`,
   write; set `DRL_NO_GYMNASIUM=1` to force the in-tree numpy physics
   (tests use it for determinism, and it is the automatic fallback);
 - Atari names (`*Deterministic-v4`, `*NoFrameskip-v4`) use gymnasium +
-  `ale-py` when the emulator is importable; otherwise `Breakout*` and
-  `Pong*` fall back to the in-tree simulators (real game dynamics at ALE
-  specs, through the same GymnasiumRawFrames adapter —
-  envs/breakout_sim, envs/pong_sim; Pong adapts without fire-reset, the
-  reference's `make_uint8_env_no_fire` path) and other titles fall back
-  to the full preprocessing pipeline over `SyntheticAtari`. All
+  `ale-py` when the emulator is importable; otherwise `Breakout*`,
+  `Pong*` and `SpaceInvaders*` fall back to the in-tree simulators (real
+  game dynamics at ALE specs, through the same GymnasiumRawFrames
+  adapter — envs/{breakout,pong,invaders}_sim; Pong/SpaceInvaders adapt
+  without fire-reset, the reference's `make_uint8_env_no_fire` path)
+  and other titles fall back to the full preprocessing pipeline over
+  `SyntheticAtari`. All
   fallbacks say so on stderr, once per name, because training
   "Breakout" on a stand-in silently is how a benchmark lies
   (`DRL_SYNTHETIC_ATARI=1` opts into silence).
@@ -49,6 +50,33 @@ def _use_gymnasium() -> bool:
     return gymnasium_available()
 
 
+def _sim_fallback(name: str, sim_mod, id_prefix: str, seed: int,
+                  fire_reset: bool, raw_cls, game: str) -> Env:
+    """Shared no-ALE fallback: warn once, then route through gymnasium's
+    registration of the in-tree simulator (the exact `GymnasiumRawFrames`
+    adapter an ale-py install would use) or the raw-protocol class.
+
+    The Deterministic name encodes ALE's built-in frameskip 4 (see
+    GymnasiumRawFrames docstring) — honored in the sim either way.
+    """
+    if name not in _warned_synthetic and os.environ.get("DRL_SYNTHETIC_ATARI") != "1":
+        _warned_synthetic.add(name)
+        print(f"[envs] WARNING: no ALE emulator available; {name!r} resolves "
+              f"to the in-tree {game} simulator (real game dynamics, not "
+              f"the 2600 ROM). Install ale-py for the real game.",
+              file=sys.stderr)
+    skip = 4 if "Deterministic" in name else 1
+    if _use_gymnasium() and sim_mod.register_gymnasium():
+        from distributed_reinforcement_learning_tpu.envs.gymnasium_env import GymnasiumRawFrames
+
+        sim_name = (f"{id_prefix}Deterministic-v0" if skip == 4
+                    else f"{id_prefix}-v0")
+        return AtariPreprocessor(GymnasiumRawFrames(sim_name, seed=seed),
+                                 fire_reset=fire_reset)
+    return AtariPreprocessor(raw_cls(seed=seed, frameskip=skip),
+                             fire_reset=fire_reset)
+
+
 def make_env(name: str, seed: int = 0, num_actions: int = 18) -> Env:
     if name in _REGISTRY:
         return _REGISTRY[name](seed=seed)
@@ -73,22 +101,10 @@ def make_env(name: str, seed: int = 0, num_actions: int = 18) -> Env:
         if name.startswith("Breakout"):
             from distributed_reinforcement_learning_tpu.envs import breakout_sim
 
-            if name not in _warned_synthetic and os.environ.get("DRL_SYNTHETIC_ATARI") != "1":
-                _warned_synthetic.add(name)
-                print(f"[envs] WARNING: no ALE emulator available; {name!r} resolves "
-                      f"to the in-tree Breakout simulator (real game dynamics, not "
-                      f"the 2600 ROM). Install ale-py for the real game.",
-                      file=sys.stderr)
-            # The Deterministic name encodes ALE's built-in frameskip 4
-            # (see GymnasiumRawFrames docstring) — honor it in the sim.
-            skip = 4 if "Deterministic" in name else 1
-            if _use_gymnasium() and breakout_sim.register_gymnasium():
-                from distributed_reinforcement_learning_tpu.envs.gymnasium_env import GymnasiumRawFrames
-
-                sim_name = ("BreakoutSimDeterministic-v0" if skip == 4
-                            else "BreakoutSim-v0")
-                return AtariPreprocessor(GymnasiumRawFrames(sim_name, seed=seed))
-            return AtariPreprocessor(breakout_sim.BreakoutSimRaw(seed=seed, frameskip=skip))
+            return _sim_fallback(name, breakout_sim, "BreakoutSim", seed,
+                                 fire_reset=True,
+                                 raw_cls=breakout_sim.BreakoutSimRaw,
+                                 game="Breakout")
         if name.startswith("Pong"):
             # Second faithful game (envs/pong_sim): 6-action set, signed
             # rewards, no lives. Adapted WITHOUT fire-reset — the
@@ -96,22 +112,22 @@ def make_env(name: str, seed: int = 0, num_actions: int = 18) -> Env:
             # (`wrappers.py:132-138`); serves are FIRE or auto.
             from distributed_reinforcement_learning_tpu.envs import pong_sim
 
-            if name not in _warned_synthetic and os.environ.get("DRL_SYNTHETIC_ATARI") != "1":
-                _warned_synthetic.add(name)
-                print(f"[envs] WARNING: no ALE emulator available; {name!r} resolves "
-                      f"to the in-tree Pong simulator (real game dynamics, not "
-                      f"the 2600 ROM). Install ale-py for the real game.",
-                      file=sys.stderr)
-            skip = 4 if "Deterministic" in name else 1
-            if _use_gymnasium() and pong_sim.register_gymnasium():
-                from distributed_reinforcement_learning_tpu.envs.gymnasium_env import GymnasiumRawFrames
+            return _sim_fallback(name, pong_sim, "PongSim", seed,
+                                 fire_reset=False,
+                                 raw_cls=pong_sim.PongSimRaw, game="Pong")
+        if name.startswith("SpaceInvaders"):
+            # Third faithful game (envs/invaders_sim): 6-action set with
+            # combined move+fire, enemy projectiles, destructible
+            # shields, mid-episode lives — the structurally-different
+            # objective the paddle pair doesn't exercise. No fire-reset:
+            # FIRE shoots (not a serve), so the wrapper would just waste
+            # the first frame.
+            from distributed_reinforcement_learning_tpu.envs import invaders_sim
 
-                sim_name = ("PongSimDeterministic-v0" if skip == 4
-                            else "PongSim-v0")
-                return AtariPreprocessor(GymnasiumRawFrames(sim_name, seed=seed),
-                                         fire_reset=False)
-            return AtariPreprocessor(pong_sim.PongSimRaw(seed=seed, frameskip=skip),
-                                     fire_reset=False)
+            return _sim_fallback(name, invaders_sim, "SpaceInvadersSim", seed,
+                                 fire_reset=False,
+                                 raw_cls=invaders_sim.InvadersSimRaw,
+                                 game="Space-Invaders")
         # Synthetic frames through the real preprocessing pipeline (same
         # shapes/dtypes/life semantics).
         if name not in _warned_synthetic and os.environ.get("DRL_SYNTHETIC_ATARI") != "1":

@@ -16,36 +16,71 @@ import jax
 import jax.numpy as jnp
 
 from distributed_reinforcement_learning_tpu.models.recurrent import LSTMCell
-from distributed_reinforcement_learning_tpu.models.torso import ActionEmbedding
+from distributed_reinforcement_learning_tpu.models.torso import (
+    ActionEmbedding, NatureConv, ResNetTorso)
 
 _glorot = nn.initializers.xavier_uniform()
 
 
 class R2D2Net(nn.Module):
-    """MLP torso + action embed -> LSTM -> dueling head (value - mean).
+    """Torso + action embed -> LSTM -> dueling head (value - mean).
 
     Single-step signature matches `model/r2d2_lstm.py:26-47`: returns
     (q_value [N, A], h, c).
+
+    `torso`: "mlp" is the reference's CartPole downscaling
+    (`model/r2d2_lstm.py:26-47` — its R2D2 never sees pixels); "nature" /
+    "resnet" are the conv torsos that make the family an Atari agent the
+    way the R2D2 paper describes (Kapturowski et al. 2019 use exactly
+    the Nature-DQN stack in front of the LSTM) — a deliberate
+    beyond-parity extension for the on-device pixel envs.
     """
 
     num_actions: int
     lstm_size: int = 512
     dtype: jnp.dtype = jnp.float32
     cell_backend: str = "auto"  # LSTM recursion backend (pallas on TPU)
+    torso: str = "mlp"  # "mlp" | "nature" | "resnet"
+    torso_width: int = 1  # ResNet channel multiplier
+    # Fold /255 into conv0's kernel; integer frames flow in raw
+    # (see NatureConv). Conv torsos only.
+    fold_normalize: bool = False
 
     def setup(self):
-        self.state_fc1 = nn.Dense(256, kernel_init=_glorot, dtype=self.dtype)
-        self.state_fc2 = nn.Dense(256, kernel_init=_glorot, dtype=self.dtype)
+        if self.torso == "mlp":
+            self.state_fc1 = nn.Dense(256, kernel_init=_glorot, dtype=self.dtype)
+            self.state_fc2 = nn.Dense(256, kernel_init=_glorot, dtype=self.dtype)
+        else:
+            scale = 1.0 / 255.0 if self.fold_normalize else None
+            if self.torso == "resnet":
+                self.conv_torso = ResNetTorso(
+                    dtype=self.dtype, width=self.torso_width,
+                    input_scale=scale, name="torso")
+            else:
+                self.conv_torso = NatureConv(
+                    dtype=self.dtype, input_scale=scale, name="torso")
         self.action_embed = ActionEmbedding(self.num_actions, dtype=self.dtype)
         self.cell = LSTMCell(self.lstm_size, dtype=self.dtype, backend=self.cell_backend)
         self.head_fc = nn.Dense(128, kernel_init=_glorot, dtype=self.dtype)
         self.value = nn.Dense(self.num_actions, kernel_init=_glorot, dtype=self.dtype)
         self.mean = nn.Dense(1, kernel_init=_glorot, dtype=self.dtype)
 
+    def _torso(self, x: jax.Array) -> jax.Array:
+        """[N, ...obs] -> [N, F] features."""
+        if self.torso == "mlp":
+            x = nn.relu(self.state_fc1(x.astype(self.dtype)))
+            return nn.relu(self.state_fc2(x))
+        if self.fold_normalize and not jnp.issubdtype(x.dtype, jnp.integer):
+            # The folded conv0 kernel scales by 1/255; already-normalized
+            # float frames would be scaled twice. Trace-time contract
+            # error, same guard as ApexAgent._prep_obs's dtype check.
+            raise ValueError(
+                "fold_normalize expects raw integer frames; got "
+                f"{x.dtype} — feed uint8 or disable fold_normalize")
+        return self.conv_torso(x)
+
     def step(self, obs: jax.Array, prev_action: jax.Array, h: jax.Array, c: jax.Array):
-        x = obs.astype(self.dtype)
-        x = nn.relu(self.state_fc1(x))
-        x = nn.relu(self.state_fc2(x))
+        x = self._torso(obs)
         a = self.action_embed(prev_action)
         z = jnp.concatenate([x, a], axis=-1)
         new_h, new_c = self.cell(z, h, c)
@@ -62,15 +97,18 @@ class R2D2Net(nn.Module):
         done-masked like `model/r2d2_lstm.py:78-80`: (h, c) are zeroed
         *after* the step at which done[t] is True. Returns `[B, T, A]`.
 
-        Only the LSTM recursion is sequential: the MLP torso, action
+        Only the LSTM recursion is sequential: the torso, action
         embedding, and dueling head are h-independent, so they run
-        time-parallel over the whole `[B, T]` batch (one MXU matmul each)
-        around the fused `cell.unroll` — vs the reference's per-timestep
-        whole-network replicas (`model/r2d2_lstm.py:65-112`).
+        time-parallel over the whole `[B, T]` batch (one MXU matmul /
+        conv pass each) around the fused `cell.unroll` — vs the
+        reference's per-timestep whole-network replicas
+        (`model/r2d2_lstm.py:65-112`). Conv torsos flatten [B, T] into
+        the batch dim for the pass (2-D feature maps keep their own
+        trailing dims).
         """
-        x = obs_seq.astype(self.dtype)
-        x = nn.relu(self.state_fc1(x))
-        x = nn.relu(self.state_fc2(x))
+        B, T = obs_seq.shape[:2]
+        x = self._torso(obs_seq.reshape((B * T,) + obs_seq.shape[2:]))
+        x = x.reshape((B, T, -1))
         a = self.action_embed(prev_action_seq)
         z = jnp.concatenate([x, a], axis=-1)
         h_all, _ = self.cell.unroll(z, done_seq, h0, c0)

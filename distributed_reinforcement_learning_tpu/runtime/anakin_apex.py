@@ -104,6 +104,8 @@ class AnakinApex(DataMeshReplayMixin):
         self._setup_mesh(mesh, num_envs=num_envs, batch_size=batch_size,
                          capacity=capacity)
         self.write_width_local = self.write_width // self.dshard
+        self._greedy_eval_jit = jax.jit(self._greedy_eval,
+                                        static_argnums=(1, 2))
 
     # -- sharding --------------------------------------------------------
     def _state_specs(self) -> AnakinApexState:
@@ -251,3 +253,42 @@ class AnakinApex(DataMeshReplayMixin):
     def _collect_chunk(self, state: AnakinApexState, num_collects: int):
         """Warm-up: fill the ring without training."""
         return jax.lax.scan(self._collect_only, state, None, length=num_collects)
+
+    # -- greedy evaluation (argmax-Q, fresh envs, all on-device) ---------
+    def _greedy_eval(self, params, num_envs: int, num_steps: int, rng):
+        k_reset, k_run = jax.random.split(rng)
+        env, obs = self.env.reset(k_reset, num_envs)
+        obs = self.obs_transform(obs)
+        pa = jnp.zeros(num_envs, jnp.int32)
+        mask_fn = getattr(self.env, "completed_episode_mask",
+                          lambda done, _state: done)
+
+        def step_fn(carry, k):
+            env, obs, pa = carry
+            # epsilon = 0 through the shared act path: pure argmax-Q.
+            action, _q = self.agent._act(params, obs, pa, 0.0, k)
+            env_action = (action % self.env.NUM_ACTIONS
+                          if self.agent.cfg.num_actions != self.env.NUM_ACTIONS
+                          else action)
+            env, next_obs, _r, done, ep = self.env.step(env, env_action, k)
+            carry = (env, self.obs_transform(next_obs),
+                     jnp.where(done, 0, action).astype(jnp.int32))
+            return carry, (ep, mask_fn(done, env))
+
+        keys = jax.random.split(k_run, num_steps)
+        _, (eps, completed) = jax.lax.scan(step_fn, (env, obs, pa), keys)
+        return {
+            "return_sum": (eps * completed.astype(jnp.float32)).sum(),
+            "episodes": completed.sum().astype(jnp.int32),
+        }
+
+    def greedy_eval(self, params, num_envs: int, num_steps: int, rng) -> dict:
+        """Deterministic (argmax-Q) score on fresh envs — the ground-truth
+        metric behind the behavior curves, which keep the epsilon ladder's
+        exploration mixed in (same contract as AnakinImpala.greedy_eval)."""
+        out = self._greedy_eval_jit(params, num_envs, num_steps, rng)
+        episodes = int(out["episodes"])
+        return {
+            "mean_return": float(out["return_sum"]) / max(episodes, 1),
+            "episodes": episodes,
+        }

@@ -114,6 +114,8 @@ class AnakinR2D2(DataMeshReplayMixin):
         # same design and argument as AnakinApex (runtime/anakin_mesh.py).
         self._setup_mesh(mesh, num_envs=num_envs, batch_size=batch_size,
                          capacity=capacity)
+        self._greedy_eval_jit = jax.jit(self._greedy_eval,
+                                        static_argnums=(1, 2))
 
     # -- sharding --------------------------------------------------------
     def _state_specs(self) -> AnakinR2D2State:
@@ -278,3 +280,45 @@ class AnakinR2D2(DataMeshReplayMixin):
         """Warm-up: fill the ring without training (the host learner's
         `train_start_factor` gate, expressed as an explicit phase)."""
         return jax.lax.scan(self._collect_only, state, None, length=num_collects)
+
+    # -- greedy evaluation (argmax-Q, fresh envs + LSTM, on-device) ------
+    def _greedy_eval(self, params, num_envs: int, num_steps: int, rng):
+        k_reset, k_run = jax.random.split(rng)
+        env, obs = self.env.reset(k_reset, num_envs)
+        obs = self.obs_transform(obs)
+        h, c = self.agent.initial_lstm_state(num_envs)
+        pa = jnp.zeros(num_envs, jnp.int32)
+        mask_fn = getattr(self.env, "completed_episode_mask",
+                          lambda done, _state: done)
+
+        def step_fn(carry, k):
+            env, obs, pa, h, c = carry
+            # epsilon = 0 through the shared act path: pure argmax-Q.
+            action, _q, new_h, new_c = self.agent._act(
+                params, obs, h, c, pa, 0.0, k)
+            env_action = (action % self.env.NUM_ACTIONS
+                          if self.agent.cfg.num_actions != self.env.NUM_ACTIONS
+                          else action)
+            env, next_obs, _r, done, ep = self.env.step(env, env_action, k)
+            keep = (~done).astype(new_h.dtype)[:, None]
+            carry = (env, self.obs_transform(next_obs),
+                     jnp.where(done, 0, action).astype(jnp.int32),
+                     new_h * keep, new_c * keep)
+            return carry, (ep, mask_fn(done, env))
+
+        keys = jax.random.split(k_run, num_steps)
+        _, (eps, completed) = jax.lax.scan(step_fn, (env, obs, pa, h, c), keys)
+        return {
+            "return_sum": (eps * completed.astype(jnp.float32)).sum(),
+            "episodes": completed.sum().astype(jnp.int32),
+        }
+
+    def greedy_eval(self, params, num_envs: int, num_steps: int, rng) -> dict:
+        """Deterministic (argmax-Q) score on fresh envs with the recurrent
+        state carried across steps (same contract as AnakinImpala)."""
+        out = self._greedy_eval_jit(params, num_envs, num_steps, rng)
+        episodes = int(out["episodes"])
+        return {
+            "mean_return": float(out["return_sum"]) / max(episodes, 1),
+            "episodes": episodes,
+        }

@@ -254,6 +254,10 @@ def _jittable_env_for(agent_cfg, rt):
         from distributed_reinforcement_learning_tpu.envs import breakout_jax
 
         return breakout_jax, None
+    if env_name.startswith("SpaceInvaders"):
+        from distributed_reinforcement_learning_tpu.envs import invaders_jax
+
+        return invaders_jax, None
     if env_name.startswith("Pong"):
         from distributed_reinforcement_learning_tpu.envs import pong_jax
 

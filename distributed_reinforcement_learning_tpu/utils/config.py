@@ -142,6 +142,12 @@ def load_config(path: str | Path, section: str):
             # change reference-config behavior. Stable mode opts in via
             # the distinct `adam_clip_norm` key.
             gradient_clip_norm=d.get("adam_clip_norm", None),
+            # Pixel-R2D2 extensions (models/r2d2_net.py): the reference's
+            # R2D2 is MLP/CartPole-only, so these keys have no reference
+            # counterpart.
+            torso=d.get("torso", "mlp"),
+            torso_width=d.get("torso_width", 1),
+            fold_normalize=d.get("fold_normalize", False),
         )
     elif algorithm == "xformer":
         agent_cfg = XformerConfig(

@@ -32,7 +32,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 def parse_args() -> argparse.Namespace:
     p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     p.add_argument("--out", required=True)
-    p.add_argument("--env", default="breakout", choices=["breakout", "pong"],
+    p.add_argument("--env", default="breakout",
+                   choices=["breakout", "pong", "invaders"],
                    help="which on-device pixel env to train "
                         "(envs/breakout_jax.py / envs/pong_jax.py)")
     p.add_argument("--num-envs", type=int, default=128)
@@ -94,14 +95,15 @@ def main() -> None:
     import jax.numpy as jnp
 
     from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
-    from distributed_reinforcement_learning_tpu.envs import breakout_jax, pong_jax
+    from distributed_reinforcement_learning_tpu.envs import breakout_jax, invaders_jax, pong_jax
     from distributed_reinforcement_learning_tpu.runtime.anakin import AnakinImpala
     from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
 
-    env_mod = {"breakout": breakout_jax, "pong": pong_jax}[args.env]
+    env_mod = {"breakout": breakout_jax, "pong": pong_jax,
+               "invaders": invaders_jax}[args.env]
     if args.eval_steps is None:
         # Episode frame caps baked into each env's step() default.
-        cap = {"breakout": 10_000, "pong": 20_000}[args.env]
+        cap = {"breakout": 10_000, "pong": 20_000, "invaders": 10_000}[args.env]
         args.eval_steps = cap // 4 + 500
 
     platform = jax.default_backend()

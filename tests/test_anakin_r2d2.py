@@ -139,3 +139,25 @@ class TestAnakinR2D2:
         mean_return = float(m["episode_return_sum"].sum()) / max(episodes, 1.0)
         assert episodes > 0
         assert mean_return > 45, f"late mean return {mean_return}"
+
+
+class TestPixelR2D2:
+    def test_breakout_sequences_train_and_eval(self):
+        """Conv-torso R2D2 (`models/r2d2_net.py` torso="nature") + uint8
+        sequence ring + pixel env: compiled updates run, stay finite, and
+        the greedy-eval rollout executes (VERDICT r4 item 2's in-suite
+        pixel-R2D2 coverage)."""
+        from distributed_reinforcement_learning_tpu.envs import breakout_jax
+
+        cfg = R2D2Config(obs_shape=(84, 84, 4), num_actions=4, seq_len=4,
+                         burn_in=2, lstm_size=16, torso="nature",
+                         fold_normalize=True, priority_eta=0.9)
+        an = AnakinR2D2(R2D2Agent(cfg), num_envs=2, capacity=8,
+                        batch_size=2, env=breakout_jax)
+        st = an.init(jax.random.PRNGKey(0))
+        assert st.replay.storage.state.dtype == jnp.uint8
+        st, _ = an.collect_chunk(st, 1)
+        st, m = an.train_chunk(st, 1)
+        assert np.isfinite(np.asarray(m["loss"])).all()
+        ev = an.greedy_eval(st.train.params, 2, 8, jax.random.PRNGKey(1))
+        assert "mean_return" in ev

@@ -206,3 +206,31 @@ class TestResNetTorso:
         cfg, rt = load_config("config.json", "impala_resnet")
         assert cfg.torso == "resnet" and cfg.torso_width == 4
         assert cfg.fold_normalize is True
+
+
+def test_r2d2_conv_torso_step_and_unroll_consistency(rng):
+    """The pixel R2D2Net (nature torso, folded /255) keeps the same
+    step/unroll contract as the MLP variant: the time-parallel conv pass
+    + fused LSTM unroll matches a per-step Python loop with done-masked
+    resets, on raw uint8 frames."""
+    B, T, A, H = 2, 4, 4, 8
+    model = R2D2Net(num_actions=A, lstm_size=H, torso="nature",
+                    fold_normalize=True)
+    key = jax.random.PRNGKey(5)
+    obs = jax.random.randint(key, (B, T, 84, 84, 4), 0, 256, dtype=jnp.uint8)
+    pa = jax.random.randint(key, (B, T), 0, A)
+    done = jnp.asarray([[False, True, False, False],
+                        [False, False, False, True]])
+    h0 = jax.random.normal(key, (B, H))
+    c0 = jax.random.normal(key, (B, H))
+
+    params = model.init(rng, obs[:, 0], pa[:, 0], h0, c0)
+    q_seq = model.apply(params, obs, pa, done, h0, c0, method=model.unroll)
+    assert q_seq.shape == (B, T, A)
+
+    h, c = h0, c0
+    for t in range(T):
+        q, h, c = model.apply(params, obs[:, t], pa[:, t], h, c)
+        np.testing.assert_allclose(q_seq[:, t], q, rtol=2e-5, atol=2e-5)
+        keep = (~done[:, t]).astype(h.dtype)[:, None]
+        h, c = h * keep, c * keep
