@@ -120,7 +120,12 @@ class PublishCadenceMixin:
         self._last_publish_step = self.train_steps
         with self.timer.stage("publish"):
             if _async_publish(self.sync_publish):
-                self.weights.publish_async(self.state.params, self.train_steps)
+                # Sub-stages so a fat `publish` mean is attributable: the
+                # handoff (device-side copy dispatch) vs the bounded-
+                # staleness stall (r4's shm-mode 2278 ms publish row was
+                # unexplained for lack of exactly this split).
+                with self.timer.stage("publish_handoff"):
+                    self.weights.publish_async(self.state.params, self.train_steps)
                 # Bounded staleness: latest-wins async publication may
                 # drop intermediate versions, but actors must never act
                 # on weights more than ~3 publish intervals old (the
@@ -128,7 +133,9 @@ class PublishCadenceMixin:
                 # targets). If the background worker lags past that,
                 # wait for it here — the common case never blocks.
                 if self.train_steps - self.weights.version > 3 * self.publish_interval:
-                    if not self.weights.flush_async(timeout=10.0):
+                    with self.timer.stage("publish_stall"):
+                        ok = self.weights.flush_async(timeout=10.0)
+                    if not ok:
                         import sys
 
                         print(f"[publish] WARNING: async weight publication "

@@ -14,6 +14,9 @@ Re-design of `train_r2d2.py:86-238`:
 
 from __future__ import annotations
 
+import collections
+import os
+
 import numpy as np
 
 import jax
@@ -170,7 +173,33 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
         self.queue = queue
         self.weights = weights
         self.batch_size = batch_size
-        self.replay = make_replay(replay_capacity)
+        # Recency-mixed sampling (opt-in stabilizer experiment, VERDICT r4
+        # item 9): DRL_R2D2_RECENT_FRACTION=r replaces the last round(r*B)
+        # rows of every prioritized batch with sequences drawn uniformly
+        # from the most recent DRL_R2D2_RECENT_WINDOW ingests (IS weight
+        # 1.0 for those rows — a deliberate bias; the hypothesis under
+        # test is that the collapse cycle is driven by replay staleness/
+        # diversity, so the knob trades strict prioritized-IS semantics
+        # for guaranteed fresh-data coverage). Forces the list-backed
+        # replay so batch rows are replaceable pre-stack.
+        self.recent_fraction = float(
+            os.environ.get("DRL_R2D2_RECENT_FRACTION", "0"))
+        # Window clamped to the ring capacity: a deque entry's tree idx is
+        # only valid until the ring overwrites that leaf (capacity ingests
+        # after its write); with maxlen <= capacity the oldest cached
+        # entry can never be a recycled slot.
+        self._recent: collections.deque = collections.deque(
+            maxlen=min(int(os.environ.get("DRL_R2D2_RECENT_WINDOW",
+                                          str(8 * batch_size))),
+                       replay_capacity))
+        self.replay = make_replay(
+            replay_capacity,
+            backend="python" if self.recent_fraction > 0 else "auto")
+        if self.recent_fraction > 0 and updates_per_call > 1:
+            raise ValueError(
+                "DRL_R2D2_RECENT_FRACTION does not compose with "
+                "updates_per_call > 1 (the scanned train call samples "
+                "inside one dispatch)")
         self.target_sync_interval = target_sync_interval
         # K>1: K prioritized updates per learn_many dispatch
         # (runtime/replay_train.py; K-1-step-stale priorities).
@@ -278,9 +307,28 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
                     batch = jax.tree.map(lambda x: x[:n], batch)
                 self.replay.add_batch_stacked(td, batch)  # one slice-assign/field
             else:
-                self.replay.add_batch(td, seqs)
+                new_idxs = self.replay.add_batch(td, seqs)
+                if self.recent_fraction > 0:
+                    self._recent.extend(zip(new_idxs, seqs))
         self.ingested_sequences += n
         return n
+
+    def _mix_recent(self, items, idxs, is_weight):
+        """Swap the tail of a prioritized sample for uniform-recent rows
+        (see the __init__ knob comment). Tree idxs come along, so the
+        post-step priority refresh covers the recent rows too."""
+        k = int(round(self.recent_fraction * len(items)))
+        if k == 0 or len(self._recent) < k:
+            return items, idxs, is_weight
+        pick = self._np_rng.choice(len(self._recent), size=k, replace=False)
+        idxs = np.asarray(idxs).copy()
+        is_weight = np.asarray(is_weight).copy()
+        for j, slot in enumerate(pick):
+            ridx, rseq = self._recent[int(slot)]
+            items[len(items) - k + j] = rseq
+            idxs[len(items) - k + j] = ridx
+            is_weight[len(items) - k + j] = 1.0
+        return items, idxs, is_weight
 
     def train(self) -> dict | None:
         """One prioritized train step over sequences (`train_r2d2.py:121-164`)."""
@@ -294,6 +342,8 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
         else:
             with self.timer.stage("replay_sample"):
                 items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
+                if self.recent_fraction > 0:
+                    items, idxs, is_weight = self._mix_recent(items, idxs, is_weight)
                 # SoA backend returns the stacked batch directly.
                 batch = items if getattr(self.replay, "stacked_samples", False) \
                     else stack_pytrees(items)

@@ -187,6 +187,18 @@ class TransportServer:
         self._conns_lock = threading.Lock()
         self._enc_lock = threading.Lock()
         self._enc_cache: tuple[int, bytes] = (-1, b"")
+        # Data-plane observability (the 20-actor scale demo and
+        # tests/test_actor_scale.py read these): accepted unrolls,
+        # ST_BUSY replies, partial batched accepts, weight sends.
+        # Lock-guarded: dict-item += is a load/add/store and the
+        # per-connection serve threads would otherwise lose increments.
+        self.stats = {"unrolls_accepted": 0, "busy_replies": 0,
+                      "partial_accepts": 0, "weight_sends": 0}
+        self._stats_lock = threading.Lock()
+
+    def _bump(self, key: str, by: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += by
 
     def start(self) -> "TransportServer":
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -197,7 +209,31 @@ class TransportServer:
         t = threading.Thread(target=self._accept_loop, daemon=True, name="transport-accept")
         t.start()
         self._threads.append(t)
+        stats_s = float(os.environ.get("DRL_TRANSPORT_STATS_S", "0"))
+        if stats_s > 0:
+            t2 = threading.Thread(target=self._stats_loop, args=(stats_s,),
+                                  daemon=True, name="transport-stats")
+            t2.start()
+            self._threads.append(t2)
         return self
+
+    def _stats_loop(self, interval: float) -> None:
+        """Periodic one-line data-plane stats on stderr (opt-in via
+        DRL_TRANSPORT_STATS_S=<seconds>; the actor-scale demo's learner
+        side of the fairness/backpressure record)."""
+        import sys as _sys
+
+        while not self._stop.wait(interval):
+            s = dict(self.stats)
+            try:
+                depth = self.queue.size()
+            except Exception:  # noqa: BLE001 — closed queue at shutdown
+                return
+            print(f"[transport] depth={depth} "
+                  f"unrolls={s['unrolls_accepted']} busy={s['busy_replies']} "
+                  f"partial={s['partial_accepts']} "
+                  f"weight_sends={s['weight_sends']}",
+                  file=_sys.stderr, flush=True)
 
     def stop(self) -> None:
         self._stop.set()
@@ -284,10 +320,11 @@ class TransportServer:
                 return True
         return False
 
-    def _enqueue_many(self, payload: bytes, total_wait: float = 30.0) -> int:
-        """Enqueue every blob of an OP_PUT_TRAJ_N payload; returns how many
-        were accepted (stops at the first refusal — the tail is NOT
-        enqueued, so the client may safely resend it)."""
+    def _enqueue_many(self, payload: bytes, total_wait: float = 30.0
+                      ) -> tuple[int, int]:
+        """Enqueue every blob of an OP_PUT_TRAJ_N payload; returns
+        (accepted, total) — acceptance stops at the first refusal (the
+        tail is NOT enqueued, so the client may safely resend it)."""
         deadline = time.monotonic() + total_wait
         blobs = unpack_batch(payload)
         raw = hasattr(self.queue, "put_bytes")
@@ -306,7 +343,7 @@ class TransportServer:
             if not ok:
                 break
             accepted += 1
-        return accepted
+        return accepted, len(blobs)
 
     def _serve_inner(self, conn: socket.socket) -> None:
         rbuf = _ConnRecvBuf()  # reused across this connection's requests
@@ -321,13 +358,18 @@ class TransportServer:
                     # backpressure (reference: blocking enqueue op,
                     # buffer_queue.py:398-414).
                     ok = self._enqueue(payload)
+                    self._bump("unrolls_accepted" if ok else "busy_replies")
                     _send_msg(conn, ST_OK if ok else ST_BUSY)
                 elif op == OP_PUT_TRAJ_N:
                     # The batched PUT: K unrolls in one round trip. The
                     # reply carries the accepted count; a partial accept
                     # (bounded queue refused the tail) is the batched
                     # analogue of ST_BUSY and the client retries the rest.
-                    _send_msg(conn, ST_OK, _I64.pack(self._enqueue_many(payload)))
+                    accepted, n_in = self._enqueue_many(payload)
+                    self._bump("unrolls_accepted", accepted)
+                    if accepted < n_in:
+                        self._bump("partial_accepts")
+                    _send_msg(conn, ST_OK, _I64.pack(accepted))
                 elif op == OP_GET_WEIGHTS:
                     # Versions are snapshot IDENTITIES across the wire,
                     # not an ordering: a restarted learner republishes
@@ -339,6 +381,7 @@ class TransportServer:
                     if version == have or version < 0:
                         _send_msg(conn, ST_OK, _I64.pack(have))
                     else:
+                        self._bump("weight_sends")
                         _send_msg(conn, ST_OK, _I64.pack(version), blob)
                 elif op == OP_ACT:
                     # Own RuntimeError handling: an inference failure (e.g.
@@ -387,6 +430,10 @@ class TransportClient:
         self.busy_timeout = busy_timeout
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
+        # Per-actor observability (read by the actor loop's periodic stat
+        # line; fairness evidence for the 20-actor topology demo).
+        self.stats = {"unrolls_sent": 0, "busy_waits": 0,
+                      "partial_accepts": 0, "weight_pulls": 0}
         self._connect()
 
     def _connect(self) -> None:
@@ -455,8 +502,10 @@ class TransportClient:
                     raise
                 return False
             if status == ST_OK:
+                self.stats["unrolls_sent"] += 1
                 return True
             if status == ST_BUSY:  # learner alive but queue full: keep pushing
+                self.stats["busy_waits"] += 1
                 now = time.monotonic()
                 busy_since = busy_since or now
                 if now - busy_since > self.busy_timeout:
@@ -500,7 +549,9 @@ class TransportClient:
                 raise TransportError("put_trajectories failed on the learner side")
             accepted = _I64.unpack(resp)[0]
             sent += accepted
+            self.stats["unrolls_sent"] += accepted
             if sent < len(blobs):
+                self.stats["partial_accepts"] += 1
                 # Partial acceptance = the bounded queue refused the tail
                 # (the batched ST_BUSY). The tail was not enqueued, so
                 # resending it cannot duplicate.
@@ -518,6 +569,7 @@ class TransportClient:
         version = _I64.unpack(resp[: _I64.size])[0]
         if version == have_version:  # identity match (see server comment)
             return None
+        self.stats["weight_pulls"] += 1
         return codec.decode(resp[_I64.size :], copy=True), version
 
     def remote_act(self, request: dict) -> dict:
@@ -785,6 +837,8 @@ def run_role(
         client.connect_retries = 3
         frames = 0
         down_since: float | None = None
+        stats_s = float(os.environ.get("DRL_TRANSPORT_STATS_S", "0"))
+        next_stats = time.monotonic() + stats_s
         try:
             while True:
                 try:
@@ -798,6 +852,14 @@ def run_role(
                               f"after {frames} frames; exiting")
                         return
                     time.sleep(1.0)
+                if stats_s > 0 and time.monotonic() >= next_stats:
+                    # Per-actor fairness/staleness record (scale demo):
+                    # machine-grepped as `[actor k] stats {...}` lines.
+                    next_stats = time.monotonic() + stats_s
+                    s = dict(client.stats)
+                    s["frames"] = frames
+                    s["weight_version"] = getattr(actor, "_version", None)
+                    print(f"[actor {task}] stats {s}", flush=True)
         finally:
             client.close()
     else:

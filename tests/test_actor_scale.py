@@ -1,0 +1,117 @@
+"""Producer fairness + backpressure under many concurrent actors
+(VERDICT r4 missing #2's suite-sized companion to the 20-process demo in
+`scripts/actor_scale_demo.py` / `benchmarks/actor_scale/`).
+
+8 TransportClient threads hammer one TransportServer's bounded queue
+while a consumer drains it at a fixed rate. Asserts the contended
+data plane's invariants rather than wall-clock numbers (this host has
+one core, so absolute rates are meaningless in-suite):
+
+- conservation: every unroll a client counts as sent is drained exactly
+  once — backpressure loses nothing and duplicates nothing;
+- fairness: every producer completes its full quota without error while
+  contending for the bounded queue;
+- backpressure: the queue pins at its capacity during the run;
+- stats: the server's accepted count matches the clients' sent counts.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+from distributed_reinforcement_learning_tpu.runtime.transport import (
+    TransportClient,
+    TransportServer,
+)
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def contended_server():
+    queue = TrajectoryQueue(capacity=16)
+    weights = WeightStore()
+    weights.publish({"w": np.zeros(4, np.float32)}, 0)
+    port = _free_port()
+    server = TransportServer(queue, weights, host="127.0.0.1", port=port).start()
+    yield queue, server, port
+    server.stop()
+
+
+def test_eight_producers_fairness_and_conservation(contended_server):
+    queue, server, port = contended_server
+    n_actors, batches_each, per_batch = 8, 30, 4
+    blob = {"state": np.zeros((4, 16), np.uint8), "r": np.float32(1.0)}
+    clients = []
+    errors = []
+
+    # Bounded supply so every producer thread verifiably EXITS before any
+    # assertion reads a counter (an open-ended hammer can still be mid-
+    # backpressure at join time and mutate counts during the asserts).
+    def producer(k: int, client: TransportClient) -> None:
+        try:
+            for _ in range(batches_each):
+                client.put_trajectories([blob] * per_batch)
+        except Exception as e:  # noqa: BLE001 — surfaced in the main thread
+            errors.append((k, e))
+
+    stop = threading.Event()
+    drained = 0
+    max_depth = 0
+
+    def consumer() -> None:
+        nonlocal drained, max_depth
+        while not stop.is_set():
+            max_depth = max(max_depth, len(queue))
+            got = queue.get(timeout=0.1)
+            if got is not None:
+                drained += 1
+            time.sleep(0.002)  # fixed-rate learner stand-in
+
+    consumer_t = threading.Thread(target=consumer, daemon=True)
+    producers = []
+    for k in range(n_actors):
+        c = TransportClient("127.0.0.1", port, busy_timeout=60.0)
+        clients.append(c)
+        producers.append(threading.Thread(target=producer, args=(k, c), daemon=True))
+    consumer_t.start()
+    for t in producers:
+        t.start()
+    for t in producers:
+        t.join(timeout=120.0)
+    assert not any(t.is_alive() for t in producers), "producer wedged"
+    stop.set()
+    consumer_t.join(timeout=5.0)
+    # Final drain of whatever the consumer left behind.
+    while queue.get(timeout=0.05) is not None:
+        drained += 1
+    for c in clients:
+        c.close()
+
+    assert not errors, errors
+    sent = [c.stats["unrolls_sent"] for c in clients]
+    total_sent = sum(sent)
+    assert total_sent == n_actors * batches_each * per_batch
+    # Conservation: accepted == sent == drained (queue fully drained).
+    assert server.stats["unrolls_accepted"] == total_sent
+    assert drained == total_sent, (drained, total_sent)
+    # Fairness here = equal bounded quotas all complete without error
+    # under contention (the wall-clock fairness of open-ended producers
+    # is the 20-process demo's job, benchmarks/actor_scale/).
+    assert sent == [batches_each * per_batch] * n_actors
+    # Backpressure was actually exercised: 960 unrolls through a 16-deep
+    # queue with a throttled consumer must pin the queue at its bound.
+    # (ST_BUSY / partial accepts stay 0 by design — the server's blocking
+    # enqueue absorbs contention as reply latency, not retry storms; the
+    # 20-actor demo shows the same signature.)
+    assert max_depth == 16, max_depth
