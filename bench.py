@@ -1571,6 +1571,47 @@ def main() -> None:
               file=sys.stderr)
         return False
 
+    # The budget gates SECTION STARTS; a section wedged inside a tunnel
+    # call (observed r5: a mid-bench tunnel wedge froze the process for
+    # 30+ min with the budget helpless, SIGINT queued behind the
+    # uninterruptible RPC) cannot be interrupted from Python. Past
+    # budget + 300 s grace, a watchdog thread force-finishes: emit the
+    # best measurement that landed and hard-exit, so the driver records
+    # a parsed line + rc 0 instead of rc 124. Started BEFORE the first
+    # tunnel-heavy section; `final_lock`/`finishing` serialize it
+    # against the normal final-emit paths (no interleaved stdout).
+    final_lock = threading.Lock()
+    finishing = threading.Event()
+
+    def _final_emit(value: float, ex: dict, **kw) -> None:
+        with final_lock:
+            finishing.set()
+            _emit(value, ex, **kw)
+
+    def _watchdog():
+        time.sleep(max(0.0, deadline + 300 - time.monotonic()))
+        with final_lock:
+            if finishing.is_set():
+                return  # normal completion beat us; let main finish
+            try:
+                snap = {**extra}
+                snap.setdefault("skipped_sections", list(skipped))
+                snap["watchdog"] = (
+                    "a section wedged past budget+300s (tunnel hang); "
+                    "force-emitted partial results")
+                ab = snap.get("anakin_breakout", {})
+                if isinstance(ab, dict) and ab.get("frames_per_s", 0) > 0:
+                    _emit(ab["frames_per_s"], snap,
+                          metric="anakin_breakout_env_frames_per_s")
+                else:
+                    _emit(0.0, {**snap,
+                                "error": "wedged before any measurement"})
+                sys.stdout.flush()
+            finally:
+                os._exit(0)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     # Headline section first (accelerator only — a conv learn step per
     # update on the 1-core host is minutes). On success, emit the parsed
     # headline NOW: even if the driver kills everything after this
@@ -1614,11 +1655,11 @@ def main() -> None:
             # clobbering the round's number with a 0.0 error line.
             extra["skipped_sections"] = skipped
             extra["error_learn_step"] = "no learn-step measurement landed"
-            _emit(ab_early["frames_per_s"], extra,
-                  metric="anakin_breakout_env_frames_per_s")
+            _final_emit(ab_early["frames_per_s"], extra,
+                        metric="anakin_breakout_env_frames_per_s")
             return
-        _emit(0.0, {**extra, "error": "no learn-step measurement landed",
-                    "phase": "learn_step", "skipped_sections": skipped})
+        _final_emit(0.0, {**extra, "error": "no learn-step measurement landed",
+                          "phase": "learn_step", "skipped_sections": skipped})
         return
     best = max(valid, key=lambda r: r["frames_per_s"])
 
@@ -1849,15 +1890,16 @@ def main() -> None:
         extra["learn_step_best_frames_per_s"] = best["frames_per_s"]
         if e2e_fps > 0:
             extra["host_loop_e2e_frames_per_s"] = e2e_fps
-        _emit(ab["frames_per_s"], extra,
-              metric="anakin_breakout_env_frames_per_s")
+        _final_emit(ab["frames_per_s"], extra,
+                    metric="anakin_breakout_env_frames_per_s")
     elif e2e_fps > 0:
         extra["learn_step_best_frames_per_s"] = best["frames_per_s"]
-        _emit(e2e_fps, extra)
+        _final_emit(e2e_fps, extra)
     else:
         # No pipeline measurement landed: fall back to the learn-step
         # headline under its own (honest) metric name.
-        _emit(best["frames_per_s"], extra, metric="impala_learn_env_frames_per_s")
+        _final_emit(best["frames_per_s"], extra,
+                    metric="impala_learn_env_frames_per_s")
 
 
 if __name__ == "__main__":
