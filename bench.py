@@ -1611,6 +1611,13 @@ def main() -> None:
                 os._exit(0)
 
     threading.Thread(target=_watchdog, daemon=True).start()
+    wedge_s = float(os.environ.get("BENCH_TEST_WEDGE_S", "0"))
+    if wedge_s > 0:
+        # Test hook (tests/test_bench_contract.py): simulate a section
+        # wedged in an uninterruptible device call so the watchdog path
+        # is actually exercised — there is no honest way to wedge a real
+        # tunnel on demand.
+        time.sleep(wedge_s)
 
     # Headline section first (accelerator only — a conv learn step per
     # update on the 1-core host is minutes). On success, emit the parsed
