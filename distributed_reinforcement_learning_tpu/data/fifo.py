@@ -22,6 +22,8 @@ from typing import Any
 
 import numpy as np
 
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+
 
 def stack_pytrees(items: list[Any]) -> Any:
     """Stack a list of identically-structured numpy pytrees along axis 0."""
@@ -94,8 +96,13 @@ class TrajectoryQueue:
             if self._closed:
                 raise RuntimeError("queue closed")
             self._items.append(item)
+            depth = len(self._items)
             self._not_empty.notify()
-            return True
+        # Telemetry outside the queue lock (the telemetry lock is a leaf).
+        if _OBS.enabled:
+            _OBS.count("fifo/puts")
+            _OBS.gauge("fifo/fill", depth / self.capacity)
+        return True
 
     def put_many(self, items: list[Any], timeout: float | None = None) -> int:
         """Enqueue a list of items; returns how many were accepted.
@@ -120,7 +127,9 @@ class TrajectoryQueue:
                 return None
             item = self._items.popleft()
             self._not_full.notify()
-            return item
+        if _OBS.enabled:
+            _OBS.count("fifo/gets")
+        return item
 
     def get_batch(self, batch_size: int, timeout: float | None = None) -> Any | None:
         """Dequeue `batch_size` items and stack them into `[B, ...]` arrays.

@@ -28,6 +28,7 @@ import numpy as np
 
 from distributed_reinforcement_learning_tpu.data import codec
 from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 
 _CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "cpp")
 _LIB_PATH = os.path.join(_CPP_DIR, "build", "libdistrl_native.so")
@@ -312,7 +313,14 @@ class NativeTrajectoryQueue:
     def put_bytes(self, blob: bytes, timeout: float | None = None) -> bool:
         if len(blob) > self._item_cap:
             self._item_cap = len(blob)
-        return self._q.put(blob, timeout)
+        ok = self._q.put(blob, timeout)
+        # Same fifo/* signals as the pure-Python TrajectoryQueue: the
+        # default deployment uses THIS queue (native_available()), and
+        # the transport server's raw path enters here via put_bytes.
+        if ok and _OBS.enabled:
+            _OBS.count("fifo/puts")
+            _OBS.gauge("fifo/fill", len(self._q) / self.capacity)
+        return ok
 
     def put_many(self, items: list[Any], timeout: float | None = None) -> int:
         return self.put_bytes_many([codec.encode(i) for i in items], timeout)
@@ -331,7 +339,11 @@ class NativeTrajectoryQueue:
 
     def get(self, timeout: float | None = None) -> Any | None:
         blob = self._q.get(timeout)
-        return None if blob is None else codec.decode(blob, copy=True)
+        if blob is None:
+            return None
+        if _OBS.enabled:
+            _OBS.count("fifo/gets")
+        return codec.decode(blob, copy=True)
 
     def _pooled_outputs(self, batch_size: int, metas: list[dict]) -> list[np.ndarray] | None:
         """Next rotation of reusable gather destinations, or None if the
@@ -391,6 +403,8 @@ class NativeTrajectoryQueue:
                                         scratch=scratch)
             if raw is None:
                 return None
+            if _OBS.enabled:
+                _OBS.count("fifo/gets", batch_size)
             buf, stride, lens = raw
             if have_scratch and len(buf) > len(self._scratch):
                 self._scratch = buf  # stride regrew inside the pop: keep it

@@ -20,6 +20,21 @@ from typing import Any
 
 import numpy as np
 
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+
+
+def _observe_replay(replay, inserted: int = 0, sampled: int = 0) -> None:
+    """Shared insert/sample-rate counters + fill-level gauge for every
+    replay backend (one instrumentation point, three implementations).
+    No-op (one attribute read) while telemetry is disabled."""
+    if not _OBS.enabled:
+        return
+    if inserted:
+        _OBS.count("replay/inserts", inserted)
+    if sampled:
+        _OBS.count("replay/samples", sampled)
+    _OBS.gauge("replay/fill", len(replay.tree) / replay.tree.capacity)
+
 
 class SumTree:
     """Array-backed binary sum tree over `capacity` leaf priorities."""
@@ -107,10 +122,14 @@ class PrioritizedReplay:
         return (abs(error) + self.EPS) ** self.ALPHA
 
     def add(self, error: float, sample: Any) -> int:
-        return self.tree.add(self._priority(error), sample)
+        idx = self.tree.add(self._priority(error), sample)
+        _observe_replay(self, inserted=1)
+        return idx
 
     def add_batch(self, errors: np.ndarray, samples: list[Any]) -> list[int]:
-        return [self.tree.add(self._priority(e), s) for e, s in zip(errors, samples)]
+        idxs = [self.tree.add(self._priority(e), s) for e, s in zip(errors, samples)]
+        _observe_replay(self, inserted=len(idxs))
+        return idxs
 
     def sample(self, n: int, rng: np.random.RandomState | None = None):
         rng = rng or np.random
@@ -139,6 +158,7 @@ class PrioritizedReplay:
         probs = priorities / self.tree.total
         weights = np.power(len(self.tree) * probs, -self.beta)
         weights /= weights.max()
+        _observe_replay(self, sampled=n)
         return items, idxs, weights.astype(np.float32)
 
     def update(self, idx: int, error: float) -> None:
@@ -252,11 +272,15 @@ class NativePrioritizedReplay:
             slots = self.tree.add_batch(self._priority(errors))
             for slot, s in zip(slots, samples):
                 self._data[slot] = s
-            return [int(s) + self.tree.capacity - 1 for s in slots]
+            idxs = [int(s) + self.tree.capacity - 1 for s in slots]
+        _observe_replay(self, inserted=len(idxs))
+        return idxs
 
     def sample(self, n: int, rng: np.random.RandomState | None = None):
         with self._lock:
-            return self._sample_locked(n, rng)
+            out = self._sample_locked(n, rng)
+        _observe_replay(self, sampled=n)
+        return out
 
     def _sample_locked(self, n: int, rng):
         rng = rng or np.random
@@ -358,7 +382,9 @@ class ArrayPrioritizedReplay:
             self._ensure_store(batch)
             slots = self.tree.add_batch(self._priority(errors))
             self._write(slots, batch)
-            return slots + self.tree.capacity - 1
+            idxs = slots + self.tree.capacity - 1
+        _observe_replay(self, inserted=len(idxs))
+        return idxs
 
     def add_batch(self, errors: np.ndarray, samples: list[Any]) -> list[int]:
         from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
@@ -383,8 +409,10 @@ class ArrayPrioritizedReplay:
                 is_written=lambda slots: slots < count)
             slots = idxs - (self.tree.capacity - 1)
             batch = jax.tree.map(lambda store: store[slots], self._store)
-            return batch, idxs, _is_weights(priorities, self.tree.total,
-                                            count, self.beta)
+            out = batch, idxs, _is_weights(priorities, self.tree.total,
+                                           count, self.beta)
+        _observe_replay(self, sampled=n)
+        return out
 
     def update(self, idx: int, error: float) -> None:
         self.update_batch(np.array([idx]), np.array([error]))

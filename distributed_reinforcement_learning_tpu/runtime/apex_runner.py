@@ -27,6 +27,7 @@ from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexBa
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
 from distributed_reinforcement_learning_tpu.data.replay import UniformBuffer, make_replay
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.replay_train import ReplayTrainMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
@@ -321,6 +322,8 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
                 td = np.asarray(self.agent.td_error(self.state, flat))
             self._replay_add(td, flat)
             self.ingested_unrolls += k
+            if _OBS.enabled:
+                _OBS.count("learner/ingested_unrolls", k)
             return done + k
 
     def _replay_add(self, td: np.ndarray, flat) -> None:
@@ -345,6 +348,8 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
             td = np.asarray(td_dev)
         self._replay_add(td, flat)
         self.ingested_unrolls += k
+        if _OBS.enabled:
+            _OBS.count("learner/ingested_unrolls", k)
         return k
 
     def train(self) -> dict | None:
@@ -373,6 +378,8 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
                 self.replay.update_batch(idxs, np.asarray(td))
         self._finish_train_call()
         metrics = {k: float(v) for k, v in metrics.items()}
+        if _OBS.enabled:
+            _OBS.count("learner/train_steps", self.updates_per_call)
         self.timer.step_done(self.train_steps)
         self._profiler.on_step(self.train_steps)
         self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)

@@ -27,6 +27,7 @@ from distributed_reinforcement_learning_tpu.agents.impala import ActOutput, Impa
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round, stack_pytrees
 from distributed_reinforcement_learning_tpu.data.structures import ImpalaTrajectoryAccumulator
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
@@ -282,6 +283,10 @@ class ImpalaLearner(PublishCadenceMixin):
                 self.state, metrics = self._learn(self.state, batch)
         self.train_steps += steps_done
         self.frames_learned += steps_done * self.batch_size * self.agent.cfg.trajectory
+        if _OBS.enabled:  # run-wide telemetry (off = one attribute read)
+            _OBS.count("learner/train_steps", steps_done)
+            _OBS.count("learner/frames_learned",
+                       steps_done * self.batch_size * self.agent.cfg.trajectory)
         if self.maybe_publish():
             # Sync publish is this step's device sync (so "learn" above
             # measured dispatch, "publish" compute+D2H, and the float()

@@ -11,6 +11,7 @@ runtime/transport.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import jax
@@ -24,6 +25,8 @@ from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
 from distributed_reinforcement_learning_tpu.envs.batched import BatchedEnv
 from distributed_reinforcement_learning_tpu.envs.cartpole import pomdp_project
 from distributed_reinforcement_learning_tpu.envs.registry import make_env
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+from distributed_reinforcement_learning_tpu.observability import maybe_configure
 from distributed_reinforcement_learning_tpu.runtime import (
     apex_runner,
     impala_runner,
@@ -282,7 +285,8 @@ def _restore_train(checkpoint_dir, train):
 
 def train_anakin(config_path: str, section: str, num_updates: int,
                  chunk: int = 50, seed: int = 0, num_envs: int | None = None,
-                 checkpoint_dir: str | None = None) -> dict:
+                 checkpoint_dir: str | None = None,
+                 run_dir: str | None = None) -> dict:
     """Fully on-device IMPALA training (runtime/anakin.py): jittable-env
     sections only (CartPole-family). Collect + learn run as compiled
     chunks of `chunk` updates; per-chunk mean episode returns stream to
@@ -306,11 +310,22 @@ def train_anakin(config_path: str, section: str, num_updates: int,
     state = state._replace(train=train)
     chunk = max(1, min(chunk, num_updates))
     returns = []
+    maybe_configure("anakin", 0, run_dir)  # env-gated run-wide telemetry
+    frames_per_update = anakin.num_envs * agent_cfg.trajectory
     while int(state.train.step) < num_updates:
         u = min(chunk, num_updates - int(state.train.step))
+        t0 = time.perf_counter()
         state, m = anakin.train_chunk(state, u)
         eps = float(np.asarray(m["episodes_done"]).sum())
         mean_ret = float(np.asarray(m["episode_return_sum"]).sum()) / max(eps, 1.0)
+        # The float() reads above are the chunk's device sync, so dt is
+        # honest device time for the whole compiled chunk.
+        dt = time.perf_counter() - t0
+        if _OBS.enabled:
+            _OBS.count("anakin/updates", u)
+            _OBS.gauge("anakin/device_chunk_s", dt)
+            _OBS.gauge("anakin/steps_per_s", u / dt)
+            _OBS.gauge("anakin/frames_per_s", u * frames_per_update / dt)
         returns.append(mean_ret)
         print(f"[anakin] step {int(state.train.step)}: mean_return {mean_ret:.1f} "
               f"({eps:.0f} episodes, loss {float(m['total_loss'][-1]):.2f})")
@@ -324,7 +339,8 @@ def train_anakin(config_path: str, section: str, num_updates: int,
 
 
 def _replay_chunk_loop(anakin, state, num_updates: int, chunk: int, ckpt,
-                       label: str, frames_per_collect: int, warm: int) -> dict:
+                       label: str, frames_per_collect: int, warm: int,
+                       run_dir: str | None = None) -> dict:
     """Shared warm-up + chunked train loop for the on-device replay
     families (AnakinR2D2 / AnakinApex — same train_chunk/metrics
     contract). `num_updates` counts OPTIMIZER steps; each chunk update is
@@ -337,13 +353,21 @@ def _replay_chunk_loop(anakin, state, num_updates: int, chunk: int, ckpt,
     K = anakin.updates_per_collect
     collects = warm
     returns = []
+    maybe_configure(label, 0, run_dir)  # env-gated run-wide telemetry
     while int(state.train.step) < num_updates:
         remaining_steps = num_updates - int(state.train.step)
         u = max(1, min(chunk, -(-remaining_steps // K)))
+        t0 = time.perf_counter()
         state, m = anakin.train_chunk(state, u)
         collects += u
         eps = float(np.asarray(m["episodes_done"]).sum())
         mean_ret = float(np.asarray(m["episode_return_sum"]).sum()) / max(eps, 1.0)
+        dt = time.perf_counter() - t0  # float() reads above = device sync
+        if _OBS.enabled:
+            _OBS.count("anakin/updates", u * K)
+            _OBS.gauge("anakin/device_chunk_s", dt)
+            _OBS.gauge("anakin/steps_per_s", u * K / dt)
+            _OBS.gauge("anakin/frames_per_s", u * frames_per_collect / dt)
         returns.append(mean_ret)
         print(f"[{label}] step {int(state.train.step)}: mean_return "
               f"{mean_ret:.1f} ({eps:.0f} episodes, loss "
@@ -361,7 +385,8 @@ def train_anakin_apex(config_path: str, section: str, num_updates: int,
                       chunk: int = 50, seed: int = 0,
                       num_envs: int | None = None,
                       capacity: int | None = None,
-                      checkpoint_dir: str | None = None) -> dict:
+                      checkpoint_dir: str | None = None,
+                      run_dir: str | None = None) -> dict:
     """Fully on-device Ape-X (runtime/anakin_apex.py): transition
     collection, the prioritized ring, double-DQN training, and target
     syncs inside compiled chunks. With a pixel section this trains the
@@ -395,14 +420,15 @@ def train_anakin_apex(config_path: str, section: str, num_updates: int,
     state = state._replace(train=train)
     warm = -(-rt.train_start_factor * rt.batch_size // width)
     return _replay_chunk_loop(anakin, state, num_updates, chunk, ckpt,
-                              "anakin-apex", width, warm)
+                              "anakin-apex", width, warm, run_dir=run_dir)
 
 
 def train_anakin_r2d2(config_path: str, section: str, num_updates: int,
                       chunk: int = 50, seed: int = 0,
                       num_envs: int | None = None,
                       capacity: int | None = None,
-                      checkpoint_dir: str | None = None) -> dict:
+                      checkpoint_dir: str | None = None,
+                      run_dir: str | None = None) -> dict:
     """Fully on-device R2D2 (runtime/anakin_r2d2.py): collect, the
     prioritized replay ring, and training all inside compiled chunks.
     Jittable envs only (CartPole-family sections via the POMDP
@@ -433,7 +459,8 @@ def train_anakin_r2d2(config_path: str, section: str, num_updates: int,
     # sequences) expressed as explicit collect-only chunks.
     warm = -(-rt.train_start_factor * rt.batch_size // n)
     return _replay_chunk_loop(anakin, state, num_updates, chunk, ckpt,
-                              "anakin-r2d2", n * agent_cfg.seq_len, warm)
+                              "anakin-r2d2", n * agent_cfg.seq_len, warm,
+                              run_dir=run_dir)
 
 
 def train_local(config_path: str, section: str, num_updates: int,
@@ -448,6 +475,7 @@ def train_local(config_path: str, section: str, num_updates: int,
     calls compose; actor episode returns persist across chunks)."""
     agent_cfg, rt = load_config(config_path, section)
     learner, actors, run_fn = build_local(agent_cfg, rt, run_dir=run_dir, seed=seed)
+    maybe_configure("local", 0, run_dir)  # env-gated run-wide telemetry
     checkpoint_interval = max(1, int(checkpoint_interval))  # 0 would spin forever
     ckpt = None
     if checkpoint_dir:
@@ -472,6 +500,7 @@ def train_local(config_path: str, section: str, num_updates: int,
                 learner.save_checkpoint(ckpt)
     finally:
         learner.close()
+        _OBS.close()  # final shard flush + trace terminator
     if "frames" in result:
         result["frames"] = frames
     returns = result.get("episode_returns", [])

@@ -12,6 +12,9 @@ from __future__ import annotations
 import os
 import queue as _queue
 import threading
+import time
+
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 
 
 def _async_publish(sync_default: bool) -> bool:
@@ -118,7 +121,8 @@ class PublishCadenceMixin:
         if self.train_steps - self._last_publish_step < self.publish_interval:
             return False
         self._last_publish_step = self.train_steps
-        with self.timer.stage("publish"):
+        t0 = time.perf_counter()  # unconditional: telemetry enablement can
+        with self.timer.stage("publish"):  # race the post-publish check
             if _async_publish(self.sync_publish):
                 # Sub-stages so a fat `publish` mean is attributable: the
                 # handoff (device-side copy dispatch) vs the bounded-
@@ -144,6 +148,12 @@ class PublishCadenceMixin:
                               file=sys.stderr)
             else:
                 self.weights.publish(self.state.params, self.train_steps)
+        if _OBS.enabled:
+            # Learn-thread cost of publication (async: handoff + any
+            # bounded-staleness stall; sync: the full D2H). The landed
+            # version's timeline is the weights/version gauge.
+            _OBS.gauge("publish/latency_ms", (time.perf_counter() - t0) * 1e3)
+            _OBS.count("publish/count")
         return True
 
     def flush_publish(self) -> None:

@@ -26,6 +26,7 @@ from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, st
 from distributed_reinforcement_learning_tpu.data.replay import make_replay
 from distributed_reinforcement_learning_tpu.data.structures import R2D2SequenceAccumulator
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.replay_train import ReplayTrainMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
@@ -311,6 +312,8 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
                 if self.recent_fraction > 0:
                     self._recent.extend(zip(new_idxs, seqs))
         self.ingested_sequences += n
+        if _OBS.enabled:
+            _OBS.count("learner/ingested_sequences", n)
         return n
 
     def _mix_recent(self, items, idxs, is_weight):
@@ -357,6 +360,8 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
                 self.replay.update_batch(idxs, np.asarray(priorities))
         self._finish_train_call()
         metrics = {k: float(v) for k, v in metrics.items()}
+        if _OBS.enabled:
+            _OBS.count("learner/train_steps", self.updates_per_call)
         self.timer.step_done(self.train_steps)
         self._profiler.on_step(self.train_steps)
         self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)

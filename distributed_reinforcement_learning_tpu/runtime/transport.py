@@ -32,6 +32,9 @@ from typing import Any
 import numpy as np
 
 from distributed_reinforcement_learning_tpu.data import codec
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+from distributed_reinforcement_learning_tpu.observability import maybe_configure
+from distributed_reinforcement_learning_tpu.observability.metrics import stale_bucket
 
 OP_PUT_TRAJ = 1
 OP_GET_WEIGHTS = 2
@@ -310,15 +313,25 @@ class TransportServer:
         deadline = time.monotonic() + total_wait
         raw = hasattr(self.queue, "put_bytes")
         item = payload if raw else codec.decode(payload, copy=True)
-        while not self._stop.is_set():
-            slice_t = min(0.5, deadline - time.monotonic())
-            if slice_t <= 0:
-                return False
-            ok = self.queue.put_bytes(item, timeout=slice_t) if raw else \
-                self.queue.put(item, timeout=slice_t)
-            if ok:
-                return True
-        return False
+        # Timed region = the put loop ONLY (decode above is excluded):
+        # this gauge quantifies backpressure, and conflating it with
+        # deserialization cost would corrupt the ring-vs-socket decision
+        # it exists to inform (ROADMAP open items).
+        t0 = time.perf_counter()
+        try:
+            while not self._stop.is_set():
+                slice_t = min(0.5, deadline - time.monotonic())
+                if slice_t <= 0:
+                    return False
+                ok = self.queue.put_bytes(item, timeout=slice_t) if raw else \
+                    self.queue.put(item, timeout=slice_t)
+                if ok:
+                    return True
+            return False
+        finally:
+            if _OBS.enabled:
+                _OBS.gauge("transport/enqueue_wait_ms",
+                           (time.perf_counter() - t0) * 1e3)
 
     def _enqueue_many(self, payload: bytes, total_wait: float = 30.0
                       ) -> tuple[int, int]:
@@ -332,6 +345,10 @@ class TransportServer:
         for blob in blobs:
             item = blob if raw else codec.decode(blob, copy=True)
             ok = False
+            # Per-BLOB wait, same unit as _enqueue's single-PUT gauge
+            # (decode above excluded): summing K blobs into one
+            # observation would inflate batched runs' stats ~K×.
+            t0 = time.perf_counter()
             while not self._stop.is_set():
                 slice_t = min(0.5, deadline - time.monotonic())
                 if slice_t <= 0:
@@ -340,13 +357,41 @@ class TransportServer:
                     self.queue.put(item, timeout=slice_t)
                 if ok:
                     break
+            if _OBS.enabled:
+                _OBS.gauge("transport/enqueue_wait_ms",
+                           (time.perf_counter() - t0) * 1e3)
             if not ok:
                 break
             accepted += 1
         return accepted, len(blobs)
 
+    def _observe_put(self, accepted: int, conn_version: int) -> None:
+        """Weight-staleness at queue ingest — learner's current version
+        minus the version this connection last confirmed holding (the
+        actor's pull and its PUTs share one socket, so no wire-format
+        change is needed to attribute staleness per actor). Weighted by
+        `accepted` so a batched PUT's K unrolls count as K observations.
+        A LOWER BOUND on staleness at train time: the unroll still has
+        its queue residency ahead of it, during which more versions may
+        publish. (Enqueue-wait is gauged inside _enqueue/_enqueue_many,
+        timing the put loop only; accepted-unroll throughput comes from
+        the server.stats provider run_role registers.)"""
+        if accepted > 0 and conn_version >= 0:
+            staleness = max(self.weights.version - conn_version, 0)
+            _OBS.gauge("learner/weight_staleness", staleness, weight=accepted)
+            # Exact histogram: bucketed at OBSERVATION time. The gauge's
+            # per-window means would average a rare staleness-16 stall
+            # into the window's bulk of zeros and hide the tail the
+            # histogram exists to reveal.
+            _OBS.count(f"staleness_bucket/{stale_bucket(staleness)}",
+                       accepted)
+
     def _serve_inner(self, conn: socket.socket) -> None:
         rbuf = _ConnRecvBuf()  # reused across this connection's requests
+        # Newest weight version this peer confirmed holding (via
+        # GET_WEIGHTS on this same connection); -1 = never pulled
+        # (e.g. remote_act actors), for which staleness is undefined.
+        conn_version = -1
         while not self._stop.is_set():
             try:
                 op, payload = rbuf.recv_msg(conn)
@@ -359,6 +404,8 @@ class TransportServer:
                     # buffer_queue.py:398-414).
                     ok = self._enqueue(payload)
                     self._bump("unrolls_accepted" if ok else "busy_replies")
+                    if _OBS.enabled:
+                        self._observe_put(1 if ok else 0, conn_version)
                     _send_msg(conn, ST_OK if ok else ST_BUSY)
                 elif op == OP_PUT_TRAJ_N:
                     # The batched PUT: K unrolls in one round trip. The
@@ -369,6 +416,8 @@ class TransportServer:
                     self._bump("unrolls_accepted", accepted)
                     if accepted < n_in:
                         self._bump("partial_accepts")
+                    if _OBS.enabled:
+                        self._observe_put(accepted, conn_version)
                     _send_msg(conn, ST_OK, _I64.pack(accepted))
                 elif op == OP_GET_WEIGHTS:
                     # Versions are snapshot IDENTITIES across the wire,
@@ -379,9 +428,11 @@ class TransportServer:
                     have = _I64.unpack(payload)[0]
                     version, blob = self._weights_blob()
                     if version == have or version < 0:
+                        conn_version = have
                         _send_msg(conn, ST_OK, _I64.pack(have))
                     else:
                         self._bump("weight_sends")
+                        conn_version = version
                         _send_msg(conn, ST_OK, _I64.pack(version), blob)
                 elif op == OP_ACT:
                     # Own RuntimeError handling: an inference failure (e.g.
@@ -565,8 +616,12 @@ class TransportClient:
         return sent
 
     def get_weights_if_newer(self, have_version: int) -> tuple[Any, int] | None:
-        resp = self._call(OP_GET_WEIGHTS, _I64.pack(have_version))
+        t0 = time.perf_counter()  # unconditional: enablement can race the
+        resp = self._call(OP_GET_WEIGHTS, _I64.pack(have_version))  # check below
         version = _I64.unpack(resp[: _I64.size])[0]
+        if _OBS.enabled:
+            _OBS.gauge("actor/weight_pull_ms", (time.perf_counter() - t0) * 1e3)
+            _OBS.gauge("actor/weight_version", version)
         if version == have_version:  # identity match (see server comment)
             return None
         self.stats["weight_pulls"] += 1
@@ -790,6 +845,20 @@ def run_role(
         serve_port = rt.server_port + (jax.process_index() if multihost else 0)
         server = TransportServer(queue, weights, host="0.0.0.0", port=serve_port,
                                  inference=inference).start()
+        # Run-wide telemetry (observability/): env-gated, off by default.
+        # The data-plane signals the paper's argument turns on — queue
+        # depth, weight version — are polled per flush, never on the
+        # learn thread's hot path.
+        if maybe_configure("learner",
+                           jax.process_index() if multihost else 0, run_dir):
+            _OBS.sample("transport/queue_depth", queue.size)
+            _OBS.sample("learner/weight_version", lambda: weights.version)
+            # The server's cumulative stats (unrolls_accepted,
+            # busy_replies, weight_sends, ...) become report throughput
+            # via counter providers — no second hot-path counter.
+            for key in server.stats:
+                _OBS.sample(f"transport/{key}",
+                            lambda k=key: server.stats[k], kind="counter")
         print(f"[learner] serving on :{serve_port}; training {num_updates} updates")
         try:
             _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
@@ -801,6 +870,7 @@ def run_role(
             server.stop()
             if inference is not None:
                 inference.stop()
+            _OBS.close()  # final shard flush + trace terminator
         print(f"[learner] done: {learner.train_steps} updates")
     elif mode == "actor":
         if task < 0:
@@ -826,6 +896,16 @@ def run_role(
             seed=seed + 1 + task,
             remote_act=RemoteInference(client) if remote_act else None,
         )
+        # Per-actor telemetry shard (observability/): this is the half of
+        # the topology the old MetricsLogger never covered (actors log
+        # nothing). The client's cumulative stats become per-flush
+        # timelines via providers — zero cost on the act/step path.
+        if maybe_configure("actor", task, run_dir):
+            for key in client.stats:
+                _OBS.sample(f"actor/{key}", lambda k=key: client.stats[k],
+                            kind="counter")
+            _OBS.sample("actor/weight_version_held",
+                        lambda: getattr(actor, "_version", -1))
         print(f"[actor {task}] connected to {server_ip}:{port}")
         # Elastic recovery (SURVEY §5.3 — the reference had none: a dead
         # learner left actors blocked forever): on transport failure the
@@ -842,7 +922,15 @@ def run_role(
         try:
             while True:
                 try:
-                    frames += _actor_round(algo, actor)
+                    t0 = time.perf_counter()
+                    with _OBS.span("actor_round"):
+                        got = _actor_round(algo, actor)
+                    frames += got
+                    if _OBS.enabled:
+                        dt = time.perf_counter() - t0
+                        _OBS.count("actor/env_frames", got)
+                        if dt > 0:
+                            _OBS.gauge("actor/env_steps_per_s", got / dt)
                     down_since = None
                 except (TransportError, OSError):  # incl. socket timeouts
                     now = time.time()
@@ -862,6 +950,7 @@ def run_role(
                     print(f"[actor {task}] stats {s}", flush=True)
         finally:
             client.close()
+            _OBS.close()  # final shard flush + trace terminator
     else:
         raise ValueError(f"unknown mode {mode!r}")
 

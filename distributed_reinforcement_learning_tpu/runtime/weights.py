@@ -21,6 +21,8 @@ from typing import Any
 import jax
 import numpy as np
 
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+
 
 class WeightStore:
     def __init__(self):
@@ -49,10 +51,14 @@ class WeightStore:
 
     def _apply(self, host_params: Any, version: int, seq: int) -> None:
         with self._lock:
-            if seq >= self._applied_seq:
+            applied = seq >= self._applied_seq
+            if applied:
                 self._params = host_params
                 self._version = version
                 self._applied_seq = seq
+        # Version-landed timeline (telemetry off = one attribute read).
+        if applied and _OBS.enabled:
+            _OBS.gauge("weights/version", version)
 
     def publish(self, params: Any, version: int) -> None:
         """Store a host-side snapshot of `params` (device arrays -> numpy)."""

@@ -9,7 +9,12 @@ profiling is first-class:
   `profile/<stage>_ms` means every `log_every` steps. This splits "the
   step took 40ms" into queue-wait vs device-compute vs weight-publication
   — the split that tells you whether the data plane or the chip is the
-  bottleneck (SURVEY §7 hard part (a)).
+  bottleneck (SURVEY §7 hard part (a)). When the run-wide telemetry is
+  enabled (observability/), every stage invocation additionally becomes
+  a span on the process's Chrome-trace timeline — the TIMELINE the means
+  cannot show (one 400 ms publish stall vs "publish averaged 3 ms") —
+  and each flush mirrors the stage means as `stage/<name>_ms` gauges
+  into the telemetry shard.
 - `ProfilerSession`: captures a real `jax.profiler` device trace (XLA op
   timeline, viewable in TensorBoard/Perfetto) for a configured window of
   train steps. Enabled via env vars so any launcher/run picks it up:
@@ -23,6 +28,7 @@ import os
 import time
 from typing import Iterator
 
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
 
 
@@ -57,12 +63,19 @@ class StageTimer:
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
+        # Trace handle read once: disabled telemetry costs one attribute
+        # load here, no wall-clock read, no allocation.
+        trace = _OBS.trace
+        wall = time.time() if trace is not None else 0.0
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self._sums[name] = self._sums.get(name, 0.0) + (time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._sums[name] = self._sums.get(name, 0.0) + dt
             self._counts[name] = self._counts.get(name, 0) + 1
+            if trace is not None:
+                trace.emit(name, wall, dt)
 
     def step_done(self, step: int) -> None:
         """Mark one train step; every `log_every` steps emit + reset means.
@@ -83,6 +96,9 @@ class StageTimer:
                 {f"{self.prefix}{n}_ms": ms for n, ms in self.last_means_ms.items()},
                 step,
             )
+        if _OBS.enabled:
+            for name, ms in self.last_means_ms.items():
+                _OBS.gauge(f"stage/{name}_ms", ms)
         self._sums.clear()
         self._counts.clear()
         self._steps = 0

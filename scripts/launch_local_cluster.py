@@ -56,6 +56,11 @@ def main() -> None:
     p.add_argument("--learners", type=int, default=1,
                    help=">1: multihost learner processes over one global mesh")
     p.add_argument("--updates", type=int, default=500)
+    p.add_argument("--run_dir", default=None,
+                   help="run directory: the learner's metrics.jsonl plus "
+                        "run-wide telemetry shards from EVERY process "
+                        "(<run_dir>/telemetry/<role>-<rank>.jsonl + Chrome "
+                        "traces; merge with scripts/obs_report.py)")
     p.add_argument("--checkpoint_dir", default=None)
     p.add_argument("--platform", default=None,
                    help="force a jax platform for the LEARNER (actors are cpu)")
@@ -89,6 +94,8 @@ def main() -> None:
     base = [sys.executable, launcher, "--config", args.config,
             "--section", args.section]
     learner_cmd = base + ["--mode", "learner", "--updates", str(args.updates)]
+    if args.run_dir:
+        learner_cmd += ["--run_dir", args.run_dir]
     if args.checkpoint_dir:
         learner_cmd += ["--checkpoint_dir", args.checkpoint_dir]
     if args.platform:
@@ -97,6 +104,13 @@ def main() -> None:
         learner_cmd += ["--serve_inference"]
 
     env = dict(os.environ)
+    if args.run_dir:
+        # Enable run-wide telemetry in every child (actors included):
+        # each process writes its own shard + Chrome trace under here.
+        # Explicit --run_dir WINS over an inherited DRL_TELEMETRY_DIR —
+        # a stale export must not silently divert this run's shards.
+        env["DRL_TELEMETRY_DIR"] = os.path.join(
+            os.path.abspath(args.run_dir), "telemetry")
     learners = []
     if args.learners > 1:
         env["DRL_COORDINATOR"] = f"localhost:{_free_port()}"

@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""Merge one run's telemetry shards into a human-readable report + trace.
+
+Every process of a topology writes its own shard
+(`telemetry/<role>-<rank>.jsonl`) and host-span timeline
+(`telemetry/trace-<role>-<rank>.json`) — see
+`distributed_reinforcement_learning_tpu/observability/`. This CLI is the
+read side: point it at the run directory (or the telemetry directory
+itself) and it prints
+
+- per-role throughput (counter deltas over the shard's time span),
+- per-stage host latencies (p50/p99 over the trace spans),
+- the queue-depth timeline (min/mean/max + an ASCII strip),
+- publish latency and weight-version staleness statistics,
+
+and writes `trace-merged.json`: all roles' spans on one wall-clock axis
+(processes get distinct track labels), loadable in Perfetto
+(ui.perfetto.dev) or chrome://tracing.
+
+    python scripts/obs_report.py /tmp/run
+    python scripts/obs_report.py /tmp/run --no-merge
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_reinforcement_learning_tpu.observability.metrics import (
+    STALENESS_BUCKET_NAMES,
+    STALENESS_BUCKETS,
+)
+from distributed_reinforcement_learning_tpu.observability.trace import load_trace
+
+_SPARK = " .:-=+*#%@"
+
+
+def shard_paths(tdir: str) -> list[str]:
+    """Only `<role>-<rank>.jsonl` files: a run_dir's metrics.jsonl (the
+    MetricsLogger stream) must not be misread as a telemetry shard."""
+    return sorted(p for p in glob.glob(os.path.join(tdir, "*.jsonl"))
+                  if re.match(r".+-\d+\.jsonl$", os.path.basename(p)))
+
+
+def find_telemetry_dir(run_dir: str) -> str:
+    for cand in (os.path.join(run_dir, "telemetry"), run_dir):
+        if shard_paths(cand):
+            return cand
+    raise SystemExit(f"no telemetry shards (<role>-<rank>.jsonl) under "
+                     f"{run_dir} — was the run launched with telemetry "
+                     f"enabled (--run_dir / DRL_TELEMETRY_DIR)?")
+
+
+def read_shard(path: str) -> dict:
+    """-> {"role", "rank", "records"} from one `<role>-<rank>.jsonl`."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn final line of a killed process
+    meta = next((r for r in records if r.get("kind") == "meta"), {})
+    m = re.match(r"(.+)-(\d+)\.jsonl$", os.path.basename(path))
+    role = meta.get("role") or (m.group(1) if m else "proc")
+    rank = meta.get("rank", int(m.group(2)) if m else 0)
+    return {"role": role, "rank": rank, "records": records}
+
+
+def shard_label(shard: dict) -> str:
+    return f"{shard['role']}-{shard['rank']}"
+
+
+def counter_rates(shard: dict) -> dict[str, dict]:
+    """Per counter: total (last cumulative value) and rate over the
+    counter's own first->last flush window."""
+    seen: dict[str, list] = {}
+    for r in shard["records"]:
+        if r.get("kind") != "counter":
+            continue
+        seen.setdefault(r["name"], []).append((r["t"], r["value"]))
+    out = {}
+    for name, points in seen.items():
+        t0, v0 = points[0]
+        t1, v1 = points[-1]
+        out[name] = {
+            "total": v1,
+            "rate": (v1 - v0) / (t1 - t0) if t1 > t0 else 0.0,
+        }
+    return out
+
+
+def gauge_series(shard: dict, name: str) -> list[dict]:
+    return [r for r in shard["records"]
+            if r.get("kind") == "gauge" and r.get("name") == name]
+
+
+def gauge_stats(series: list[dict]) -> dict | None:
+    """Weighted aggregate over gauge flush windows."""
+    n = sum(r["n"] for r in series)
+    if not n:
+        return None
+    return {
+        "n": n,
+        "mean": sum(r["mean"] * r["n"] for r in series) / n,
+        "min": min(r["min"] for r in series),
+        "max": max(r["max"] for r in series),
+        "last": series[-1]["last"],
+    }
+
+
+def sparkline(series: list[dict], width: int = 60) -> str:
+    """ASCII strip of a gauge timeline (bucketed means, scaled to max)."""
+    if not series:
+        return ""
+    values = [r["mean"] for r in series]
+    if len(values) > width:
+        per = len(values) / width
+        values = [
+            sum(values[int(i * per):max(int((i + 1) * per), int(i * per) + 1)])
+            / max(len(values[int(i * per):max(int((i + 1) * per), int(i * per) + 1)]), 1)
+            for i in range(width)
+        ]
+    hi = max(values) or 1.0
+    return "".join(_SPARK[min(int(v / hi * (len(_SPARK) - 1) + 0.5),
+                              len(_SPARK) - 1)] for v in values)
+
+
+def percentile(sorted_values: list[float], q: float) -> float:
+    if not sorted_values:
+        return 0.0
+    idx = min(int(q * (len(sorted_values) - 1) + 0.5), len(sorted_values) - 1)
+    return sorted_values[idx]
+
+
+def stage_latencies(tdir: str) -> list[dict]:
+    """Per (process, span-name) p50/p99 from every trace shard."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(tdir, "trace-*.json"))):
+        if os.path.basename(path) == "trace-merged.json":
+            continue
+        label = re.sub(r"^trace-|\.json$", "", os.path.basename(path))
+        spans: dict[str, list[float]] = {}
+        for event in load_trace(path):
+            if event.get("ph") != "X":
+                continue
+            spans.setdefault(event["name"], []).append(event.get("dur", 0.0) / 1e3)
+        for name, durs in sorted(spans.items()):
+            durs.sort()
+            rows.append({
+                "proc": label, "stage": name, "count": len(durs),
+                "p50_ms": percentile(durs, 0.50),
+                "p99_ms": percentile(durs, 0.99),
+                "total_s": sum(durs) / 1e3,
+            })
+    return rows
+
+
+def merge_traces(tdir: str, out_path: str) -> int:
+    """One Chrome trace with every process on its own labeled track."""
+    events: list[dict] = []
+    for pid, path in enumerate(sorted(glob.glob(os.path.join(tdir, "trace-*.json")))):
+        if os.path.basename(path) == "trace-merged.json":
+            continue
+        label = re.sub(r"^trace-|\.json$", "", os.path.basename(path))
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+        for event in load_trace(path):
+            if event.get("ph") == "M" and event.get("name") == "process_name":
+                continue  # replaced by the merged labels above
+            event = dict(event)
+            event["pid"] = pid
+            events.append(event)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return sum(1 for e in events if e.get("ph") == "X")
+
+
+def staleness_buckets_exact(shard: dict) -> list[tuple[str, int]]:
+    """Exact histogram from the observation-time `staleness_bucket/*`
+    counters the transport server maintains (preferred: per-window gauge
+    means would average a rare stall into the window's bulk and hide the
+    tail). Edges shared with the write side via observability.metrics."""
+    rates = counter_rates(shard)
+    return [(name, int(rates[f"staleness_bucket/{name}"]["total"]))
+            for name in STALENESS_BUCKET_NAMES
+            if rates.get(f"staleness_bucket/{name}", {}).get("total")]
+
+
+def staleness_histogram(series: list[dict]) -> list[tuple[str, int]]:
+    """Fallback bucketing from gauge windows (window means, weighted by
+    each window's observation count) for shards predating the exact
+    counters."""
+    edges = list(STALENESS_BUCKETS) + [(float("inf"), ">16")]
+    counts = [0] * len(edges)
+    for r in series:
+        value = r["mean"]
+        for i, (edge, _) in enumerate(edges):
+            if value <= edge:
+                counts[i] += r["n"]
+                break
+    return [(name, c) for (_, name), c in zip(edges, counts) if c]
+
+
+def build_report(tdir: str, merge: bool = True) -> str:
+    shards = [read_shard(p) for p in shard_paths(tdir)]
+    shards = [s for s in shards if s["records"]]
+    if not shards:
+        raise SystemExit(f"no readable telemetry records under {tdir}")
+    lines: list[str] = []
+    out = lines.append
+    times = [r["t"] for s in shards for r in s["records"] if "t" in r]
+    out("== Telemetry report ==")
+    out(f"run: {tdir}")
+    out(f"processes: {', '.join(shard_label(s) for s in shards)}")
+    if times:
+        out(f"span: {max(times) - min(times):.1f}s of telemetry")
+
+    out("")
+    out("-- Throughput (counters) --")
+    any_counter = False
+    for shard in shards:
+        for name, stats in sorted(counter_rates(shard).items()):
+            if name.startswith("staleness_bucket/"):
+                continue  # rendered as the staleness histogram below
+            any_counter = True
+            out(f"  {shard_label(shard):<14} {name:<28} "
+                f"total {stats['total']:>12.0f}   {stats['rate']:>10.1f}/s")
+    if not any_counter:
+        out("  (no counters recorded)")
+
+    out("")
+    out("-- Host stage latencies (trace spans) --")
+    rows = stage_latencies(tdir)
+    if rows:
+        out(f"  {'process':<14} {'stage':<20} {'count':>7} "
+            f"{'p50_ms':>9} {'p99_ms':>9} {'total_s':>9}")
+        for r in rows:
+            out(f"  {r['proc']:<14} {r['stage']:<20} {r['count']:>7} "
+                f"{r['p50_ms']:>9.2f} {r['p99_ms']:>9.2f} {r['total_s']:>9.2f}")
+    else:
+        out("  (no trace spans recorded)")
+
+    out("")
+    out("-- Queue depth (learner transport) --")
+    any_depth = False
+    for shard in shards:
+        series = gauge_series(shard, "transport/queue_depth")
+        stats = gauge_stats(series)
+        if stats is None:
+            continue
+        any_depth = True
+        out(f"  {shard_label(shard)}: min {stats['min']:.0f}  "
+            f"mean {stats['mean']:.1f}  max {stats['max']:.0f}  "
+            f"last {stats['last']:.0f}")
+        out(f"    [{sparkline(series)}]")
+    if not any_depth:
+        out("  (no queue-depth samples)")
+
+    out("")
+    out("-- Weight publication --")
+    any_pub = False
+    for shard in shards:
+        stats = gauge_stats(gauge_series(shard, "publish/latency_ms"))
+        if stats is None:
+            continue
+        any_pub = True
+        out(f"  {shard_label(shard)}: publish latency mean "
+            f"{stats['mean']:.2f}ms  max {stats['max']:.2f}ms  "
+            f"({stats['n']} publishes)")
+    for shard in shards:
+        stats = gauge_stats(gauge_series(shard, "actor/weight_pull_ms"))
+        if stats is not None:
+            any_pub = True
+            out(f"  {shard_label(shard)}: weight pull mean "
+                f"{stats['mean']:.2f}ms  max {stats['max']:.2f}ms  "
+                f"({stats['n']} pulls)")
+    if not any_pub:
+        out("  (no publish/pull gauges)")
+
+    out("")
+    out("-- Weight staleness (learner version - actor version at queue "
+        "ingest; lower bound on staleness at train time) --")
+    any_stale = False
+    for shard in shards:
+        series = gauge_series(shard, "learner/weight_staleness")
+        stats = gauge_stats(series)
+        if stats is None:
+            continue
+        any_stale = True
+        out(f"  {shard_label(shard)}: mean {stats['mean']:.2f}  "
+            f"max {stats['max']:.0f}  ({stats['n']} ingested unrolls)")
+        hist = staleness_buckets_exact(shard) or staleness_histogram(series)
+        width = max((c for _, c in hist), default=1)
+        for bucket, count in hist:
+            bar = "#" * max(1, int(30 * count / width))
+            out(f"    {bucket:>6}: {count:>8} {bar}")
+    for shard in shards:
+        stats = gauge_stats(gauge_series(shard, "actor/weight_version"))
+        if stats is not None:
+            any_stale = True
+            out(f"  {shard_label(shard)}: last pulled version {stats['last']:.0f}")
+    for shard in shards:
+        stats = gauge_stats(gauge_series(shard, "learner/weight_version"))
+        if stats is not None:
+            out(f"  {shard_label(shard)}: last published version {stats['last']:.0f}")
+    if not any_stale:
+        out("  (no staleness gauges — actors may not have pulled weights)")
+
+    if merge:
+        out("")
+        merged = os.path.join(tdir, "trace-merged.json")
+        n = merge_traces(tdir, merged)
+        out(f"merged trace: {merged} ({n} spans; open in ui.perfetto.dev)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("run_dir", help="run directory (or the telemetry dir itself)")
+    p.add_argument("--no-merge", action="store_true",
+                   help="skip writing trace-merged.json")
+    args = p.parse_args(argv)
+    print(build_report(find_telemetry_dir(args.run_dir), merge=not args.no_merge))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

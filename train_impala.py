@@ -60,7 +60,8 @@ def main() -> None:
 
         print(train_anakin(args.config, args.section, args.updates, seed=args.seed,
                            num_envs=args.anakin_envs,
-                           checkpoint_dir=args.checkpoint_dir))
+                           checkpoint_dir=args.checkpoint_dir,
+                           run_dir=args.run_dir))
         return
     if args.mode == "local":
         from distributed_reinforcement_learning_tpu.runtime.launch import train_local

@@ -50,7 +50,8 @@ def main() -> None:
         print(train_anakin_r2d2(args.config, args.section, args.updates,
                                 seed=args.seed, num_envs=args.anakin_envs,
                                 capacity=args.anakin_capacity,
-                                checkpoint_dir=args.checkpoint_dir))
+                                checkpoint_dir=args.checkpoint_dir,
+                                run_dir=args.run_dir))
         return
     if args.mode == "local":
         from distributed_reinforcement_learning_tpu.runtime.launch import train_local
