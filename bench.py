@@ -67,7 +67,10 @@ def _probe_backend(timeout: float) -> tuple[str | None, str | None]:
 
 # Small, bounded extra fields the compact stdout line keeps; everything
 # else (section results, rooflines, sweeps) lives only in the detail file.
-_COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase", "watchdog")
+# chunk_regressions: the device-chunk gate's failing section names (a
+# regression must survive into the compact line the driver reads).
+_COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
+                 "watchdog", "chunk_regressions")
 
 
 def _emit(value: float, extra: dict,
@@ -379,6 +382,7 @@ def bench_anakin(num_envs: int, chunk: int, iters: int) -> dict:
         "num_envs": num_envs, "trajectory": cfg.trajectory, "chunk": chunk,
         "updates_per_s": round(1.0 / update_s, 1),
         "frames_per_s": round(frames / update_s, 1),
+        "device_chunk_s": round(call_s, 4),  # gate input: see check_chunk_gates
         "compile_s": round(compile_s, 1), "timing": stats,
         "last_chunk_mean_return": round(
             box.get("ret_sum", 0.0) / max(box.get("eps", 0.0), 1.0), 1),
@@ -439,6 +443,7 @@ def bench_anakin_breakout(num_envs: int, chunk: int, iters: int) -> dict:
         "num_envs": num_envs, "trajectory": cfg.trajectory, "chunk": chunk,
         "updates_per_s": round(1.0 / update_s, 1),
         "frames_per_s": round(frames / update_s, 1),
+        "device_chunk_s": round(call_s, 4),  # gate input: see check_chunk_gates
         "compile_s": round(compile_s, 1), "timing": stats,
         "last_loss": round(box.get("loss", float("nan")), 3),
     }
@@ -493,6 +498,7 @@ def bench_anakin_r2d2(num_envs: int, chunk: int, iters: int) -> dict:
         "num_envs": num_envs, "seq_len": cfg.seq_len, "chunk": chunk,
         "updates_per_s": round(1.0 / update_s, 1),
         "frames_per_s": round(frames / update_s, 1),
+        "device_chunk_s": round(call_s, 4),  # gate input: see check_chunk_gates
         "compile_s": round(compile_s, 1), "timing": stats,
         "last_loss": round(box.get("loss", float("nan")), 5),
     }
@@ -557,6 +563,7 @@ def bench_anakin_apex(num_envs: int, chunk: int, iters: int) -> dict:
             anakin.updates_per_collect * anakin.batch_size / width, 3),
         "updates_per_s": round(1.0 / update_s, 1),
         "frames_per_s": round(frames / update_s, 1),
+        "device_chunk_s": round(call_s, 4),  # gate input: see check_chunk_gates
         "compile_s": round(compile_s, 1), "timing": stats,
         "last_loss": round(box.get("loss", float("nan")), 5),
     }
@@ -564,6 +571,66 @@ def bench_anakin_apex(num_envs: int, chunk: int, iters: int) -> dict:
           f"{frames / update_s:,.0f} on-device pixel frames/s "
           f"(iqr {stats['iqr_rel']:.0%})", file=sys.stderr)
     return out
+
+
+_CHUNK_GATES_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "benchmarks", "device_chunk_gates.json")
+
+
+def check_chunk_gates(extra: dict, platform: str,
+                      gates: dict | None = None) -> dict | None:
+    """Regression gate on the anakin sections' per-chunk device seconds
+    (ROADMAP open item: the telemetry `anakin/device_chunk_s` gauge gives
+    honest per-chunk device time — gate it here instead of re-measuring).
+
+    `benchmarks/device_chunk_gates.json` pins, per backend platform and
+    per anakin section, the worst acceptable `device_chunk_s` (committed
+    v5e measurements + 25% headroom) at a specific (num_envs, chunk)
+    shape. Sections measured at a different shape are recorded as
+    config_mismatch rather than compared against the wrong limit.
+    Returns a report dict (never raises — a gate must not cost a
+    bench its number), or None when gating is disabled. Pure function
+    over (extra, platform, gates) so tests can drive it directly.
+    """
+    if os.environ.get("BENCH_CHUNK_GATE", "1") != "1":
+        return None
+    if gates is None:
+        try:
+            with open(_CHUNK_GATES_PATH) as f:
+                gates = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return {"skipped": f"gates file unusable: {e}"}
+    plat_gates = gates.get(platform)
+    if not isinstance(plat_gates, dict):
+        return {"skipped": f"no gates for platform {platform!r}"}
+    checked: dict = {}
+    regressed: list[str] = []
+    for section, gate in plat_gates.items():
+        got = extra.get(section)
+        if not isinstance(got, dict) or not isinstance(
+                got.get("device_chunk_s"), (int, float)):
+            continue  # section skipped/failed this run: nothing to gate
+        if any(got.get(k) != gate.get(k) for k in ("num_envs", "chunk")):
+            checked[section] = {
+                "config_mismatch": {k: [got.get(k), gate.get(k)]
+                                    for k in ("num_envs", "chunk")
+                                    if got.get(k) != gate.get(k)}}
+            continue
+        measured = float(got["device_chunk_s"])
+        limit = float(gate["max_device_chunk_s"])
+        ok = measured <= limit
+        checked[section] = {"device_chunk_s": measured,
+                            "max_device_chunk_s": limit, "ok": ok}
+        if not ok:
+            regressed.append(section)
+    report = {"platform": platform, "checked": checked, "regressed": regressed}
+    for section in regressed:
+        c = checked[section]
+        print(f"[bench] CHUNK-GATE REGRESSION: {section} device_chunk_s "
+              f"{c['device_chunk_s']:.4f}s > {c['max_device_chunk_s']:.4f}s "
+              f"limit ({_CHUNK_GATES_PATH})", file=sys.stderr)
+    return report
 
 
 def _pad_util(n: int, q: int = 128) -> float:
@@ -1607,6 +1674,17 @@ def main() -> None:
     def _final_emit(value: float, ex: dict, **kw) -> None:
         with final_lock:
             finishing.set()
+            try:
+                # Device-chunk regression gate over whatever anakin
+                # sections actually ran; best-effort — the gate must
+                # never cost the round its number.
+                gate = check_chunk_gates(ex, platform)
+                if gate is not None:
+                    ex["device_chunk_gate"] = gate
+                    if gate.get("regressed"):
+                        ex["chunk_regressions"] = gate["regressed"]
+            except Exception as e:  # noqa: BLE001
+                ex["device_chunk_gate"] = {"error": f"{type(e).__name__}: {e}"}
             _emit(value, ex, **kw)
 
     def _watchdog():

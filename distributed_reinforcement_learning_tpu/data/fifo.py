@@ -57,6 +57,15 @@ class TrajectoryQueue:
     returns them stacked along a new leading batch axis.
     """
 
+    # Concurrency map (tools/drlint lock-discipline): `_not_full` and
+    # `_not_empty` are Conditions over the SAME `_lock`, so any of the
+    # three names is the same mutex; producers, consumers, and the
+    # transport server's enqueue slices all go through it.
+    _GUARDED_BY = {
+        "_items": ("_lock", "_not_full", "_not_empty"),
+        "_closed": ("_lock", "_not_full", "_not_empty"),
+    }
+
     def __init__(self, capacity: int):
         if capacity <= 0:
             raise ValueError("capacity must be positive")

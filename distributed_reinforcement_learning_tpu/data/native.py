@@ -275,6 +275,21 @@ class NativeTrajectoryQueue:
     # next pooled call (DevicePrefetcher does) needs only 2.
     POOL_SETS = 2
 
+    # Concurrency map (tools/drlint lock-discipline): the reusable batch
+    # scratch and the pooled output sets may only be touched by the
+    # consumer that won the try-acquire on `_scratch_lock` (get_batch) —
+    # losers fall back to fresh allocations. `_item_cap` is deliberately
+    # unannotated: it is a monotonic int hint racily grown by producers
+    # AND consumers, and a lost update only costs one stride-regrow
+    # retry on a later pop, never correctness. The C++ queue itself is
+    # internally synchronized (cpp/ring_queue.cc).
+    _GUARDED_BY = {
+        "_scratch": "_scratch_lock",
+        "_pool": "_scratch_lock",
+        "_pool_sig": "_scratch_lock",
+        "_pool_idx": "_scratch_lock",
+    }
+
     def __init__(self, capacity: int):
         self._q = NativeByteQueue(capacity)
         self.capacity = capacity
@@ -345,9 +360,10 @@ class NativeTrajectoryQueue:
             _OBS.count("fifo/gets")
         return codec.decode(blob, copy=True)
 
-    def _pooled_outputs(self, batch_size: int, metas: list[dict]) -> list[np.ndarray] | None:
+    def _pooled_outputs_locked(self, batch_size: int, metas: list[dict]) -> list[np.ndarray] | None:
         """Next rotation of reusable gather destinations, or None if the
-        schema changed mid-stream (fall back to fresh allocations)."""
+        schema changed mid-stream (fall back to fresh allocations).
+        Caller holds `_scratch_lock` (get_batch's winning try-acquire)."""
         sig = (batch_size, tuple((m["dtype"], tuple(m["shape"])) for m in metas))
         if sig != self._pool_sig:
             self._pool = [None] * self.POOL_SETS
@@ -359,6 +375,19 @@ class NativeTrajectoryQueue:
                 for m in metas
             ]
         return self._pool[self._pool_idx]
+
+    def _take_scratch_locked(self, nbytes: int) -> np.ndarray:
+        """Grow-and-return the shared pop destination. Caller holds
+        `_scratch_lock` (get_batch's winning try-acquire)."""
+        if len(self._scratch) < nbytes:
+            self._scratch = np.empty(nbytes, np.uint8)
+        return self._scratch
+
+    def _keep_scratch_locked(self, buf: np.ndarray) -> None:
+        """Adopt a buffer the native pop regrew past the scratch. Caller
+        holds `_scratch_lock`."""
+        if len(buf) > len(self._scratch):
+            self._scratch = buf
 
     def get_batch(self, batch_size: int, timeout: float | None = None,
                   pooled: bool = False) -> Any | None:
@@ -394,11 +423,8 @@ class NativeTrajectoryQueue:
         # pays a fresh per-call allocation — the queue stays MPMC-safe.
         have_scratch = self._scratch_lock.acquire(blocking=False)
         try:
-            scratch = None
-            if have_scratch:
-                if len(self._scratch) < batch_size * item_cap:
-                    self._scratch = np.empty(batch_size * item_cap, np.uint8)
-                scratch = self._scratch
+            scratch = (self._take_scratch_locked(batch_size * item_cap)
+                       if have_scratch else None)
             raw = self._q.get_batch_raw(batch_size, item_cap, remaining,
                                         scratch=scratch)
             if raw is None:
@@ -406,8 +432,8 @@ class NativeTrajectoryQueue:
             if _OBS.enabled:
                 _OBS.count("fifo/gets", batch_size)
             buf, stride, lens = raw
-            if have_scratch and len(buf) > len(self._scratch):
-                self._scratch = buf  # stride regrew inside the pop: keep it
+            if have_scratch:
+                self._keep_scratch_locked(buf)  # stride regrew in the pop
             # Persist a regrown stride so later batches don't repeat the
             # doomed small-stride native call (one wasted lock+retry each).
             self._item_cap = max(self._item_cap, stride)
@@ -421,7 +447,7 @@ class NativeTrajectoryQueue:
             if batch_size == 1 or lib.bs_all_equal_prefix(
                 base, stride, batch_size, payload_start
             ):
-                outs = (self._pooled_outputs(batch_size, metas)
+                outs = (self._pooled_outputs_locked(batch_size, metas)
                         if pooled and have_scratch else None)
                 arrays = []
                 for j, meta in enumerate(metas):

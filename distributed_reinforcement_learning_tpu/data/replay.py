@@ -111,9 +111,18 @@ class PrioritizedReplay:
     ALPHA = 0.6
     BETA_INCREMENT = 0.001
 
-    def __init__(self, capacity: int, beta: float = 0.4):
+    # No locks on purpose (so no _GUARDED_BY map): this backend is
+    # single-thread by contract — the learner thread both ingests and
+    # samples; cross-thread traffic arrives through the queue, not here.
+    # The threaded backends below declare their maps.
+
+    def __init__(self, capacity: int, beta: float = 0.4, seed: int = 0):
         self.tree = SumTree(capacity)
         self.beta = beta
+        # Owned, seeded sampling stream: defaulting to the process-global
+        # np.random made replay composition depend on every other
+        # consumer of the global state (drlint: nondeterminism).
+        self._default_rng = np.random.RandomState(seed)
 
     def __len__(self) -> int:
         return len(self.tree)
@@ -132,7 +141,7 @@ class PrioritizedReplay:
         return idxs
 
     def sample(self, n: int, rng: np.random.RandomState | None = None):
-        rng = rng or np.random
+        rng = rng or self._default_rng
         self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
         segment = self.tree.total / n
         idxs = np.empty(n, np.int64)
@@ -247,11 +256,21 @@ class NativePrioritizedReplay:
     ALPHA = PrioritizedReplay.ALPHA
     BETA_INCREMENT = PrioritizedReplay.BETA_INCREMENT
 
-    def __init__(self, capacity: int, beta: float = 0.4):
+    # Concurrency map (tools/drlint lock-discipline). `tree` is NOT here:
+    # the C++ SumTree carries its own internal mutex (cpp/sumtree.cc), so
+    # bare tree calls (update_batch) are safe — `_lock` exists for the
+    # slot-reserve + payload-write PAIR, which must be atomic together.
+    _GUARDED_BY = {
+        "_data": "_lock",
+        "beta": "_lock",
+    }
+
+    def __init__(self, capacity: int, beta: float = 0.4, seed: int = 0):
         from distributed_reinforcement_learning_tpu.data.native import NativeSumTree
 
         self.tree = NativeSumTree(capacity)
         self.beta = beta
+        self._default_rng = np.random.RandomState(seed)  # owned sampling stream
         self._data: list[Any] = [None] * capacity
         # Guards the slot-reserve (native) + payload-write (Python) pair so a
         # threaded ingest can't expose a priority whose payload isn't stored
@@ -283,7 +302,7 @@ class NativePrioritizedReplay:
         return out
 
     def _sample_locked(self, n: int, rng):
-        rng = rng or np.random
+        rng = rng or self._default_rng
         self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
         cap = self.tree.capacity
         idxs, priorities = _stratified_pick(
@@ -345,11 +364,21 @@ class ArrayPrioritizedReplay:
     ALPHA = PrioritizedReplay.ALPHA
     BETA_INCREMENT = PrioritizedReplay.BETA_INCREMENT
 
-    def __init__(self, capacity: int, beta: float = 0.4):
+    # Concurrency map (tools/drlint lock-discipline): the lazily-built
+    # field rings and the annealed beta are shared between a threaded
+    # ingest and the sampling learner. The C++ tree locks internally
+    # (see NativePrioritizedReplay).
+    _GUARDED_BY = {
+        "_store": "_lock",
+        "beta": "_lock",
+    }
+
+    def __init__(self, capacity: int, beta: float = 0.4, seed: int = 0):
         from distributed_reinforcement_learning_tpu.data.native import NativeSumTree
 
         self.tree = NativeSumTree(capacity)
         self.beta = beta
+        self._default_rng = np.random.RandomState(seed)  # owned sampling stream
         self._store = None  # pytree of [capacity, ...] arrays, lazy
         self._lock = threading.Lock()
 
@@ -359,7 +388,7 @@ class ArrayPrioritizedReplay:
     def _priority(self, errors) -> np.ndarray:
         return (np.abs(np.asarray(errors, np.float64)) + self.EPS) ** self.ALPHA
 
-    def _ensure_store(self, batch: Any) -> None:
+    def _ensure_store_locked(self, batch: Any) -> None:
         import jax
 
         if self._store is None:
@@ -370,7 +399,7 @@ class ArrayPrioritizedReplay:
                 batch,
             )
 
-    def _write(self, slots: np.ndarray, batch: Any) -> None:
+    def _write_locked(self, slots: np.ndarray, batch: Any) -> None:
         import jax
 
         jax.tree.map(lambda store, x: store.__setitem__(slots, np.asarray(x)),
@@ -379,9 +408,9 @@ class ArrayPrioritizedReplay:
     def add_batch_stacked(self, errors: np.ndarray, batch: Any) -> np.ndarray:
         """Insert a `[N, ...]`-stacked batch of transitions/sequences."""
         with self._lock:
-            self._ensure_store(batch)
+            self._ensure_store_locked(batch)
             slots = self.tree.add_batch(self._priority(errors))
-            self._write(slots, batch)
+            self._write_locked(slots, batch)
             idxs = slots + self.tree.capacity - 1
         _observe_replay(self, inserted=len(idxs))
         return idxs
@@ -400,7 +429,7 @@ class ArrayPrioritizedReplay:
     def sample(self, n: int, rng: np.random.RandomState | None = None):
         import jax
 
-        rng = rng or np.random
+        rng = rng or self._default_rng
         with self._lock:
             self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
             count = len(self.tree)
@@ -427,12 +456,15 @@ class ArrayPrioritizedReplay:
         before snapshot() copies it under the lock."""
         import jax
 
-        n = len(self.tree)
-        if self._store is None or n == 0:
-            return 0
-        per_item = sum(
-            int(np.prod(leaf.shape[1:], dtype=np.int64)) * leaf.dtype.itemsize
-            for leaf in jax.tree.leaves(self._store))
+        # Locked: a threaded ingest may be building _store right now, and
+        # this races a half-assigned pytree otherwise.
+        with self._lock:
+            n = len(self.tree)
+            if self._store is None or n == 0:
+                return 0
+            per_item = sum(
+                int(np.prod(leaf.shape[1:], dtype=np.int64)) * leaf.dtype.itemsize
+                for leaf in jax.tree.leaves(self._store))
         return n * per_item + n * 8  # + float64 priorities
 
     def snapshot(self) -> dict:
@@ -455,28 +487,31 @@ class ArrayPrioritizedReplay:
             batch = stack_pytrees(snap["items"])
         with self._lock:
             if batch is not None:
-                self._ensure_store(batch)
+                self._ensure_store_locked(batch)
                 slots = self.tree.add_batch(np.asarray(snap["priorities"], np.float64))
-                self._write(slots, batch)
+                self._write_locked(slots, batch)
             self.beta = float(snap["beta"])
 
 
-def make_replay(capacity: int, beta: float = 0.4, backend: str = "auto"):
+def make_replay(capacity: int, beta: float = 0.4, backend: str = "auto",
+                seed: int = 0):
     """Pick the replay implementation: 'python', 'native', 'array', or
     'auto' (= structure-of-arrays over the C++ tree when the native lib
-    builds, else the pure-Python Memory)."""
+    builds, else the pure-Python Memory). `seed` fixes the backend's
+    default sampling stream (callers passing their own rng to sample()
+    are unaffected)."""
     if backend == "python":
-        return PrioritizedReplay(capacity, beta)
+        return PrioritizedReplay(capacity, beta, seed=seed)
     if backend == "native":
-        return NativePrioritizedReplay(capacity, beta)
+        return NativePrioritizedReplay(capacity, beta, seed=seed)
     if backend in ("array", "auto"):
         from distributed_reinforcement_learning_tpu.data.native import native_available
 
         if native_available():
-            return ArrayPrioritizedReplay(capacity, beta)
+            return ArrayPrioritizedReplay(capacity, beta, seed=seed)
         if backend == "array":
             raise RuntimeError("array replay backend needs the native library")
-        return PrioritizedReplay(capacity, beta)
+        return PrioritizedReplay(capacity, beta, seed=seed)
     raise ValueError(f"unknown replay backend {backend!r}")
 
 

@@ -305,8 +305,10 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
                 return done + self._flush_pending_ingest()
             with self.timer.stage("ingest_td"):
                 # [K, U, ...] -> [K*U, ...]: one forward for everything.
+                # Host arrays by design: the dequeued batch is already
+                # host numpy and the sum-tree add below is host memory.
                 flat = jax.tree.map(
-                    lambda x: np.asarray(x).reshape(-1, *np.asarray(x).shape[2:]),
+                    lambda x: np.asarray(x).reshape(-1, *np.asarray(x).shape[2:]),  # drlint: disable=host-sync
                     stacked)
                 if pipeline:
                     # Dispatch k's H2D + TD forward, then materialize
@@ -319,7 +321,9 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
                     if done:
                         return done
                     continue  # primed the pipeline; pop the next chunk
-                td = np.asarray(self.agent.td_error(self.state, flat))
+                # Deliberate sync (non-pipelined path only): priorities
+                # must reach the host sum-tree before the add.
+                td = np.asarray(self.agent.td_error(self.state, flat))  # drlint: disable=host-sync
             self._replay_add(td, flat)
             self.ingested_unrolls += k
             if _OBS.enabled:
@@ -375,7 +379,9 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
                     batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
                 self.state, td, metrics = self._learn(self.state, batch, is_weight)
             with self.timer.stage("replay_update"):
-                self.replay.update_batch(idxs, np.asarray(td))
+                # Deliberate sync: the re-prioritization targets the host
+                # sum-tree, so the TD errors must materialize here.
+                self.replay.update_batch(idxs, np.asarray(td))  # drlint: disable=host-sync
         self._finish_train_call()
         metrics = {k: float(v) for k, v in metrics.items()}
         if _OBS.enabled:

@@ -306,7 +306,9 @@ class ImpalaLearner(PublishCadenceMixin):
                     self._metrics_pump.submit(dict(metrics), self.train_steps)
             else:
                 with self.timer.stage("metrics_sync"):
-                    metrics = {k: float(v) for k, v in metrics.items()}
+                    # Deliberate sync path (async metrics off): the float
+                    # doubles as the sync loop's pipelining bound.
+                    metrics = {k: float(v) for k, v in metrics.items()}  # drlint: disable=host-sync
                 self.logger.add_scalars(
                     {f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
         # Non-publish steps return the metrics as DEVICE arrays and log

@@ -108,6 +108,18 @@ class InferenceServer:
     published version), so inference always uses the newest snapshot.
     """
 
+    # Concurrency map (tools/drlint lock-discipline): the pending-request
+    # state is shared between submitter (connection-handler) threads and
+    # the batcher; `_batch_ready` is a Condition OVER `_lock`, so holding
+    # either name is holding the same mutex. The batch-side state
+    # (`_rng`, `_device_params`, `_cached_version`, counters) is touched
+    # only by the single batcher thread and needs no lock.
+    _GUARDED_BY = {
+        "_pending": ("_lock", "_batch_ready"),
+        "_pending_rows": ("_lock", "_batch_ready"),
+        "_stop": ("_lock", "_batch_ready"),
+    }
+
     def __init__(
         self,
         act_fn: Callable,
@@ -250,8 +262,11 @@ class InferenceServer:
             self._stop = True
             self._batch_ready.notify_all()
         self._thread.join(timeout=5.0)
-        # Unblock any submitters that raced the shutdown.
-        for r in self._pending:
+        # Unblock any submitters that raced the shutdown. Drained under
+        # the lock: a submitter that saw _stop unset could still be
+        # appending while this runs.
+        with self._batch_ready:
+            pending, self._pending = self._pending, []
+        for r in pending:
             r["error"] = RuntimeError("inference server stopped")
             r["event"].set()
-        self._pending = []

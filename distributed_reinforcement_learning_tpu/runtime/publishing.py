@@ -43,6 +43,14 @@ class MetricsPump:
     loop can dispatch device steps.
     """
 
+    # Concurrency map (tools/drlint lock-discipline): empty on purpose,
+    # and kept as documentation — the pump owns no lock because all of
+    # its mutable attributes (`_thread`, `_logger`, `_prefix`) are
+    # touched only by the learn thread (submit/close callers); the
+    # internally-synchronized `_q` is the single cross-thread channel,
+    # and the worker reads nothing else.
+    _GUARDED_BY: dict = {}
+
     def __init__(self, logger, prefix: str = "learner/", depth: int = 4):
         self._logger = logger
         self._prefix = prefix

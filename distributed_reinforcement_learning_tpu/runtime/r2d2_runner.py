@@ -301,7 +301,9 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
                 k = min(k, self.batch_size)
             padded = seqs if k == n else seqs + [seqs[0]] * (k - n)
             batch = stack_pytrees(padded)
-            td = np.asarray(self.agent.td_error(self.state, batch))[:n]
+            # Deliberate sync: initial priorities feed the host sum-tree
+            # add directly below.
+            td = np.asarray(self.agent.td_error(self.state, batch))[:n]  # drlint: disable=host-sync
         with self.timer.stage("ingest_replay_add"):
             if getattr(self.replay, "stacked_samples", False):
                 if k > n:
@@ -357,7 +359,9 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
                     batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
                 self.state, priorities, metrics = self._learn(self.state, batch, is_weight)
             with self.timer.stage("replay_update"):
-                self.replay.update_batch(idxs, np.asarray(priorities))
+                # Deliberate sync: re-prioritization targets the host
+                # sum-tree, so the priorities must materialize here.
+                self.replay.update_batch(idxs, np.asarray(priorities))  # drlint: disable=host-sync
         self._finish_train_call()
         metrics = {k: float(v) for k, v in metrics.items()}
         if _OBS.enabled:

@@ -174,8 +174,46 @@ class _ConnRecvBuf:
         return tag, view
 
 
-class TransportServer:
+class _LockedStatsMixin:
+    """Lock-guarded counter surface shared by the server and the client.
+
+    Host class provides `self.stats` (a plain dict of int counters) and
+    `self._stats_lock`. Writers go through _bump; cross-thread readers
+    (stats loops, telemetry providers) through stat()/snapshot_stats() —
+    dict-item += is a load/add/store, and unlocked reads against it tear.
+    """
+
+    def _bump(self, key: str, by: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += by
+
+    def stat(self, key: str) -> int:
+        """One counter, read under the lock (telemetry providers poll
+        this from the flush thread)."""
+        with self._stats_lock:
+            return self.stats[key]
+
+    def snapshot_stats(self) -> dict:
+        """Consistent copy of the whole stats dict (periodic stat lines
+        and the scale-demo reporting read this, never the live dict)."""
+        with self._stats_lock:
+            return dict(self.stats)
+
+
+class TransportServer(_LockedStatsMixin):
     """Learner-side service: owns nothing, serves the queue + weight store."""
+
+    # Concurrency map (enforced by tools/drlint's lock-discipline pass;
+    # docs/static_analysis.md): per-connection _serve threads, the
+    # accept loop, the stats loop, and telemetry flushes all touch this
+    # state. `_threads` shares _conns_lock — both are the accept loop's
+    # connection bookkeeping and are read together at stop().
+    _GUARDED_BY = {
+        "stats": "_stats_lock",
+        "_conns": "_conns_lock",
+        "_threads": "_conns_lock",
+        "_enc_cache": "_enc_lock",
+    }
 
     def __init__(self, queue, weights, host: str = "0.0.0.0", port: int = 8000,
                  inference=None):
@@ -199,10 +237,6 @@ class TransportServer:
                       "partial_accepts": 0, "weight_sends": 0}
         self._stats_lock = threading.Lock()
 
-    def _bump(self, key: str, by: int = 1) -> None:
-        with self._stats_lock:
-            self.stats[key] += by
-
     def start(self) -> "TransportServer":
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -211,13 +245,19 @@ class TransportServer:
         self._sock.settimeout(0.5)
         t = threading.Thread(target=self._accept_loop, daemon=True, name="transport-accept")
         t.start()
-        self._threads.append(t)
+        # The accept loop is already running and prunes/extends _threads
+        # on every accepted connection — appending the stats thread below
+        # unlocked could lose it to a concurrent prune-rebuild and leave
+        # stop() unable to join it.
+        with self._conns_lock:
+            self._threads.append(t)
         stats_s = float(os.environ.get("DRL_TRANSPORT_STATS_S", "0"))
         if stats_s > 0:
             t2 = threading.Thread(target=self._stats_loop, args=(stats_s,),
                                   daemon=True, name="transport-stats")
             t2.start()
-            self._threads.append(t2)
+            with self._conns_lock:
+                self._threads.append(t2)
         return self
 
     def _stats_loop(self, interval: float) -> None:
@@ -227,7 +267,10 @@ class TransportServer:
         import sys as _sys
 
         while not self._stop.wait(interval):
-            s = dict(self.stats)
+            # Locked copy: the per-connection _serve threads _bump these
+            # concurrently, and an unlocked dict read here could tear
+            # against a resize or report a half-applied +=.
+            s = self.snapshot_stats()
             try:
                 depth = self.queue.size()
             except Exception:  # noqa: BLE001 — closed queue at shutdown
@@ -258,7 +301,9 @@ class TransportServer:
                 c.close()
             except OSError:
                 pass
-        for t in self._threads:
+        with self._conns_lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=2.0)
 
     def _accept_loop(self) -> None:
@@ -280,8 +325,9 @@ class TransportServer:
             t.start()
             # Prune finished connection threads so reconnect churn over a
             # long-running learner doesn't accumulate dead Thread objects.
-            self._threads = [x for x in self._threads if x.is_alive()]
-            self._threads.append(t)
+            with self._conns_lock:
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
 
     def _weights_blob(self) -> tuple[int, bytes]:
         # Read-then-cache entirely under the lock, and only move the cache
@@ -464,8 +510,18 @@ class TransportServer:
                 return
 
 
-class TransportClient:
+class TransportClient(_LockedStatsMixin):
     """Actor-side connection with bounded-retry reconnect."""
+
+    # Concurrency map (tools/drlint lock-discipline): `_lock` serializes
+    # the request/reply exchange and owns the socket lifecycle;
+    # `_stats_lock` covers the counters, which the actor loop's stat
+    # line and the telemetry flush thread read while call paths bump
+    # them. Methods named *_locked are called with `_lock` already held.
+    _GUARDED_BY = {
+        "_sock": "_lock",
+        "stats": "_stats_lock",
+    }
 
     def __init__(
         self,
@@ -485,9 +541,10 @@ class TransportClient:
         # line; fairness evidence for the 20-actor topology demo).
         self.stats = {"unrolls_sent": 0, "busy_waits": 0,
                       "partial_accepts": 0, "weight_pulls": 0}
-        self._connect()
+        self._stats_lock = threading.Lock()
+        self._connect_locked()  # __init__ happens-before any sharing
 
-    def _connect(self) -> None:
+    def _connect_locked(self) -> None:
         last: Exception | None = None
         for _ in range(self.connect_retries):
             try:
@@ -510,19 +567,24 @@ class TransportClient:
         parts = payload if isinstance(payload, list) else [payload]
         with self._lock:
             if self._sock is None:  # a prior failed reconnect left us down
-                self._connect()
+                self._connect_locked()
             try:
                 _send_msg(self._sock, op, *parts)
                 return _recv_msg(self._sock)
             except (TransportError, OSError):
                 if not retry:
                     raise
-                self.close()
-                self._connect()
+                self._close_locked()
+                self._connect_locked()
                 if not resend:
                     raise TransportError("connection lost mid-request") from None
                 _send_msg(self._sock, op, *parts)
                 return _recv_msg(self._sock)
+
+    def _is_down(self) -> bool:
+        """True when the last reconnect attempt failed (learner gone)."""
+        with self._lock:
+            return self._sock is None
 
     def _call(self, op: int, payload: bytes = b"", retry: bool = True) -> bytes:
         status, resp = self._exchange(op, payload, retry, resend=True)
@@ -549,14 +611,14 @@ class TransportClient:
             try:
                 status, _ = self._exchange(OP_PUT_TRAJ, blob, retry=True, resend=False)
             except TransportError:
-                if self._sock is None:  # reconnect failed: learner is gone
+                if self._is_down():  # reconnect failed: learner is gone
                     raise
                 return False
             if status == ST_OK:
-                self.stats["unrolls_sent"] += 1
+                self._bump("unrolls_sent")
                 return True
             if status == ST_BUSY:  # learner alive but queue full: keep pushing
-                self.stats["busy_waits"] += 1
+                self._bump("busy_waits")
                 now = time.monotonic()
                 busy_since = busy_since or now
                 if now - busy_since > self.busy_timeout:
@@ -591,7 +653,7 @@ class TransportClient:
                 status, resp = self._exchange(
                     OP_PUT_TRAJ_N, pack_batch(blobs[sent:]), retry=True, resend=False)
             except TransportError:
-                if self._sock is None:  # reconnect failed: learner is gone
+                if self._is_down():  # reconnect failed: learner is gone
                     raise
                 return sent  # batch fate unknown: drop, never duplicate
             if status == ST_CLOSED:
@@ -600,9 +662,9 @@ class TransportClient:
                 raise TransportError("put_trajectories failed on the learner side")
             accepted = _I64.unpack(resp)[0]
             sent += accepted
-            self.stats["unrolls_sent"] += accepted
+            self._bump("unrolls_sent", accepted)
             if sent < len(blobs):
-                self.stats["partial_accepts"] += 1
+                self._bump("partial_accepts")
                 # Partial acceptance = the bounded queue refused the tail
                 # (the batched ST_BUSY). The tail was not enqueued, so
                 # resending it cannot duplicate.
@@ -624,7 +686,7 @@ class TransportClient:
             _OBS.gauge("actor/weight_version", version)
         if version == have_version:  # identity match (see server comment)
             return None
-        self.stats["weight_pulls"] += 1
+        self._bump("weight_pulls")
         return codec.decode(resp[_I64.size :], copy=True), version
 
     def remote_act(self, request: dict) -> dict:
@@ -655,6 +717,12 @@ class TransportClient:
             return False
 
     def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        # Split from close(): _exchange already holds _lock when it tears
+        # down a dead socket, and threading.Lock is not reentrant.
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -855,10 +923,12 @@ def run_role(
             _OBS.sample("learner/weight_version", lambda: weights.version)
             # The server's cumulative stats (unrolls_accepted,
             # busy_replies, weight_sends, ...) become report throughput
-            # via counter providers — no second hot-path counter.
-            for key in server.stats:
+            # via counter providers — no second hot-path counter. The
+            # providers poll from the telemetry flush thread, so they go
+            # through the locked stat() accessor, not the live dict.
+            for key in server.snapshot_stats():
                 _OBS.sample(f"transport/{key}",
-                            lambda k=key: server.stats[k], kind="counter")
+                            lambda k=key: server.stat(k), kind="counter")
         print(f"[learner] serving on :{serve_port}; training {num_updates} updates")
         try:
             _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
@@ -901,8 +971,8 @@ def run_role(
         # nothing). The client's cumulative stats become per-flush
         # timelines via providers — zero cost on the act/step path.
         if maybe_configure("actor", task, run_dir):
-            for key in client.stats:
-                _OBS.sample(f"actor/{key}", lambda k=key: client.stats[k],
+            for key in client.snapshot_stats():
+                _OBS.sample(f"actor/{key}", lambda k=key: client.stat(k),
                             kind="counter")
             _OBS.sample("actor/weight_version_held",
                         lambda: getattr(actor, "_version", -1))
@@ -944,7 +1014,7 @@ def run_role(
                     # Per-actor fairness/staleness record (scale demo):
                     # machine-grepped as `[actor k] stats {...}` lines.
                     next_stats = time.monotonic() + stats_s
-                    s = dict(client.stats)
+                    s = client.snapshot_stats()
                     s["frames"] = frames
                     s["weight_version"] = getattr(actor, "_version", None)
                     print(f"[actor {task}] stats {s}", flush=True)

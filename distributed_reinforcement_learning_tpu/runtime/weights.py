@@ -25,6 +25,22 @@ from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _O
 
 
 class WeightStore:
+    # Concurrency map (tools/drlint lock-discipline): `_lock` covers the
+    # published snapshot that actor pulls / the transport server / the
+    # inference service read; `_async_lock` covers the async-publication
+    # worker's submission state. `_copy_fn` is deliberately unannotated:
+    # it is only ever touched by the learn thread (publish_async caller).
+    _GUARDED_BY = {
+        "_params": "_lock",
+        "_version": "_lock",
+        "_applied_seq": "_lock",
+        "_seq": "_async_lock",
+        "_pending": "_async_lock",
+        "_busy": "_async_lock",
+        "_closed": "_async_lock",
+        "_worker": "_async_lock",
+    }
+
     def __init__(self):
         self._lock = threading.Lock()
         self._params: Any = None

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Local drlint one-liner (docs/static_analysis.md). Defaults to the
+# library package; pass paths/flags to override, e.g.:
+#   scripts/drlint.sh                          # lint the shipped tree
+#   scripts/drlint.sh --json runtime/foo.py    # one file, JSON output
+# Exit: 0 clean (after baseline), 1 findings, 2 usage/parse error.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+if [ "$#" -eq 0 ]; then
+  set -- distributed_reinforcement_learning_tpu
+fi
+exec python -m tools.drlint "$@"

@@ -13,6 +13,7 @@ sections):
   exits 0 — the behavior a mid-section tunnel hang relies on.
 """
 
+import importlib.util
 import json
 import os
 import subprocess
@@ -20,6 +21,15 @@ import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_bench():
+    """Import bench.py as a module (repo root is not on sys.path; the
+    module top level is import-light — jax only loads inside main)."""
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 _TRIMMED = {
     "BENCH_PLATFORM": "cpu",
@@ -80,3 +90,63 @@ def test_watchdog_force_emits_while_main_thread_is_wedged(tmp_path):
     assert proc.returncode == 0
     assert last["metric"] and "value" in last
     assert "watchdog" in last["extra"], last["extra"]
+
+
+class TestDeviceChunkGate:
+    """check_chunk_gates (bench.py): the ROADMAP's anakin device_chunk_s
+    regression gate, driven as a pure function over (extra, platform,
+    gates) — no accelerator needed."""
+
+    GATES = {"tpu": {
+        "anakin_breakout": {"num_envs": 256, "chunk": 20,
+                            "max_device_chunk_s": 0.52},
+        "anakin_r2d2": {"num_envs": 256, "chunk": 50,
+                        "max_device_chunk_s": 0.031},
+    }}
+
+    def test_regression_detected_and_pass_recorded(self):
+        bench = _load_bench()
+        extra = {
+            "anakin_breakout": {"num_envs": 256, "chunk": 20,
+                                "device_chunk_s": 0.61},   # over the limit
+            "anakin_r2d2": {"num_envs": 256, "chunk": 50,
+                            "device_chunk_s": 0.025},      # within it
+        }
+        report = bench.check_chunk_gates(extra, "tpu", self.GATES)
+        assert report["regressed"] == ["anakin_breakout"]
+        assert report["checked"]["anakin_breakout"]["ok"] is False
+        assert report["checked"]["anakin_r2d2"]["ok"] is True
+
+    def test_config_mismatch_is_not_compared(self):
+        bench = _load_bench()
+        extra = {"anakin_breakout": {"num_envs": 128, "chunk": 20,
+                                     "device_chunk_s": 9.9}}
+        report = bench.check_chunk_gates(extra, "tpu", self.GATES)
+        assert report["regressed"] == []
+        mismatch = report["checked"]["anakin_breakout"]["config_mismatch"]
+        assert mismatch == {"num_envs": [128, 256]}
+
+    def test_missing_platform_and_failed_section_skip(self):
+        bench = _load_bench()
+        report = bench.check_chunk_gates({}, "cpu", self.GATES)
+        assert "skipped" in report
+        # A section that errored (no device_chunk_s) is simply not gated.
+        extra = {"anakin_breakout": {"error": "OOM"}}
+        report2 = bench.check_chunk_gates(extra, "tpu", self.GATES)
+        assert report2["checked"] == {} and report2["regressed"] == []
+
+    def test_env_kill_switch(self, monkeypatch):
+        bench = _load_bench()
+        monkeypatch.setenv("BENCH_CHUNK_GATE", "0")
+        assert bench.check_chunk_gates({}, "tpu", self.GATES) is None
+
+    def test_committed_gates_file_shape(self):
+        """The committed gates file parses and pins all four anakin
+        sections at their r04 v5e shapes."""
+        gates = json.loads(
+            (REPO / "benchmarks" / "device_chunk_gates.json").read_text())
+        assert set(gates["tpu"]) == {"anakin", "anakin_breakout",
+                                     "anakin_r2d2", "anakin_apex"}
+        for section, g in gates["tpu"].items():
+            assert g["max_device_chunk_s"] > 0, section
+            assert "num_envs" in g and "chunk" in g, section

@@ -1,0 +1,542 @@
+"""drlint (tools/drlint): per-pass fixtures + the tier-1 tree gate.
+
+Each of the five passes gets at least one positive fixture (violation
+detected with the right rule id and line) and one negative fixture
+(idiomatic code passes), plus suppression-comment and baseline
+round-trip coverage — ISSUE 2's test contract. The final test IS the
+gate: the shipped package must lint clean against the committed
+baseline, forever. Everything here is pure-stdlib analysis of source
+strings — no jax import, so the whole module runs in well under the
+10 s budget on CPU.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.drlint import (
+    Baseline,
+    BaselineError,
+    lint_paths,
+    lint_source,
+    write_baseline,
+)
+from tools.drlint.core import BASELINE_MAX_ENTRIES, Finding
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "distributed_reinforcement_learning_tpu"
+BASELINE = REPO / "tools" / "drlint" / "baseline.json"
+
+
+def lint(src: str, path: str = "distributed_reinforcement_learning_tpu/x.py"):
+    return lint_source(textwrap.dedent(src), path)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------- jit-purity
+
+class TestJitPurity:
+    def test_positive_decorated_jit(self):
+        findings = lint("""
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                t = time.time()
+                print("tracing", x)
+                return x + t
+        """)
+        assert rules_of(findings) == ["jit-purity", "jit-purity"]
+        assert findings[0].line == 7 and "time.time" in findings[0].message
+        assert findings[1].line == 8 and "print" in findings[1].message
+        assert findings[0].context == "step"
+
+    def test_positive_scan_body_and_transitive_helper(self):
+        findings = lint("""
+            import time
+            import jax
+            from jax import lax
+
+            def _helper(c):
+                time.sleep(0.1)
+                return c
+
+            def _body(carry, x):
+                return _helper(carry), x
+
+            def run(xs):
+                return lax.scan(_body, 0.0, xs)
+        """)
+        assert rules_of(findings) == ["jit-purity"]
+        assert "time.sleep" in findings[0].message
+        assert findings[0].context == "_helper"
+
+    def test_positive_global_and_partial_decorator(self):
+        findings = lint("""
+            import functools
+            import jax
+
+            COUNT = 0
+
+            @functools.partial(jax.jit, static_argnums=0)
+            def step(n, x):
+                global COUNT
+                return x * n
+        """)
+        assert rules_of(findings) == ["jit-purity"]
+        assert "global" in findings[0].message
+
+    def test_positive_aliased_clock_import(self):
+        """`import time as _t` must not smuggle a trace-time clock read
+        past the pass."""
+        findings = lint("""
+            import time as _t
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x + _t.time()
+        """)
+        assert rules_of(findings) == ["jit-purity"]
+        assert "time.time" in findings[0].message
+
+    def test_negative_host_code_and_debug_print(self):
+        findings = lint("""
+            import time
+            import jax
+
+            def host_loop(x):
+                t0 = time.time()          # not traced: fine
+                print("host", t0)
+                return x
+
+            @jax.jit
+            def step(x):
+                jax.debug.print("x={}", x)   # trace-legal callback
+                key = jax.random.PRNGKey(0)  # jax.random is fine
+                return x + jax.random.uniform(key)
+        """)
+        assert findings == []
+
+    def test_negative_seeded_ctor_at_setup(self):
+        findings = lint("""
+            import numpy as np
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x
+
+            def make_env(seed):
+                return np.random.RandomState(seed)
+        """)
+        assert findings == []
+
+
+# ----------------------------------------------------------------- host-sync
+
+HOT_PATH = "distributed_reinforcement_learning_tpu/runtime/fake_runner.py"
+
+
+class TestHostSync:
+    def test_positive_learner_loop(self):
+        findings = lint_source(textwrap.dedent("""
+            import numpy as np
+            import jax
+
+            class Learner:
+                def train(self):
+                    metrics = self._learn()
+                    loss = float(metrics["loss"])
+                    td = np.asarray(metrics["td"])
+                    v = metrics["v"].item()
+                    jax.block_until_ready(td)
+                    return loss, td, v
+        """), HOT_PATH)
+        got = rules_of(findings)
+        assert got == ["host-sync"] * 4, findings
+        assert [f.line for f in findings] == [8, 9, 10, 11]
+        assert findings[0].context == "Learner.train"
+
+    def test_positive_actor_loop_item_only(self):
+        findings = lint_source(textwrap.dedent("""
+            import numpy as np
+
+            class Actor:
+                def run_unroll(self):
+                    a = self.agent.act(self._obs)
+                    actions = np.asarray(a)       # actor boundary: allowed
+                    return actions.sum().item()   # blocking sync: flagged
+        """), HOT_PATH)
+        assert rules_of(findings) == ["host-sync"]
+        assert ".item()" in findings[0].message
+
+    def test_negative_out_of_scope_file(self):
+        src = """
+            class Learner:
+                def train(self):
+                    return float(self.metrics["loss"])
+        """
+        assert lint_source(
+            textwrap.dedent(src),
+            "distributed_reinforcement_learning_tpu/data/fifo.py") == []
+
+    def test_negative_cold_function_and_constants(self):
+        findings = lint_source(textwrap.dedent("""
+            import os
+
+            class Learner:
+                def restore_checkpoint(self, extra):
+                    return int(extra.get("train_steps", 0))  # cold path
+
+                def train(self):
+                    k = int(1)  # constant: no sync possible
+                    return k
+        """), HOT_PATH)
+        assert findings == []
+
+
+# ----------------------------------------------------------- lock-discipline
+
+LOCK_SRC = """
+    import threading
+
+    class Store:
+        _GUARDED_BY = {
+            "_params": "_lock",
+            "_items": ("_lock", "_not_empty"),
+        }
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._not_empty = threading.Condition(self._lock)
+            self._params = None   # __init__ is exempt (happens-before)
+            self._items = []
+
+        def publish(self, p):
+            with self._lock:
+                self._params = p
+
+        def drain(self):
+            with self._not_empty:
+                return list(self._items)
+
+        def _peek_locked(self):
+            return self._params   # *_locked: caller holds the lock
+
+        def racy_read(self):
+            return self._params
+
+        def racy_write(self):
+            self._items.append(1)
+"""
+
+
+class TestLockDiscipline:
+    def test_positive_unlocked_touches(self):
+        findings = lint(LOCK_SRC)
+        assert rules_of(findings) == ["lock-discipline", "lock-discipline"]
+        assert findings[0].context == "Store.racy_read"
+        assert "_params" in findings[0].message and "_lock" in findings[0].message
+        assert findings[1].context == "Store.racy_write"
+
+    def test_negative_locked_variants(self):
+        clean = LOCK_SRC[:LOCK_SRC.index("    def racy_read")]
+        assert lint(clean) == []
+
+    def test_condition_alias_and_lambda_inherit_lock(self):
+        findings = lint("""
+            import threading
+
+            class Q:
+                _GUARDED_BY = {"_items": ("_lock", "_not_empty")}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._not_empty = threading.Condition(self._lock)
+                    self._items = []
+
+                def get(self):
+                    with self._not_empty:
+                        self._not_empty.wait_for(lambda: len(self._items) > 0)
+                        return self._items.pop()
+        """)
+        assert findings == []
+
+    def test_unannotated_class_is_ignored(self):
+        findings = lint("""
+            class Plain:
+                def touch(self):
+                    self._anything = 1
+        """)
+        assert findings == []
+
+
+# ------------------------------------------------------------ nondeterminism
+
+class TestNondeterminism:
+    def test_positive_global_rng_call_and_value(self):
+        findings = lint("""
+            import numpy as np
+
+            def sample(rng=None):
+                rng = rng or np.random
+                return np.random.uniform(0.0, 1.0)
+        """)
+        assert rules_of(findings) == ["nondeterminism", "nondeterminism"]
+        assert "RNG object" in findings[0].message
+        assert "numpy.random.uniform" in findings[1].message
+
+    def test_positive_stdlib_random(self):
+        findings = lint("""
+            import random
+
+            def jitter():
+                return random.random()
+        """)
+        assert rules_of(findings) == ["nondeterminism"]
+
+    def test_positive_aliased_imports_still_caught(self):
+        """`import random as r` must not smuggle the global RNG past the
+        pass (resolve_chain roots at real imports, aliases included)."""
+        findings = lint("""
+            import random as r
+            import numpy as xp
+
+            def jitter():
+                return r.uniform(0, 1) + xp.random.rand()
+        """)
+        assert rules_of(findings) == ["nondeterminism", "nondeterminism"]
+
+    def test_negative_local_variable_named_random(self):
+        findings = lint("""
+            def f(random):
+                return random.choice([1, 2])  # a param, not the module
+        """)
+        assert findings == []
+
+    def test_negative_seeded_streams(self):
+        findings = lint("""
+            import random
+            import numpy as np
+
+            def make(seed):
+                a = np.random.RandomState(seed)
+                b = np.random.default_rng(seed)
+                c = random.Random(seed)
+                return a.uniform(), b.uniform(), c.random()
+        """)
+        assert findings == []
+
+
+# ------------------------------------------------------------- dtype-pitfall
+
+class TestDtypePitfall:
+    def test_positive_device_dir(self):
+        findings = lint_source(textwrap.dedent("""
+            import numpy as np
+
+            def init(n):
+                mask = np.zeros(n)
+                fill = np.full((n, n), 0.5)
+                acc = np.float64
+                return mask, fill, acc
+        """), "distributed_reinforcement_learning_tpu/ops/fake.py")
+        assert rules_of(findings) == ["dtype-pitfall"] * 3
+        assert [f.line for f in findings] == [5, 6, 7]
+
+    def test_positive_inside_traced_function(self):
+        findings = lint("""
+            import numpy as np
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x + np.ones(3)
+        """)
+        assert rules_of(findings) == ["dtype-pitfall"]
+
+    def test_negative_explicit_dtype_and_host_code(self):
+        findings = lint_source(textwrap.dedent("""
+            import numpy as np
+            import jax.numpy as jnp
+
+            def init(n):
+                a = np.zeros(n, np.float32)
+                b = np.full((n,), 0.5, dtype=np.float32)
+                c = jnp.zeros((n,))   # jnp default is float32: fine
+                return a, b, c
+        """), "distributed_reinforcement_learning_tpu/models/fake.py")
+        assert findings == []
+        host = lint_source(
+            "import numpy as np\n\ndef f(n):\n    return np.zeros(n)\n",
+            "distributed_reinforcement_learning_tpu/envs/fake_sim.py")
+        assert host == []  # host simulator dirs are out of scope
+
+
+# -------------------------------------------------- suppressions & baseline
+
+class TestSuppressionsAndBaseline:
+    SRC = """
+        import numpy as np
+
+        def a():
+            return np.random.uniform()  # drlint: disable=nondeterminism
+
+        def b():
+            # drlint: disable=nondeterminism
+            return np.random.uniform()
+
+        def c():
+            return np.random.uniform()
+    """
+
+    def test_inline_and_previous_line_suppression(self):
+        findings = lint(self.SRC)
+        assert rules_of(findings) == ["nondeterminism"]
+        assert findings[0].context == "c"
+
+    def test_wrong_rule_id_does_not_suppress(self):
+        findings = lint("""
+            import numpy as np
+
+            def f():
+                return np.random.uniform()  # drlint: disable=host-sync
+        """)
+        assert rules_of(findings) == ["nondeterminism"]
+
+    def test_baseline_round_trip(self, tmp_path):
+        findings = lint(self.SRC)
+        assert len(findings) == 1
+        path = tmp_path / "baseline.json"
+        write_baseline(findings, str(path), justification="fixture: known global RNG use")
+        baseline = Baseline.load(str(path))
+        new, old, stale = baseline.split(lint(self.SRC))
+        assert new == [] and len(old) == 1 and stale == []
+        # A different finding is NOT absorbed by the baseline.
+        other = lint("""
+            import numpy as np
+
+            def d():
+                return np.random.uniform()
+        """)
+        new2, _, stale2 = baseline.split(other)
+        assert len(new2) == 1 and len(stale2) == 1  # and the entry is stale
+
+    def test_baseline_match_field_narrows_entry(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps({"entries": [{
+            "rule": "host-sync",
+            "path": HOT_PATH,
+            "context": "Learner.train",
+            "match": "float()",
+            "justification": "fixture: grandfathered metrics float",
+        }]}))
+        baseline = Baseline.load(str(path))
+        findings = lint_source(textwrap.dedent("""
+            class Learner:
+                def train(self):
+                    x = float(self.m["loss"])
+                    return self.m["v"].item()
+        """), HOT_PATH)
+        new, old, _ = baseline.split(findings)
+        assert ["float()" in f.message for f in old] == [True]
+        assert [".item()" in f.message for f in new] == [True]
+
+    def test_baseline_cap_and_justification_enforced(self, tmp_path):
+        over = {"entries": [
+            {"rule": "host-sync", "path": "p.py", "context": f"f{i}",
+             "justification": "long enough justification"}
+            for i in range(BASELINE_MAX_ENTRIES + 1)]}
+        path = tmp_path / "over.json"
+        path.write_text(json.dumps(over))
+        with pytest.raises(BaselineError, match="cap"):
+            Baseline.load(str(path))
+        lazy = {"entries": [{"rule": "host-sync", "path": "p.py",
+                             "context": "f", "justification": "meh"}]}
+        path2 = tmp_path / "lazy.json"
+        path2.write_text(json.dumps(lazy))
+        with pytest.raises(BaselineError, match="justification"):
+            Baseline.load(str(path2))
+
+
+# --------------------------------------------------------------- CLI + gate
+
+class TestCliAndTreeGate:
+    def test_cli_json_output_and_exit_codes(self, tmp_path):
+        bad = tmp_path / "mod.py"
+        bad.write_text("import numpy as np\n\ndef f():\n    return np.random.rand()\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", "--json", "--no-baseline",
+             str(bad)],
+            capture_output=True, text=True, cwd=REPO, timeout=60)
+        assert proc.returncode == 1, proc.stderr
+        out = json.loads(proc.stdout)
+        assert [f["rule"] for f in out["findings"]] == ["nondeterminism"]
+        good = tmp_path / "ok.py"
+        good.write_text("def f():\n    return 1\n")
+        proc2 = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", str(good)],
+            capture_output=True, text=True, cwd=REPO, timeout=60)
+        assert proc2.returncode == 0, proc2.stderr
+
+    def test_syntax_error_fails_the_gate(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def f(:\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", str(bad)],
+            capture_output=True, text=True, cwd=REPO, timeout=60)
+        assert proc.returncode == 2
+        assert "SyntaxError" in proc.stderr
+
+    def test_tree_gate_is_cwd_independent(self, tmp_path, monkeypatch):
+        """Finding paths are repo-relative regardless of the process CWD,
+        so baseline matching works when pytest runs from anywhere."""
+        monkeypatch.chdir(tmp_path)
+        findings, errors = lint_paths([str(PKG)])
+        assert errors == []
+        assert all(f.path.startswith("distributed_reinforcement_learning_tpu/")
+                   for f in findings), [f.path for f in findings][:3]
+        new, _, stale = Baseline.load(str(BASELINE)).split(findings)
+        assert new == [] and stale == []
+
+    def test_shipped_tree_is_clean(self):
+        """THE tier-1 gate: zero non-baselined findings over the package.
+
+        If this fails after your change: fix the finding, or suppress
+        inline with a justifying comment — growing the baseline is the
+        last resort and capped at 10 (docs/static_analysis.md)."""
+        findings, errors = lint_paths([str(PKG)])
+        assert errors == [], errors
+        baseline = Baseline.load(str(BASELINE))
+        new, old, stale = baseline.split(findings)
+        assert new == [], "non-baselined drlint findings:\n" + "\n".join(
+            f.render() for f in new)
+        assert stale == [], f"stale baseline entries (remove them): {stale}"
+        assert len(baseline.entries) <= BASELINE_MAX_ENTRIES
+
+    def test_guarded_by_annotations_present(self):
+        """The seven threaded modules keep their concurrency maps — the
+        annotations double as documentation (ISSUE 2 satellite) and
+        deleting one silently disables the race check for that class."""
+        expected = {
+            "runtime/transport.py": 2,   # TransportServer + TransportClient
+            "runtime/weights.py": 1,
+            "runtime/publishing.py": 1,  # empty-map documentation form
+            "runtime/inference.py": 1,
+            "data/fifo.py": 1,
+            "data/replay.py": 3,         # Native/Array backends + doc note
+            "data/native.py": 1,
+        }
+        for rel, want in expected.items():
+            src = (PKG / rel).read_text()
+            got = src.count("_GUARDED_BY")
+            assert got >= want, f"{rel}: {got} _GUARDED_BY maps, want >= {want}"

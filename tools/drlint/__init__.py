@@ -1,0 +1,32 @@
+"""drlint: repo-native static analysis for the TPU RL stack.
+
+Five stdlib-`ast` passes encode the invariants the paper's architecture
+depends on but nothing previously enforced (docs/static_analysis.md has
+the full catalog and workflow):
+
+- ``jit-purity``       no host side effects inside traced (jit/pmap/
+                       shard_map/lax-control-flow) functions
+- ``host-sync``        no hidden device syncs inside the learner/actor
+                       step loops of ``runtime/``
+- ``lock-discipline``  attributes declared in a class's ``_GUARDED_BY``
+                       map are only touched under the matching lock
+- ``nondeterminism``   no module-level ``random``/``np.random`` RNG in
+                       library code (seeded generators are fine)
+- ``dtype-pitfall``    no dtype-less numpy constructors / ``np.float64``
+                       on device-bound paths (silently breaks bf16)
+
+Run ``python -m tools.drlint <paths>`` (see ``scripts/drlint.sh``), or
+use :func:`lint_paths` / :func:`lint_source` from tests. Pure stdlib:
+importing this package must never pull in jax/numpy — it has to run in
+a bare CI interpreter in well under a second.
+"""
+
+from tools.drlint.core import (  # noqa: F401
+    Baseline,
+    BaselineError,
+    Finding,
+    lint_paths,
+    lint_source,
+    write_baseline,
+)
+from tools.drlint.rules import RULES  # noqa: F401

@@ -1,0 +1,111 @@
+"""CLI: `python -m tools.drlint <paths>` (scripts/drlint.sh wraps this).
+
+Exit codes: 0 = clean (after baseline), 1 = non-baselined findings,
+2 = usage / parse / baseline-format error. The default baseline is
+tools/drlint/baseline.json when it exists; `--no-baseline` ignores it,
+`--write-baseline` regenerates it from the current findings (still
+subject to the 10-entry cap — fix findings, don't freeze them).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.drlint.core import Baseline, BaselineError, lint_paths, write_baseline
+from tools.drlint.rules import RULES
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.drlint",
+        description="Repo-native static analysis (see docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output on stdout")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rule ids to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} if present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline file and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in RULES:
+            print(name)
+        return 0
+    if not args.paths:
+        ap.error("no paths given")
+
+    rules = RULES
+    if args.rules:
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in wanted if r not in RULES]
+        if unknown:
+            ap.error(f"unknown rules: {', '.join(unknown)} "
+                     f"(have: {', '.join(RULES)})")
+        rules = {r: RULES[r] for r in wanted}
+
+    findings, errors = lint_paths(args.paths, rules)
+    if errors:
+        for e in errors:
+            print(f"drlint: error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+    if args.no_baseline:
+        baseline_path = None
+
+    if args.write_baseline:
+        target = args.baseline or DEFAULT_BASELINE
+        try:
+            write_baseline(findings, target)
+        except BaselineError as e:
+            print(f"drlint: {e}", file=sys.stderr)
+            return 2
+        print(f"drlint: wrote {len(findings)} finding(s) to {target} — "
+              f"fill in the justification fields", file=sys.stderr)
+        return 0
+
+    grandfathered: list = []
+    stale: list[dict] = []
+    if baseline_path is not None:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (BaselineError, OSError, json.JSONDecodeError) as e:
+            print(f"drlint: bad baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+        findings, grandfathered, stale = baseline.split(findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in findings],
+            "grandfathered": [f.__dict__ for f in grandfathered],
+            "stale_baseline_entries": stale,
+            "rules": list(rules),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        for e in stale:
+            print(f"drlint: warning: stale baseline entry {e['rule']} @ "
+                  f"{e['path']} ({e['context']}) — the finding is gone; "
+                  f"remove the entry", file=sys.stderr)
+        summary = (f"drlint: {len(findings)} finding(s)"
+                   f" ({len(grandfathered)} baselined)")
+        print(summary, file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,276 @@
+"""drlint engine: module model, suppressions, baseline, runners.
+
+Deliberately stdlib-only (ast/json/re/dataclasses): the linter gates
+tier-1 and must cost milliseconds, not a jax import.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+# Inline suppression: `# drlint: disable=rule-a,rule-b` on the finding's
+# line, or on a comment-only line directly above it (useful when the
+# offending expression is long). Rule ids use the catalog's kebab-case.
+_SUPPRESS_RE = re.compile(r"#\s*drlint:\s*disable=([a-zA-Z0-9_,\- ]+)")
+
+# Grandfathered-findings cap: the baseline exists to land the linter on
+# an imperfect tree, not to become a second tree. Ten entries, each with
+# a human justification, is the hard ceiling (ISSUE 2 acceptance).
+BASELINE_MAX_ENTRIES = 10
+
+# Finding paths are REPO-relative (this file lives at tools/drlint/),
+# never CWD-relative: baseline entries and the path-scoped rules
+# (host-sync, dtype-pitfall) must match identically whether the linter
+# runs from the repo root, from pytest in a tmp dir, or from an IDE.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def repo_rel(path: str) -> str:
+    """Repo-relative forward-slash path; absolute for paths outside the
+    repo (fixture files in tmp dirs keep an unambiguous identity)."""
+    ap = os.path.abspath(path)
+    try:
+        rel = os.path.relpath(ap, _REPO_ROOT)
+    except ValueError:  # different drive (windows)
+        return ap.replace(os.sep, "/")
+    if rel == ".." or rel.startswith(".." + os.sep):
+        return ap.replace(os.sep, "/")
+    return rel.replace(os.sep, "/")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    context: str  # dotted class/function context ('' at module level)
+
+    def key(self) -> tuple[str, str, str]:
+        """Baseline identity: line numbers churn with every edit, so a
+        grandfathered finding is matched by (rule, path, context)."""
+        return (self.rule, self.path, self.context)
+
+    def render(self) -> str:
+        where = f" (in {self.context})" if self.context else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{where}"
+
+
+class BaselineError(RuntimeError):
+    """Malformed baseline file (over cap, missing justification, ...)."""
+
+
+@dataclass
+class Baseline:
+    entries: list[dict] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+        entries = raw.get("entries", raw) if isinstance(raw, dict) else raw
+        if not isinstance(entries, list):
+            raise BaselineError(f"{path}: expected a list of entries")
+        if len(entries) > BASELINE_MAX_ENTRIES:
+            raise BaselineError(
+                f"{path}: {len(entries)} entries exceeds the cap of "
+                f"{BASELINE_MAX_ENTRIES} — fix findings instead of "
+                f"growing the baseline")
+        for i, e in enumerate(entries):
+            for k in ("rule", "path", "context", "justification"):
+                if not isinstance(e.get(k), str):
+                    raise BaselineError(f"{path}: entry {i} missing '{k}'")
+            if "match" in e and not isinstance(e["match"], str):
+                raise BaselineError(f"{path}: entry {i} 'match' must be a string")
+            just = e["justification"].strip()
+            if len(just) < 10 or just.startswith("TODO"):
+                raise BaselineError(
+                    f"{path}: entry {i} ({e['rule']} @ {e['path']}) needs a "
+                    f"real justification, not {e['justification']!r}")
+        return cls(entries)
+
+    @staticmethod
+    def _matches(e: dict, f: Finding) -> bool:
+        # The optional `match` substring narrows an entry to specific
+        # findings inside its (rule, path, context) cell, so one
+        # grandfathered float() doesn't also forgive a future .item()
+        # added to the same function.
+        return ((e["rule"], e["path"], e["context"]) == f.key()
+                and e.get("match", "") in f.message)
+
+    def split(self, findings: list[Finding]) -> tuple[list[Finding], list[Finding], list[dict]]:
+        """-> (new, grandfathered, stale_entries)."""
+        new, old = [], []
+        hit: set[int] = set()
+        for f in findings:
+            idx = next((i for i, e in enumerate(self.entries)
+                        if self._matches(e, f)), None)
+            if idx is None:
+                new.append(f)
+            else:
+                hit.add(idx)
+                old.append(f)
+        stale = [e for i, e in enumerate(self.entries) if i not in hit]
+        return new, old, stale
+
+
+def write_baseline(findings: list[Finding], path: str,
+                   justification: str = "TODO: justify or fix") -> None:
+    """Emit a baseline skeleton for `findings` (dedup'd by key). The cap
+    still applies on write: a >10-finding tree must be fixed, not frozen."""
+    seen: dict = {}
+    for f in findings:
+        seen.setdefault(f.key(), {
+            "rule": f.rule, "path": f.path, "context": f.context,
+            "justification": justification,
+        })
+    entries = list(seen.values())
+    if len(entries) > BASELINE_MAX_ENTRIES:
+        raise BaselineError(
+            f"{len(entries)} distinct findings exceed the baseline cap of "
+            f"{BASELINE_MAX_ENTRIES}; fix some before grandfathering")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"entries": entries}, f, indent=2)
+        f.write("\n")
+
+
+class ModuleInfo:
+    """One parsed source file + the derived maps every rule shares."""
+
+    def __init__(self, src: str, path: str):
+        self.src = src
+        self.path = path.replace(os.sep, "/")
+        self.tree = ast.parse(src, filename=path)
+        self.lines = src.splitlines()
+        # Parent links + dotted context names, one walk for all rules.
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self._context_cache: dict[ast.AST, str] = {}
+        self.module_aliases = self._collect_aliases()
+        self.suppressions = self._collect_suppressions()
+        self._cache: dict[str, object] = {}  # cross-rule scratch (traced fns)
+
+    # -- aliases ---------------------------------------------------------
+    def _collect_aliases(self) -> dict[str, str]:
+        """Names this module binds to modules of interest:
+        `import numpy as np` -> {'np': 'numpy'}; `from jax import lax`
+        -> {'lax': 'jax.lax'}; `import random` -> {'random': 'random'}."""
+        out: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+        return out
+
+    def resolve_chain(self, node: ast.AST) -> str | None:
+        """Dotted module-level name of an attribute chain, aliases
+        resolved: `np.random.uniform` -> 'numpy.random.uniform',
+        `lax.scan` -> 'jax.lax.scan', `r.uniform` (after `import random
+        as r`) -> 'random.uniform'. None for non-static chains AND for
+        chains whose root name was never imported — a local variable
+        that happens to be called `time` or `random` must not resolve
+        to the stdlib module, and an *aliased* stdlib import must not
+        escape the rules that key on the canonical module name."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name) or node.id not in self.module_aliases:
+            return None
+        return ".".join([self.module_aliases[node.id], *reversed(parts)])
+
+    # -- context ---------------------------------------------------------
+    def context_of(self, node: ast.AST) -> str:
+        """Dotted enclosing class/function names ('Cls.meth')."""
+        if node in self._context_cache:
+            return self._context_cache[node]
+        names: list[str] = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                names.append(cur.name)
+            cur = self.parents.get(cur)
+        ctx = ".".join(reversed(names))
+        self._context_cache[node] = ctx
+        return ctx
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule=rule, path=self.path,
+                       line=getattr(node, "lineno", 0), message=message,
+                       context=self.context_of(node))
+
+    # -- suppressions ----------------------------------------------------
+    def _collect_suppressions(self) -> dict[int, set[str]]:
+        out: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            # A comment-only line suppresses the NEXT line; a trailing
+            # comment suppresses its own line.
+            target = i + 1 if line.lstrip().startswith("#") else i
+            out.setdefault(target, set()).update(rules)
+        return out
+
+    def suppressed(self, f: Finding) -> bool:
+        rules = self.suppressions.get(f.line, ())
+        return f.rule in rules or "all" in rules
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".") and d != "__pycache__")
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def lint_source(src: str, path: str = "<string>",
+                rules: dict | None = None) -> list[Finding]:
+    """Lint one source blob; suppression comments applied, no baseline."""
+    from tools.drlint.rules import RULES
+
+    mod = ModuleInfo(src, path)
+    findings: list[Finding] = []
+    for name, check in (rules or RULES).items():
+        for f in check(mod):
+            assert f.rule == name, (f.rule, name)
+            if not mod.suppressed(f):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_paths(paths: list[str], rules: dict | None = None
+               ) -> tuple[list[Finding], list[str]]:
+    """Lint files/trees -> (findings, errors). Unparseable files are
+    reported as errors, not silently skipped (a syntax error in a linted
+    module must fail the gate, not shrink its coverage)."""
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            findings.extend(lint_source(src, repo_rel(path), rules))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(f"{path}: {type(e).__name__}: {e}")
+    return findings, errors

@@ -1,0 +1,21 @@
+"""Rule registry: id -> check(ModuleInfo) -> list[Finding].
+
+Rule ids are the kebab-case names used in suppression comments
+(`# drlint: disable=<id>`) and baseline entries. Adding a rule = adding
+a module here + a catalog section in docs/static_analysis.md + a
+positive/negative fixture pair in tests/test_drlint.py.
+"""
+
+from tools.drlint.rules.dtype_pitfall import check as _dtype_pitfall
+from tools.drlint.rules.host_sync import check as _host_sync
+from tools.drlint.rules.jit_purity import check as _jit_purity
+from tools.drlint.rules.lock_discipline import check as _lock_discipline
+from tools.drlint.rules.nondeterminism import check as _nondeterminism
+
+RULES = {
+    "jit-purity": _jit_purity,
+    "host-sync": _host_sync,
+    "lock-discipline": _lock_discipline,
+    "nondeterminism": _nondeterminism,
+    "dtype-pitfall": _dtype_pitfall,
+}

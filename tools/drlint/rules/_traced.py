@@ -1,0 +1,156 @@
+"""Shared traced-function detection for jit-purity and dtype-pitfall.
+
+A function is *traced* when its body runs under a JAX tracer — so host
+side effects inside it fire at trace time (once, at a surprising
+moment) or not at all, and numpy defaults leak float64 into the graph.
+Detection is name-based and module-local:
+
+- decorated with a transform (`@jax.jit`, `@partial(jax.jit, ...)`,
+  `@jax.pmap`, `@shard_map(...)`, ...);
+- passed by name to a transform call (`jax.jit(self._step)`) or to a
+  lax control-flow HOF (`lax.scan(body, ...)`, `lax.while_loop(cond,
+  body, ...)`, `lax.cond(p, t, f)`, ...);
+- called (as `f(...)` or `self.f(...)`) from an already-traced function
+  in the same module, transitively — scan bodies that delegate to
+  helpers stay covered.
+
+Name matching is per-module and intentionally coarse (two classes
+sharing a method name both get marked); false positives are rare in
+practice and suppressible inline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import ModuleInfo
+
+# Transforms whose function argument (arg 0) is traced.
+_TRANSFORMS = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.pjit", "jax.experimental.pjit.pjit", "jax.checkpoint", "jax.remat",
+    "jax.experimental.shard_map.shard_map", "jax.shard_map",
+}
+# lax control-flow HOFs -> positions of the traced function arguments.
+_LAX_HOFS = {
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "switch": (1, 2, 3, 4), "map": (0,),
+    "associative_scan": (0,),
+}
+
+
+def _is_transform(mod: ModuleInfo, func: ast.AST) -> bool:
+    chain = mod.resolve_chain(func)
+    if chain is None:
+        return False
+    if chain in _TRANSFORMS:
+        return True
+    # `from jax import jit` resolves to 'jax.jit' already; catch other
+    # spellings like jax.experimental.* re-exports by suffix.
+    last = chain.rsplit(".", 1)[-1]
+    return chain.startswith("jax.") and last in (
+        "jit", "pmap", "pjit", "shard_map", "checkpoint", "remat")
+
+
+def _lax_fn_positions(mod: ModuleInfo, func: ast.AST) -> tuple[int, ...] | None:
+    chain = mod.resolve_chain(func)
+    if chain is None or not chain.startswith("jax."):
+        return None
+    head, _, last = chain.rpartition(".")
+    if head.endswith("lax") and last in _LAX_HOFS:
+        return _LAX_HOFS[last]
+    return None
+
+
+def _mark_fn_arg(node: ast.AST, names: set[str], lambdas: list[ast.Lambda]) -> None:
+    if isinstance(node, ast.Name):
+        names.add(node.id)
+    elif isinstance(node, ast.Attribute):  # jax.jit(self._step) et al.
+        names.add(node.attr)
+    elif isinstance(node, ast.Lambda):
+        lambdas.append(node)
+    elif isinstance(node, ast.Call):
+        # partial(body, ...) / ft.partial(self._step, k) passed to a HOF:
+        # the traced callable is the partial's first argument.
+        if node.args:
+            _mark_fn_arg(node.args[0], names, lambdas)
+
+
+def traced_roots(mod: ModuleInfo) -> tuple[list[ast.AST], set[str]]:
+    """-> (traced def/lambda nodes, traced function names). Cached on the
+    module so jit-purity and dtype-pitfall share one computation."""
+    cached = mod._cache.get("traced_roots")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+
+    names: set[str] = set()
+    lambdas: list[ast.Lambda] = []
+    defs: dict[str, list[ast.AST]] = {}
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+            for deco in node.decorator_list:
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                if _is_transform(mod, target):
+                    names.add(node.name)
+                elif (isinstance(deco, ast.Call) and deco.args
+                      and mod.resolve_chain(deco.func) in
+                      ("functools.partial", "partial")
+                      and _is_transform(mod, deco.args[0])):
+                    names.add(node.name)
+        elif isinstance(node, ast.Call):
+            if _is_transform(mod, node.func) and node.args:
+                _mark_fn_arg(node.args[0], names, lambdas)
+            else:
+                positions = _lax_fn_positions(mod, node.func)
+                if positions is not None:
+                    for i in positions:
+                        if i < len(node.args):
+                            _mark_fn_arg(node.args[i], names, lambdas)
+
+    # Transitive closure: helpers called from traced code are traced.
+    # Only same-module calls by bare name or self.<name> are followed.
+    while True:
+        added = False
+        for name in list(names):
+            for fn in defs.get(name, ()):
+                for sub in ast.walk(fn):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    callee = None
+                    if isinstance(sub.func, ast.Name):
+                        callee = sub.func.id
+                    elif (isinstance(sub.func, ast.Attribute)
+                          and isinstance(sub.func.value, ast.Name)
+                          and sub.func.value.id in ("self", "cls")):
+                        callee = sub.func.attr
+                    if callee and callee in defs and callee not in names:
+                        names.add(callee)
+                        added = True
+        if not added:
+            break
+
+    roots: list[ast.AST] = list(lambdas)
+    for name in names:
+        roots.extend(defs.get(name, ()))
+    result = (roots, names)
+    mod._cache["traced_roots"] = result
+    return result
+
+
+# Calls that legally wrap host side effects inside traced code: their
+# arguments execute on the host via the callback machinery.
+_CALLBACK_CHAINS = ("jax.debug.", "jax.experimental.io_callback",
+                    "jax.pure_callback", "jax.experimental.host_callback")
+
+
+def is_callback_wrapped(mod: ModuleInfo, node: ast.AST) -> bool:
+    cur = mod.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call):
+            chain = mod.resolve_chain(cur.func) or ""
+            if chain.startswith(_CALLBACK_CHAINS):
+                return True
+        cur = mod.parents.get(cur)
+    return False

@@ -1,0 +1,83 @@
+"""dtype-pitfall: no dtype-less numpy constructors on device-bound paths.
+
+numpy defaults to float64. On TPU that either x64-truncates with a
+warning or — worse, with jax_enable_x64 — silently doubles every
+downstream buffer and knocks matmuls off the bf16 MXU fast path. The
+rule covers the code whose arrays feed devices:
+
+- everything under `agents/`, `ops/`, `models/`, `parallel/`;
+- any traced function anywhere (rules/_traced.py), since an np array
+  materialized inside a trace becomes a baked-in constant.
+
+Flags `np.zeros/ones/empty/full` without an explicit dtype, and any
+`np.float64` reference in scope (an explicit float64 on a device path
+is the same pitfall spelled confidently). Host-side bookkeeping (the
+replay tree's float64 priorities, env simulators) lives outside the
+scoped directories on purpose.
+
+`jnp.*` constructors are NOT flagged: their default is float32, which
+is exactly the intended device default.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Finding, ModuleInfo
+from tools.drlint.rules._traced import traced_roots
+
+RULE = "dtype-pitfall"
+
+_DEVICE_DIRS = ("/agents/", "/ops/", "/models/", "/parallel/")
+# dtype position among positional args: zeros/ones/empty take (shape,
+# dtype); full takes (shape, fill_value, dtype).
+_CTORS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2}
+
+
+def _has_dtype(node: ast.Call, pos: int) -> bool:
+    return len(node.args) > pos or any(k.arg == "dtype" for k in node.keywords)
+
+
+def _check_node(mod: ModuleInfo, node: ast.AST) -> Finding | None:
+    if isinstance(node, ast.Call):
+        chain = mod.resolve_chain(node.func)
+        if chain and chain.startswith("numpy."):
+            name = chain.rsplit(".", 1)[-1]
+            if name in _CTORS and not _has_dtype(node, _CTORS[name]):
+                return mod.finding(
+                    RULE, node,
+                    f"dtype-less `np.{name}` defaults to float64 on a "
+                    f"device-bound path — pass an explicit dtype")
+    elif isinstance(node, ast.Attribute):
+        if mod.resolve_chain(node) == "numpy.float64" and \
+                not isinstance(mod.parents.get(node), ast.Attribute):
+            return mod.finding(
+                RULE, node,
+                "np.float64 on a device-bound path breaks bf16/f32 "
+                "compute — use the model dtype or float32")
+    return None
+
+
+def check(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[int, int]] = set()
+
+    def emit(node: ast.AST) -> None:
+        f = _check_node(mod, node)
+        if f is not None:
+            pos = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+            if pos not in seen:
+                seen.add(pos)
+                findings.append(f)
+
+    if any(d in f"/{mod.path}" for d in _DEVICE_DIRS):
+        for node in ast.walk(mod.tree):
+            emit(node)
+    else:
+        roots, _ = traced_roots(mod)
+        for root in roots:
+            body = root.body if isinstance(root.body, list) else [root.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    emit(node)
+    return findings

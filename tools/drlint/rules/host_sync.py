@@ -1,0 +1,98 @@
+"""host-sync: no hidden device syncs inside runtime step loops.
+
+The throughput argument of the whole stack (free-running learner,
+pipelined dispatch, K-step publish cadence — docs/performance.md) dies
+the moment a step loop blocks on a device value: one stray `.item()`
+turns overlapped dispatch back into lockstep. TorchBeast-style eager
+stacks accumulate exactly these. Scope (by construction, not
+convention): `runtime/*_runner.py` and `runtime/anakin*.py`, inside the
+named hot-loop functions only.
+
+Hot functions:
+- actor loops  — ``run_unroll``, ``run_steps``
+- learner loops — ``step``, ``train``, ``ingest``, ``ingest_many``,
+  ``ingest_batch``, ``train_chunk``, ``collect_chunk``
+
+Flagged in BOTH: `.item()`, `jax.device_get`, `.block_until_ready()` —
+unambiguous blocking syncs.
+
+Flagged in LEARNER loops only: `np.asarray(...)` and `float(...)` /
+`int(...)` on non-constants. The actor's act→env boundary is a host
+boundary by design (actions must reach a host env), so asarray there is
+the idiom, not a bug; on the learner thread every one of these stalls
+the dispatch pipeline and must be either removed or explicitly
+justified with an inline suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+import posixpath
+
+from tools.drlint.core import Finding, ModuleInfo
+
+RULE = "host-sync"
+
+ACTOR_HOT = {"run_unroll", "run_steps"}
+LEARNER_HOT = {"step", "train", "ingest", "ingest_many", "ingest_batch",
+               "train_chunk", "collect_chunk"}
+
+
+def in_scope(path: str) -> bool:
+    base = posixpath.basename(path)
+    return "runtime/" in path and (base.endswith("_runner.py")
+                                   or base.startswith("anakin"))
+
+
+def _check_node(mod: ModuleInfo, node: ast.AST, learner: bool) -> Finding | None:
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "item" and not node.args:
+            return mod.finding(RULE, node,
+                               ".item() blocks on the device inside a hot loop")
+        if func.attr == "block_until_ready":
+            return mod.finding(RULE, node,
+                               "block_until_ready() inside a hot loop "
+                               "serializes the dispatch pipeline")
+    chain = mod.resolve_chain(func)
+    if chain in ("jax.device_get", "jax.block_until_ready"):
+        return mod.finding(RULE, node,
+                           f"`{chain}` blocks on the device inside a hot loop")
+    if not learner:
+        return None
+    if chain == "numpy.asarray":
+        return mod.finding(RULE, node,
+                           "np.asarray() on the learner thread is a D2H "
+                           "sync; move it off the step path or justify it")
+    if isinstance(func, ast.Name) and func.id in ("float", "int") and node.args:
+        arg = node.args[0]
+        if not isinstance(arg, ast.Constant):
+            return mod.finding(RULE, node,
+                               f"{func.id}() on a runtime value forces a "
+                               f"device sync when the value is a device "
+                               f"array; hoist it off the learn loop or "
+                               f"justify it")
+    return None
+
+
+def check(mod: ModuleInfo) -> list[Finding]:
+    if not in_scope(mod.path):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in LEARNER_HOT:
+            learner = True
+        elif node.name in ACTOR_HOT:
+            learner = False
+        else:
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                f = _check_node(mod, sub, learner)
+                if f is not None:
+                    findings.append(f)
+    return findings

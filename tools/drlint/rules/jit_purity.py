@@ -1,0 +1,87 @@
+"""jit-purity: no host side effects inside traced functions.
+
+The on-device story (PAPER.md; Podracer's hot-loop ban on host
+round-trips) only holds if jitted/scanned code is pure: a `time.time()`
+or `print` inside a traced function runs once at trace time — silently
+wrong — and module-level RNG inside a trace bakes one draw into the
+compiled graph forever. Flags, inside any traced function (see
+rules/_traced.py for how "traced" is decided):
+
+- host clock reads (`time.time/perf_counter/monotonic/...`)
+- builtin `print` (use `jax.debug.print`, which is trace-legal)
+- stdlib `random.*` and `numpy.random.*` calls (thread JAX PRNG keys)
+- `global` statements (trace-time global mutation)
+
+Host calls wrapped in `jax.debug.*` / `io_callback` / `pure_callback`
+are exempt: that machinery exists precisely to host-execute them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Finding, ModuleInfo
+from tools.drlint.rules._traced import is_callback_wrapped, traced_roots
+
+RULE = "jit-purity"
+
+_CLOCKS = {
+    "time.time", "time.time_ns", "time.perf_counter", "time.perf_counter_ns",
+    "time.monotonic", "time.monotonic_ns", "time.process_time", "time.sleep",
+}
+# numpy.random constructors that *return seeded generators* are fine to
+# call even at trace time setup; everything else is a hidden host draw.
+_SEEDED_CTORS = {"RandomState", "Generator", "default_rng", "SeedSequence",
+                 "PCG64", "Philox", "MT19937"}
+
+
+def _check_call(mod: ModuleInfo, node: ast.Call) -> Finding | None:
+    if isinstance(node.func, ast.Name) and node.func.id == "print":
+        return mod.finding(RULE, node,
+                           "print() inside traced code runs at trace time "
+                           "only — use jax.debug.print")
+    # resolve_chain only resolves through real imports (aliases
+    # included), so `import time as _t; _t.time()` is caught and a
+    # local variable named `time` is not.
+    chain = mod.resolve_chain(node.func)
+    if chain is None:
+        return None
+    if chain in _CLOCKS:
+        return mod.finding(RULE, node,
+                           f"host clock `{chain}` inside traced code is "
+                           f"evaluated once at trace time")
+    if chain.startswith("numpy.random.") and \
+            chain.rsplit(".", 1)[-1] not in _SEEDED_CTORS:
+        return mod.finding(RULE, node,
+                           f"`{chain}` inside traced code bakes one host "
+                           f"draw into the compiled graph — thread a JAX "
+                           f"PRNG key instead")
+    if chain.startswith("random."):
+        return mod.finding(RULE, node,
+                           f"stdlib `{chain}` inside traced code — thread "
+                           f"a JAX PRNG key instead")
+    return None
+
+
+def check(mod: ModuleInfo) -> list[Finding]:
+    roots, _ = traced_roots(mod)
+    findings: list[Finding] = []
+    seen: set[tuple[int, int]] = set()  # roots may nest (decorated + called)
+    for root in roots:
+        body = root.body if isinstance(root.body, list) else [root.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                pos = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+                if pos in seen:
+                    continue
+                f = None
+                if isinstance(node, ast.Call):
+                    f = _check_call(mod, node)
+                elif isinstance(node, ast.Global):
+                    f = mod.finding(RULE, node,
+                                    "`global` inside traced code mutates "
+                                    "host state at trace time")
+                if f is not None and not is_callback_wrapped(mod, node):
+                    seen.add(pos)
+                    findings.append(f)
+    return findings

@@ -1,0 +1,124 @@
+"""lock-discipline: `_GUARDED_BY`-annotated attributes need their lock.
+
+The GuardedBy race check (the TF graph runtime used to police shared
+state for free; the threaded Python runtime has nothing but convention).
+A class opts in by declaring, at class level:
+
+    _GUARDED_BY = {
+        "stats": "_stats_lock",              # one lock
+        "_items": ("_lock", "_not_empty"),   # any of several aliases
+    }
+
+Every `self.<attr>` touch (read OR write — torn reads of dicts/tuples
+under mutation are the races transport.py actually had) of a declared
+attribute must then be lexically inside `with self.<lock>:` for one of
+the declared lock names. Conditions constructed over a lock are listed
+as aliases, as fifo.TrajectoryQueue does.
+
+Escapes, by convention (docs/static_analysis.md):
+- `__init__`/`__del__` are exempt (construction happens-before any
+  other thread; destruction happens-after).
+- methods whose name ends in `_locked` are exempt — the suffix is the
+  repo's caller-holds-the-lock contract.
+- nested functions/lambdas inherit the lexically held set (a
+  `wait_for(lambda: ...)` inside a `with` is covered; a closure that
+  escapes the lock's scope is on the author — suppress inline and say
+  why).
+
+The check is lexical and per-class: accesses through other names
+(`server.stats` from a module function) are out of scope, exactly like
+Java's @GuardedBy.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Finding, ModuleInfo
+
+RULE = "lock-discipline"
+
+_EXEMPT = {"__init__", "__del__"}
+
+
+def _literal_guards(value: ast.AST) -> dict[str, frozenset[str]] | None:
+    if not isinstance(value, ast.Dict):
+        return None
+    out: dict[str, frozenset[str]] = {}
+    for k, v in zip(value.keys, value.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            locks = frozenset({v.value})
+        elif isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in v.elts):
+            locks = frozenset(e.value for e in v.elts)
+        else:
+            return None
+        out[k.value] = locks
+    return out
+
+
+def _class_guards(cls: ast.ClassDef) -> dict[str, frozenset[str]] | None:
+    for stmt in cls.body:
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            target = stmt.targets[0].id
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            target = stmt.target.id
+        if target == "_GUARDED_BY":
+            return _literal_guards(stmt.value)
+    return None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _walk(mod: ModuleInfo, node: ast.AST, held: frozenset[str],
+          guards: dict[str, frozenset[str]], out: list[Finding]) -> None:
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        acquired = set()
+        for item in node.items:
+            _walk(mod, item.context_expr, held, guards, out)
+            name = _self_attr(item.context_expr)
+            if name:
+                acquired.add(name)
+            if item.optional_vars is not None:
+                _walk(mod, item.optional_vars, held, guards, out)
+        inner = held | frozenset(acquired)
+        for stmt in node.body:
+            _walk(mod, stmt, inner, guards, out)
+        return
+    attr = _self_attr(node)
+    if attr is not None and attr in guards and not (held & guards[attr]):
+        locks = "/".join(sorted(guards[attr]))
+        out.append(mod.finding(
+            RULE, node,
+            f"self.{attr} touched without holding self.{locks} "
+            f"(declared in _GUARDED_BY)"))
+    for child in ast.iter_child_nodes(node):
+        _walk(mod, child, held, guards, out)
+
+
+def check(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guards = _class_guards(cls)
+        if not guards:
+            continue
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if method.name in _EXEMPT or method.name.endswith("_locked"):
+                continue
+            for stmt in method.body:
+                _walk(mod, stmt, frozenset(), guards, findings)
+    return findings

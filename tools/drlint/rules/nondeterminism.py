@@ -1,0 +1,66 @@
+"""nondeterminism: no module-level RNG in library code.
+
+Reproducibility across the distributed topology requires every random
+stream to be owned and seeded: JAX keys threaded explicitly, numpy via
+per-object `np.random.RandomState(seed)` / `default_rng(seed)`. The
+module-level `np.random.*` / stdlib `random.*` functions share ONE
+process-global state — any thread (a transport handler, the prefetch
+worker, a metrics pump) that touches it perturbs every other consumer's
+stream, so runs stop replaying the moment thread timing shifts.
+
+Flags:
+- calls through the global numpy RNG (`np.random.uniform(...)`) — the
+  seeded constructors (`RandomState`, `default_rng`, `Generator`, ...)
+  are the fix, not a violation;
+- the global RNG object used as a *value* (`rng = rng or np.random`) —
+  it aliases the same shared state through a polite name;
+- stdlib `random.*` calls (except constructing `random.Random(seed)` /
+  `random.SystemRandom()` instances).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Finding, ModuleInfo
+
+RULE = "nondeterminism"
+
+_SEEDED = {"RandomState", "Generator", "default_rng", "SeedSequence",
+           "PCG64", "Philox", "MT19937", "BitGenerator"}
+_STDLIB_OK = {"Random", "SystemRandom"}
+
+
+def check(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            chain = mod.resolve_chain(node.func)
+            if chain is None:
+                continue
+            if chain.startswith("numpy.random.") and \
+                    chain.rsplit(".", 1)[-1] not in _SEEDED:
+                findings.append(mod.finding(
+                    RULE, node,
+                    f"`{chain}` draws from the process-global numpy RNG — "
+                    f"use a seeded np.random.RandomState/default_rng owned "
+                    f"by the caller"))
+            elif chain.startswith("random.") and \
+                    chain.rsplit(".", 1)[-1] not in _STDLIB_OK:
+                # resolve_chain roots only at real imports, so this
+                # catches `import random as r; r.uniform()` and skips
+                # local variables that happen to be named `random`.
+                findings.append(mod.finding(
+                    RULE, node,
+                    f"stdlib `{chain}` uses the process-global RNG — seed "
+                    f"a random.Random(seed) instance instead"))
+        elif isinstance(node, ast.Attribute):
+            # The bare `np.random` object as a value (`rng or np.random`):
+            # parent-Attribute cases (np.random.X) are handled above.
+            if mod.resolve_chain(node) == "numpy.random" and \
+                    not isinstance(mod.parents.get(node), ast.Attribute):
+                findings.append(mod.finding(
+                    RULE, node,
+                    "the global `np.random` module used as an RNG object — "
+                    "pass a seeded np.random.RandomState/default_rng"))
+    return findings
