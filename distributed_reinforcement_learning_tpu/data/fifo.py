@@ -32,6 +32,24 @@ def stack_pytrees(items: list[Any]) -> Any:
     return jax.tree.map(lambda *xs: np.stack(xs), *items)
 
 
+def blob_ingest(queue: Any) -> tuple[Any, Any]:
+    """-> (prepare, put) for feeding CODEC BLOBS into a trajectory queue.
+
+    The single definition of blob-ingest semantics, shared by the TCP
+    transport server and the shm-ring drainer so the two transports
+    cannot drift: blob-native queues (`put_bytes`, the C++ backend) take
+    the raw bytes; pytree queues take a decoded COPY — the blob's buffer
+    may be reused or unmapped by the caller the moment `prepare` returns.
+    `put(item, timeout=...)` follows the queue's blocking-put contract
+    (False on timeout, RuntimeError once closed).
+    """
+    if hasattr(queue, "put_bytes"):
+        return (lambda blob: blob), queue.put_bytes
+    from distributed_reinforcement_learning_tpu.data import codec
+
+    return (lambda blob: codec.decode(blob, copy=True)), queue.put
+
+
 def put_round(queue: Any, items: list[Any]) -> None:
     """Ship one actor round (the N trajectories of an `extract()`) to a
     queue, batched when the queue supports it.

@@ -383,16 +383,18 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
                 # sum-tree, so the TD errors must materialize here.
                 self.replay.update_batch(idxs, np.asarray(td))  # drlint: disable=host-sync
         self._finish_train_call()
-        metrics = {k: float(v) for k, v in metrics.items()}
         if _OBS.enabled:
             _OBS.count("learner/train_steps", self.updates_per_call)
         self.timer.step_done(self.train_steps)
         self._profiler.on_step(self.train_steps)
-        self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
-        return metrics
+        # Off the learn thread: async mode hands the DEVICE arrays to the
+        # bounded MetricsPump (as the IMPALA learner does) instead of the
+        # old per-step float() sync; sync loops still get host floats.
+        return self.log_step_metrics(metrics)
 
     def close(self) -> None:
         self.flush_publish()
+        self.close_metrics()
         self._profiler.close()
 
 
@@ -415,4 +417,7 @@ def run_sync(learner: ApexLearner, actors: list[ApexActor], num_updates: int,
         if close_learner:
             learner.close()
     returns = [r for a in actors for r in a.episode_returns]
+    # Under async metrics `metrics` may hold device arrays (the pump owns
+    # materialization); the public result is always host floats.
+    metrics = {k: float(v) for k, v in metrics.items()}
     return {"frames": frames, "last_metrics": metrics, "episode_returns": returns}

@@ -210,8 +210,7 @@ class ImpalaLearner(PublishCadenceMixin):
         self.frames_learned = 0
         self.timer = StageTimer(self.logger)
         self._profiler = ProfilerSession.from_env()
-        self._metrics_pump = None  # lazy: free-running async-metrics path
-        weights.publish(self.state.params, 0)
+        weights.publish(self.state.params, 0)  # pump is mixin-lazy
 
     def save_checkpoint(self, ckpt) -> None:
         """Persist TrainState + host counters (the checkpoint the reference
@@ -293,24 +292,11 @@ class ImpalaLearner(PublishCadenceMixin):
             # after it is free). With async publication the float() here
             # would become the learn thread's only device sync — so the
             # free-running path hands the DEVICE arrays to the bounded
-            # MetricsPump instead (the pump's depth still caps how far
-            # ahead the host loop can dispatch). Sync loops keep the
-            # blocking float: it doubles as their pipelining bound.
-            from distributed_reinforcement_learning_tpu.runtime.publishing import (
-                MetricsPump, _async_metrics)
-
-            if _async_metrics(self.sync_publish):
-                if self._metrics_pump is None:
-                    self._metrics_pump = MetricsPump(self.logger)
-                with self.timer.stage("metrics_sync"):
-                    self._metrics_pump.submit(dict(metrics), self.train_steps)
-            else:
-                with self.timer.stage("metrics_sync"):
-                    # Deliberate sync path (async metrics off): the float
-                    # doubles as the sync loop's pipelining bound.
-                    metrics = {k: float(v) for k, v in metrics.items()}  # drlint: disable=host-sync
-                self.logger.add_scalars(
-                    {f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
+            # MetricsPump (the pump's depth still caps how far ahead the
+            # host loop can dispatch); sync loops keep the blocking
+            # float, which doubles as their pipelining bound. One
+            # definition for all learners: PublishCadenceMixin.
+            metrics = self.log_step_metrics(metrics)
         # Non-publish steps return the metrics as DEVICE arrays and log
         # nothing: forcing a float() here would block on the step and
         # defeat the whole point of the interval (letting K device steps
@@ -325,8 +311,7 @@ class ImpalaLearner(PublishCadenceMixin):
 
         Called by every run path (run_sync/run_async/run_role) on exit."""
         self.flush_publish()
-        if self._metrics_pump is not None:
-            self._metrics_pump.close()  # drain pending log lines
+        self.close_metrics()  # drain pending pump log lines
         if self._prefetcher is not None:
             self._prefetcher.close()
         self._profiler.close()

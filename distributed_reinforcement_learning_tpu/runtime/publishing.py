@@ -112,6 +112,9 @@ class PublishCadenceMixin:
     # actors interleave on one thread, so async publication buys nothing
     # and only makes the weight-staleness sequence nondeterministic.
     sync_publish = False
+    # Lazily-created MetricsPump (free-running async-metrics path); the
+    # class default keeps __init__-less adoption safe across learners.
+    _metrics_pump = None
     # Step count at the last publish. Cadence is "at least every
     # `publish_interval` steps since the last publish", NOT a modulo on
     # train_steps: learners advancing in strides (updates_per_call K, or
@@ -163,6 +166,33 @@ class PublishCadenceMixin:
             _OBS.gauge("publish/latency_ms", (time.perf_counter() - t0) * 1e3)
             _OBS.count("publish/count")
         return True
+
+    def log_step_metrics(self, metrics: dict) -> dict:
+        """Per-train-step metrics to the logger WITHOUT stalling the learn
+        thread (the replay learners' old unconditional `float()` per step
+        was a per-step device sync — the two grandfathered drlint
+        baseline entries this method retired). Async mode hands the
+        DEVICE arrays to the bounded MetricsPump, which floats + logs
+        them on its worker (the returned dict stays un-materialized);
+        sync mode floats inline — that deliberate device sync doubles as
+        the sync loop's pipelining bound, exactly like ImpalaLearner's —
+        and logs host floats."""
+        if _async_metrics(self.sync_publish):
+            if self._metrics_pump is None:
+                self._metrics_pump = MetricsPump(self.logger)
+            with self.timer.stage("metrics_sync"):
+                self._metrics_pump.submit(dict(metrics), self.train_steps)
+            return metrics
+        with self.timer.stage("metrics_sync"):
+            metrics = {k: float(v) for k, v in metrics.items()}
+        self.logger.add_scalars(
+            {f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
+        return metrics
+
+    def close_metrics(self) -> None:
+        """Drain any pending pump lines at close() (safe when unused)."""
+        if self._metrics_pump is not None:
+            self._metrics_pump.close()
 
     def flush_publish(self) -> None:
         """close()-time flush: any updates since the last publish would

@@ -363,16 +363,18 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
                 # sum-tree, so the priorities must materialize here.
                 self.replay.update_batch(idxs, np.asarray(priorities))  # drlint: disable=host-sync
         self._finish_train_call()
-        metrics = {k: float(v) for k, v in metrics.items()}
         if _OBS.enabled:
             _OBS.count("learner/train_steps", self.updates_per_call)
         self.timer.step_done(self.train_steps)
         self._profiler.on_step(self.train_steps)
-        self.logger.add_scalars({f"learner/{k}": v for k, v in metrics.items()}, self.train_steps)
-        return metrics
+        # Off the learn thread: async mode hands the DEVICE arrays to the
+        # bounded MetricsPump (as the IMPALA learner does) instead of the
+        # old per-step float() sync; sync loops still get host floats.
+        return self.log_step_metrics(metrics)
 
     def close(self) -> None:
         self.flush_publish()
+        self.close_metrics()
         self._profiler.close()
 
 
@@ -393,4 +395,7 @@ def run_sync(learner: R2D2Learner, actors: list[R2D2Actor], num_updates: int,
         if close_learner:
             learner.close()
     returns = [r for a in actors for r in a.episode_returns]
+    # Under async metrics `metrics` may hold device arrays (the pump owns
+    # materialization); the public result is always host floats.
+    metrics = {k: float(v) for k, v in metrics.items()}
     return {"frames": frames, "last_metrics": metrics, "episode_returns": returns}

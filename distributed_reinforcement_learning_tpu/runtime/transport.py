@@ -32,6 +32,7 @@ from typing import Any
 import numpy as np
 
 from distributed_reinforcement_learning_tpu.data import codec
+from distributed_reinforcement_learning_tpu.data.fifo import blob_ingest
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 from distributed_reinforcement_learning_tpu.observability import maybe_configure
 from distributed_reinforcement_learning_tpu.observability.metrics import stale_bucket
@@ -357,8 +358,8 @@ class TransportServer(_LockedStatsMixin):
         stop() from being ignored by a handler parked in queue.put (the
         socket close only interrupts recv, not a queue wait)."""
         deadline = time.monotonic() + total_wait
-        raw = hasattr(self.queue, "put_bytes")
-        item = payload if raw else codec.decode(payload, copy=True)
+        prepare, put = blob_ingest(self.queue)
+        item = prepare(payload)
         # Timed region = the put loop ONLY (decode above is excluded):
         # this gauge quantifies backpressure, and conflating it with
         # deserialization cost would corrupt the ring-vs-socket decision
@@ -369,9 +370,7 @@ class TransportServer(_LockedStatsMixin):
                 slice_t = min(0.5, deadline - time.monotonic())
                 if slice_t <= 0:
                     return False
-                ok = self.queue.put_bytes(item, timeout=slice_t) if raw else \
-                    self.queue.put(item, timeout=slice_t)
-                if ok:
+                if put(item, timeout=slice_t):
                     return True
             return False
         finally:
@@ -386,10 +385,10 @@ class TransportServer(_LockedStatsMixin):
         tail is NOT enqueued, so the client may safely resend it)."""
         deadline = time.monotonic() + total_wait
         blobs = unpack_batch(payload)
-        raw = hasattr(self.queue, "put_bytes")
+        prepare, put = blob_ingest(self.queue)
         accepted = 0
         for blob in blobs:
-            item = blob if raw else codec.decode(blob, copy=True)
+            item = prepare(blob)
             ok = False
             # Per-BLOB wait, same unit as _enqueue's single-PUT gauge
             # (decode above excluded): summing K blobs into one
@@ -399,9 +398,8 @@ class TransportServer(_LockedStatsMixin):
                 slice_t = min(0.5, deadline - time.monotonic())
                 if slice_t <= 0:
                     break
-                ok = self.queue.put_bytes(item, timeout=slice_t) if raw else \
-                    self.queue.put(item, timeout=slice_t)
-                if ok:
+                if put(item, timeout=slice_t):
+                    ok = True
                     break
             if _OBS.enabled:
                 _OBS.gauge("transport/enqueue_wait_ms",
@@ -804,6 +802,15 @@ def run_role(
     from distributed_reinforcement_learning_tpu.utils.logger import MetricsLogger
 
     agent_cfg, rt = load_config(config_path, section)
+    # Staleness-budget override (scripts/launch_local_cluster.py
+    # --staleness_budget): the launcher derives a publish cadence from
+    # the `learner/weight_staleness` semantics and exports it here,
+    # replacing the config section's fixed per-recipe default.
+    interval_env = os.environ.get("DRL_PUBLISH_INTERVAL")
+    if interval_env:
+        import dataclasses as _dc
+
+        rt = _dc.replace(rt, publish_interval=max(1, int(interval_env)))
 
     if mode == "learner":
         # Multi-chip / multi-host learner. parallel.distributed.initialize
@@ -913,6 +920,20 @@ def run_role(
         serve_port = rt.server_port + (jax.process_index() if multihost else 0)
         server = TransportServer(queue, weights, host="0.0.0.0", port=serve_port,
                                  inference=inference).start()
+        # Co-hosted actors' zero-copy data plane (runtime/shm_ring.py):
+        # the launcher names one ring per co-hosted actor; this side
+        # creates the segments and drains them into the same bounded
+        # queue the TCP server feeds. Failure leaves TCP-only operation.
+        ring_drainer = None
+        ring_names = [n for n in
+                      os.environ.get("DRL_SHM_RING_CREATE", "").split(",") if n]
+        if ring_names:
+            from distributed_reinforcement_learning_tpu.runtime import shm_ring
+
+            ring_drainer = shm_ring.serve_rings(ring_names, queue)
+            if ring_drainer is not None:
+                print(f"[learner] shm rings serving {len(ring_names)} "
+                      f"co-hosted actor(s)")
         # Run-wide telemetry (observability/): env-gated, off by default.
         # The data-plane signals the paper's argument turns on — queue
         # depth, weight version — are polled per flush, never on the
@@ -929,6 +950,14 @@ def run_role(
             for key in server.snapshot_stats():
                 _OBS.sample(f"transport/{key}",
                             lambda k=key: server.stat(k), kind="counter")
+            if ring_drainer is not None:
+                # The ring next to the TCP stats in obs_report: in-flight
+                # bytes (depth), drained unrolls/bytes as throughput.
+                _OBS.sample("ring/depth", ring_drainer.depth_bytes)
+                for key in ring_drainer.snapshot_stats():
+                    _OBS.sample(f"ring/{key}",
+                                lambda k=key: ring_drainer.stat(k),
+                                kind="counter")
         print(f"[learner] serving on :{serve_port}; training {num_updates} updates")
         try:
             _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
@@ -938,6 +967,8 @@ def run_role(
             learner.close()  # stop prefetch thread, flush open profiler trace
             queue.close()
             server.stop()
+            if ring_drainer is not None:
+                ring_drainer.stop()  # closes, unlinks the shm segments
             if inference is not None:
                 inference.stop()
             _OBS.close()  # final shard flush + trace terminator
@@ -961,8 +992,21 @@ def run_role(
             server_ip = rt.server_ip
             port = rt.server_port + int(os.environ.get("DRL_LEARNER_INDEX", "0"))
         client = TransportClient(server_ip, port)
+        # Zero-copy data plane for co-hosted actors: when the launcher
+        # named a ring for this task, trajectory PUTs become one memcpy
+        # into shared memory (control traffic stays on this TCP client).
+        # Attach failure or a mid-run ring death falls back to TCP.
+        actor_queue: Any = RemoteQueue(client)
+        ring_name = os.environ.get("DRL_SHM_RING_NAME")
+        if ring_name:
+            from distributed_reinforcement_learning_tpu.runtime import shm_ring
+
+            rq = shm_ring.attach_ring_queue(ring_name, client)
+            if rq is not None:
+                actor_queue = rq
+                print(f"[actor {task}] shm ring attached: {ring_name}")
         actor = launch.make_actor(
-            algo, agent_cfg, rt, task, RemoteQueue(client), RemoteWeights(client),
+            algo, agent_cfg, rt, task, actor_queue, RemoteWeights(client),
             seed=seed + 1 + task,
             remote_act=RemoteInference(client) if remote_act else None,
         )
@@ -974,6 +1018,11 @@ def run_role(
             for key in client.snapshot_stats():
                 _OBS.sample(f"actor/{key}", lambda k=key: client.stat(k),
                             kind="counter")
+            if hasattr(actor_queue, "snapshot_stats"):  # RingQueue only
+                for key in actor_queue.snapshot_stats():
+                    _OBS.sample(f"ring/{key}",
+                                lambda k=key: actor_queue.stat(k),
+                                kind="counter")
             _OBS.sample("actor/weight_version_held",
                         lambda: getattr(actor, "_version", -1))
         print(f"[actor {task}] connected to {server_ip}:{port}")
@@ -1019,6 +1068,8 @@ def run_role(
                     s["weight_version"] = getattr(actor, "_version", None)
                     print(f"[actor {task}] stats {s}", flush=True)
         finally:
+            if hasattr(actor_queue, "close"):  # RingQueue: release the shm map
+                actor_queue.close()
             client.close()
             _OBS.close()  # final shard flush + trace terminator
     else:

@@ -66,6 +66,13 @@ def main() -> None:
                    help="force a jax platform for the LEARNER (actors are cpu)")
     p.add_argument("--serve_inference", action="store_true")
     p.add_argument("--remote_act", action="store_true")
+    p.add_argument("--staleness_budget", type=int, default=None,
+                   help="bound the weight staleness actors can be observed "
+                        "at (in train steps, the unit of the "
+                        "learner/weight_staleness telemetry) by deriving "
+                        "publish_interval from it instead of the config "
+                        "section's fixed default; see docs/performance.md "
+                        "'Staleness budget'")
     args = p.parse_args()
 
     algo = args.algo or args.section.split("_")[0]
@@ -111,23 +118,77 @@ def main() -> None:
         # a stale export must not silently divert this run's shards.
         env["DRL_TELEMETRY_DIR"] = os.path.join(
             os.path.abspath(args.run_dir), "telemetry")
+    if args.staleness_budget is not None:
+        # Derivation from the learner/weight_staleness semantics (the
+        # histogram measures learner version minus the version each
+        # actor's connection last pulled, at queue ingest): cadence
+        # quantization contributes up to `publish_interval` steps, and
+        # the async-publish bounded-staleness flush
+        # (runtime/publishing.py) admits a worker lag of up to
+        # 3*publish_interval more — so the observable bound is
+        # ~4*publish_interval, and a budget of N steps buys interval
+        # N//4. See docs/performance.md "Staleness budget".
+        interval = max(1, args.staleness_budget // 4)
+        env["DRL_PUBLISH_INTERVAL"] = str(interval)
+        print(f"[cluster] staleness_budget {args.staleness_budget} -> "
+              f"publish_interval {interval} (cadence + 3x async-lag bound)",
+              file=sys.stderr)
+    # Everything this launcher spawns shares one host, so every
+    # actor/learner pair is co-hosted: wire one shm ring per actor
+    # (runtime/shm_ring.py) when rings are enabled — DRL_SHM_RING=1/0
+    # overrides, unset defers to the committed transport_compare verdict
+    # on x86-64 only. The gate is INLINED (mirroring
+    # shm_ring.ring_enabled, the canonical definition) because importing
+    # the package here pulls jax into the launcher parent — a measured
+    # ~2s tax on every launch just to read an env var and a JSON file.
+    ring_names: dict[int, str] = {}
+    gate = os.environ.get("DRL_SHM_RING", "").strip().lower()
+    if gate in ("1", "true", "yes", "on"):
+        use_rings = True
+    elif gate in ("0", "false", "no", "off"):
+        use_rings = False
+    else:
+        import json
+        import platform
+
+        use_rings = False
+        if platform.machine().lower() in ("x86_64", "amd64"):
+            try:
+                with open(os.path.join(REPO, "benchmarks",
+                                       "transport_verdict.json")) as f:
+                    use_rings = bool(json.load(f).get("auto_enable", False))
+            except (OSError, ValueError):
+                pass
+    if use_rings:
+        tag = os.urandom(4).hex()
+        ring_names = {task: f"drlring-{os.getpid()}-{tag}-{task}"
+                      for task in range(args.actors)}
+        print(f"[cluster] shm rings enabled for {args.actors} co-hosted "
+              f"actor(s)", file=sys.stderr)
     learners = []
     if args.learners > 1:
         env["DRL_COORDINATOR"] = f"localhost:{_free_port()}"
         env["DRL_NUM_PROCESSES"] = str(args.learners)
-        for pid in range(args.learners):
-            learners.append(spawn(
-                f"learner{pid}", learner_cmd,
-                {**env, "DRL_PROCESS_ID": str(pid)}))
-    else:
-        learners.append(spawn("learner", learner_cmd, env))
+    for pid in range(args.learners):
+        lenv = {**env}
+        if args.learners > 1:
+            lenv["DRL_PROCESS_ID"] = str(pid)
+        mine = [ring_names[t] for t in sorted(ring_names)
+                if t % args.learners == pid]
+        if mine:
+            lenv["DRL_SHM_RING_CREATE"] = ",".join(mine)
+        learners.append(spawn(
+            f"learner{pid}" if args.learners > 1 else "learner",
+            learner_cmd, lenv))
 
     for task in range(args.actors):
         actor_cmd = base + ["--mode", "actor", "--task", str(task)]
         if args.remote_act:
             actor_cmd += ["--remote_act"]
-        spawn(f"actor{task}",
-              actor_cmd, {**env, "DRL_LEARNER_INDEX": str(task % args.learners)})
+        aenv = {**env, "DRL_LEARNER_INDEX": str(task % args.learners)}
+        if task in ring_names:
+            aenv["DRL_SHM_RING_NAME"] = ring_names[task]
+        spawn(f"actor{task}", actor_cmd, aenv)
 
     def shutdown(*_):
         for proc in procs:
@@ -169,6 +230,21 @@ def main() -> None:
         # Drain the relay threads: without the join, the children's final
         # lines (e.g. the learner's "done: N updates") race sys.exit.
         t.join(timeout=5.0)
+    # Ring reaper: the learner unlinks its segments on a clean stop, but
+    # a SIGKILLed/crashed learner leaves them in /dev/shm — sweep every
+    # name this launch created, best-effort, after the children are dead.
+    for name in ring_names.values():
+        try:
+            from multiprocessing import shared_memory
+
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+            print(f"[cluster] reaped leaked shm ring {name}", file=sys.stderr)
+        except FileNotFoundError:
+            pass  # the learner cleaned up, as it should
+        except OSError:
+            pass
     sys.exit(rc)
 
 

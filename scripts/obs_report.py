@@ -17,6 +17,14 @@ and writes `trace-merged.json`: all roles' spans on one wall-clock axis
 (processes get distinct track labels), loadable in Perfetto
 (ui.perfetto.dev) or chrome://tracing.
 
+Shards are STREAMED line-by-line with incremental aggregation (counters
+keep first/last points, gauges fold into running {n, total, min, max,
+last} windows, staleness buckets accumulate as they pass) — an
+hours-long run's multi-GB shard costs this report one line of memory,
+not the whole file. Only the handful of gauges that render as timelines
+(the queue/ring depth sparklines) retain their per-flush means, which
+grow with flush count, not record count.
+
     python scripts/obs_report.py /tmp/run
     python scripts/obs_report.py /tmp/run --no-merge
 """
@@ -40,6 +48,115 @@ from distributed_reinforcement_learning_tpu.observability.trace import load_trac
 
 _SPARK = " .:-=+*#%@"
 
+# Gauges whose per-flush mean SERIES the report renders (sparklines);
+# every other gauge folds into a constant-size running aggregate.
+_SERIES_GAUGES = ("transport/queue_depth", "ring/depth")
+# Gauges needing the fallback per-window histogram (pre-exact-counter
+# shards): per-record (mean, n) folds straight into bucket counts.
+_STALE_GAUGE = "learner/weight_staleness"
+
+
+class GaugeAgg:
+    """One gauge's running aggregate across flush windows — the same
+    arithmetic (sequential sum of mean*n) the old whole-file
+    `gauge_stats` performed, so reports are byte-identical."""
+
+    __slots__ = ("n", "total", "lo", "hi", "last")
+
+    def __init__(self):
+        self.n = 0
+        self.total = 0.0
+        self.lo = float("inf")
+        self.hi = float("-inf")
+        self.last = 0.0
+
+    def add(self, record: dict) -> None:
+        self.n += record["n"]
+        self.total += record["mean"] * record["n"]
+        self.lo = min(self.lo, record["min"])
+        self.hi = max(self.hi, record["max"])
+        self.last = record["last"]
+
+    def stats(self) -> dict | None:
+        if not self.n:
+            return None
+        return {"n": self.n, "mean": self.total / self.n,
+                "min": self.lo, "max": self.hi, "last": self.last}
+
+
+class ShardAgg:
+    """Streaming aggregate of one `<role>-<rank>.jsonl` shard."""
+
+    def __init__(self, path: str):
+        self.path = path
+        m = re.match(r"(.+)-(\d+)\.jsonl$", os.path.basename(path))
+        self.role = m.group(1) if m else "proc"
+        self.rank = int(m.group(2)) if m else 0
+        self.n_records = 0
+        self._meta_seen = False  # first meta wins: a process has one identity
+        self.t_min: float | None = None
+        self.t_max: float | None = None
+        # counter name -> [t_first, v_first, t_last, v_last]
+        self.counters: dict[str, list] = {}
+        self.gauges: dict[str, GaugeAgg] = {}
+        self.series: dict[str, list[float]] = {}  # sparkline means only
+        # Fallback staleness histogram, bucketed AS records stream by.
+        self._stale_edges = list(STALENESS_BUCKETS) + [(float("inf"), ">16")]
+        self._stale_counts = [0] * len(self._stale_edges)
+
+    def consume(self, record: dict) -> None:
+        self.n_records += 1
+        t = record.get("t")
+        if t is not None:
+            self.t_min = t if self.t_min is None else min(self.t_min, t)
+            self.t_max = t if self.t_max is None else max(self.t_max, t)
+        kind = record.get("kind")
+        if kind == "meta":
+            if not self._meta_seen:
+                self._meta_seen = True
+                self.role = record.get("role") or self.role
+                self.rank = record.get("rank", self.rank)
+        elif kind == "counter":
+            entry = self.counters.get(record["name"])
+            if entry is None:
+                self.counters[record["name"]] = [t, record["value"],
+                                                 t, record["value"]]
+            else:
+                entry[2], entry[3] = t, record["value"]
+        elif kind == "gauge":
+            name = record["name"]
+            agg = self.gauges.get(name)
+            if agg is None:
+                agg = self.gauges[name] = GaugeAgg()
+            agg.add(record)
+            if name in _SERIES_GAUGES:
+                self.series.setdefault(name, []).append(record["mean"])
+            if name == _STALE_GAUGE:
+                value = record["mean"]
+                for i, (edge, _) in enumerate(self._stale_edges):
+                    if value <= edge:
+                        self._stale_counts[i] += record["n"]
+                        break
+
+    def counter_rates(self) -> dict[str, dict]:
+        """Per counter: total (last cumulative value) and rate over the
+        counter's own first->last flush window."""
+        out = {}
+        for name, (t0, v0, t1, v1) in self.counters.items():
+            out[name] = {
+                "total": v1,
+                "rate": (v1 - v0) / (t1 - t0) if t1 > t0 else 0.0,
+            }
+        return out
+
+    def gauge_stats(self, name: str) -> dict | None:
+        agg = self.gauges.get(name)
+        return agg.stats() if agg is not None else None
+
+    def stale_fallback_hist(self) -> list[tuple[str, int]]:
+        return [(name, c) for (_, name), c
+                in zip(self._stale_edges, self._stale_counts) if c]
+
 
 def shard_paths(tdir: str) -> list[str]:
     """Only `<role>-<rank>.jsonl` files: a run_dir's metrics.jsonl (the
@@ -57,72 +174,30 @@ def find_telemetry_dir(run_dir: str) -> str:
                      f"enabled (--run_dir / DRL_TELEMETRY_DIR)?")
 
 
-def read_shard(path: str) -> dict:
-    """-> {"role", "rank", "records"} from one `<role>-<rank>.jsonl`."""
-    records = []
+def read_shard(path: str) -> ShardAgg:
+    """Stream one shard into a ShardAgg — one line in memory at a time."""
+    agg = ShardAgg(path)
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
             try:
-                records.append(json.loads(line))
+                record = json.loads(line)
             except json.JSONDecodeError:
                 continue  # torn final line of a killed process
-    meta = next((r for r in records if r.get("kind") == "meta"), {})
-    m = re.match(r"(.+)-(\d+)\.jsonl$", os.path.basename(path))
-    role = meta.get("role") or (m.group(1) if m else "proc")
-    rank = meta.get("rank", int(m.group(2)) if m else 0)
-    return {"role": role, "rank": rank, "records": records}
+            agg.consume(record)
+    return agg
 
 
-def shard_label(shard: dict) -> str:
-    return f"{shard['role']}-{shard['rank']}"
+def shard_label(shard: ShardAgg) -> str:
+    return f"{shard.role}-{shard.rank}"
 
 
-def counter_rates(shard: dict) -> dict[str, dict]:
-    """Per counter: total (last cumulative value) and rate over the
-    counter's own first->last flush window."""
-    seen: dict[str, list] = {}
-    for r in shard["records"]:
-        if r.get("kind") != "counter":
-            continue
-        seen.setdefault(r["name"], []).append((r["t"], r["value"]))
-    out = {}
-    for name, points in seen.items():
-        t0, v0 = points[0]
-        t1, v1 = points[-1]
-        out[name] = {
-            "total": v1,
-            "rate": (v1 - v0) / (t1 - t0) if t1 > t0 else 0.0,
-        }
-    return out
-
-
-def gauge_series(shard: dict, name: str) -> list[dict]:
-    return [r for r in shard["records"]
-            if r.get("kind") == "gauge" and r.get("name") == name]
-
-
-def gauge_stats(series: list[dict]) -> dict | None:
-    """Weighted aggregate over gauge flush windows."""
-    n = sum(r["n"] for r in series)
-    if not n:
-        return None
-    return {
-        "n": n,
-        "mean": sum(r["mean"] * r["n"] for r in series) / n,
-        "min": min(r["min"] for r in series),
-        "max": max(r["max"] for r in series),
-        "last": series[-1]["last"],
-    }
-
-
-def sparkline(series: list[dict], width: int = 60) -> str:
+def sparkline(values: list[float], width: int = 60) -> str:
     """ASCII strip of a gauge timeline (bucketed means, scaled to max)."""
-    if not series:
+    if not values:
         return ""
-    values = [r["mean"] for r in series]
     if len(values) > width:
         per = len(values) / width
         values = [
@@ -185,51 +260,37 @@ def merge_traces(tdir: str, out_path: str) -> int:
     return sum(1 for e in events if e.get("ph") == "X")
 
 
-def staleness_buckets_exact(shard: dict) -> list[tuple[str, int]]:
+def staleness_buckets_exact(shard: ShardAgg) -> list[tuple[str, int]]:
     """Exact histogram from the observation-time `staleness_bucket/*`
     counters the transport server maintains (preferred: per-window gauge
     means would average a rare stall into the window's bulk and hide the
     tail). Edges shared with the write side via observability.metrics."""
-    rates = counter_rates(shard)
+    rates = shard.counter_rates()
     return [(name, int(rates[f"staleness_bucket/{name}"]["total"]))
             for name in STALENESS_BUCKET_NAMES
             if rates.get(f"staleness_bucket/{name}", {}).get("total")]
 
 
-def staleness_histogram(series: list[dict]) -> list[tuple[str, int]]:
-    """Fallback bucketing from gauge windows (window means, weighted by
-    each window's observation count) for shards predating the exact
-    counters."""
-    edges = list(STALENESS_BUCKETS) + [(float("inf"), ">16")]
-    counts = [0] * len(edges)
-    for r in series:
-        value = r["mean"]
-        for i, (edge, _) in enumerate(edges):
-            if value <= edge:
-                counts[i] += r["n"]
-                break
-    return [(name, c) for (_, name), c in zip(edges, counts) if c]
-
-
 def build_report(tdir: str, merge: bool = True) -> str:
     shards = [read_shard(p) for p in shard_paths(tdir)]
-    shards = [s for s in shards if s["records"]]
+    shards = [s for s in shards if s.n_records]
     if not shards:
         raise SystemExit(f"no readable telemetry records under {tdir}")
     lines: list[str] = []
     out = lines.append
-    times = [r["t"] for s in shards for r in s["records"] if "t" in r]
+    t_mins = [s.t_min for s in shards if s.t_min is not None]
+    t_maxs = [s.t_max for s in shards if s.t_max is not None]
     out("== Telemetry report ==")
     out(f"run: {tdir}")
     out(f"processes: {', '.join(shard_label(s) for s in shards)}")
-    if times:
-        out(f"span: {max(times) - min(times):.1f}s of telemetry")
+    if t_mins:
+        out(f"span: {max(t_maxs) - min(t_mins):.1f}s of telemetry")
 
     out("")
     out("-- Throughput (counters) --")
     any_counter = False
     for shard in shards:
-        for name, stats in sorted(counter_rates(shard).items()):
+        for name, stats in sorted(shard.counter_rates().items()):
             if name.startswith("staleness_bucket/"):
                 continue  # rendered as the staleness histogram below
             any_counter = True
@@ -254,23 +315,48 @@ def build_report(tdir: str, merge: bool = True) -> str:
     out("-- Queue depth (learner transport) --")
     any_depth = False
     for shard in shards:
-        series = gauge_series(shard, "transport/queue_depth")
-        stats = gauge_stats(series)
+        stats = shard.gauge_stats("transport/queue_depth")
         if stats is None:
             continue
         any_depth = True
         out(f"  {shard_label(shard)}: min {stats['min']:.0f}  "
             f"mean {stats['mean']:.1f}  max {stats['max']:.0f}  "
             f"last {stats['last']:.0f}")
-        out(f"    [{sparkline(series)}]")
+        out(f"    [{sparkline(shard.series.get('transport/queue_depth', []))}]")
     if not any_depth:
         out("  (no queue-depth samples)")
+
+    # Shm-ring data plane (runtime/shm_ring.py), next to the TCP stats:
+    # in-flight bytes per flush window, rendered like the queue depth.
+    # Section only appears when a run actually used rings.
+    ring_lines: list[str] = []
+    for shard in shards:
+        stats = shard.gauge_stats("ring/depth")
+        if stats is None:
+            continue
+        ring_lines.append(
+            f"  {shard_label(shard)}: min {stats['min']:.0f}B  "
+            f"mean {stats['mean']:.0f}B  max {stats['max']:.0f}B  "
+            f"last {stats['last']:.0f}B")
+        ring_lines.append(
+            f"    [{sparkline(shard.series.get('ring/depth', []))}]")
+    for shard in shards:
+        stats = shard.gauge_stats("ring/full_wait_ms")
+        if stats is not None:
+            ring_lines.append(
+                f"  {shard_label(shard)}: ring full-wait mean "
+                f"{stats['mean']:.2f}ms  max {stats['max']:.2f}ms  "
+                f"({stats['n']} stalls)")
+    if ring_lines:
+        out("")
+        out("-- Shm ring (co-hosted data plane) --")
+        lines.extend(ring_lines)
 
     out("")
     out("-- Weight publication --")
     any_pub = False
     for shard in shards:
-        stats = gauge_stats(gauge_series(shard, "publish/latency_ms"))
+        stats = shard.gauge_stats("publish/latency_ms")
         if stats is None:
             continue
         any_pub = True
@@ -278,7 +364,7 @@ def build_report(tdir: str, merge: bool = True) -> str:
             f"{stats['mean']:.2f}ms  max {stats['max']:.2f}ms  "
             f"({stats['n']} publishes)")
     for shard in shards:
-        stats = gauge_stats(gauge_series(shard, "actor/weight_pull_ms"))
+        stats = shard.gauge_stats("actor/weight_pull_ms")
         if stats is not None:
             any_pub = True
             out(f"  {shard_label(shard)}: weight pull mean "
@@ -292,25 +378,24 @@ def build_report(tdir: str, merge: bool = True) -> str:
         "ingest; lower bound on staleness at train time) --")
     any_stale = False
     for shard in shards:
-        series = gauge_series(shard, "learner/weight_staleness")
-        stats = gauge_stats(series)
+        stats = shard.gauge_stats(_STALE_GAUGE)
         if stats is None:
             continue
         any_stale = True
         out(f"  {shard_label(shard)}: mean {stats['mean']:.2f}  "
             f"max {stats['max']:.0f}  ({stats['n']} ingested unrolls)")
-        hist = staleness_buckets_exact(shard) or staleness_histogram(series)
+        hist = staleness_buckets_exact(shard) or shard.stale_fallback_hist()
         width = max((c for _, c in hist), default=1)
         for bucket, count in hist:
             bar = "#" * max(1, int(30 * count / width))
             out(f"    {bucket:>6}: {count:>8} {bar}")
     for shard in shards:
-        stats = gauge_stats(gauge_series(shard, "actor/weight_version"))
+        stats = shard.gauge_stats("actor/weight_version")
         if stats is not None:
             any_stale = True
             out(f"  {shard_label(shard)}: last pulled version {stats['last']:.0f}")
     for shard in shards:
-        stats = gauge_stats(gauge_series(shard, "learner/weight_version"))
+        stats = shard.gauge_stats("learner/weight_version")
         if stats is not None:
             out(f"  {shard_label(shard)}: last published version {stats['last']:.0f}")
     if not any_stale:

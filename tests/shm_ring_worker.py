@@ -1,0 +1,50 @@
+"""Producer-side child process for the shm-ring two-process e2e test.
+
+Attaches the named ring, encodes a DETERMINISTIC trajectory set (the
+parent test builds the identical set from the same seed and ships it
+over the TCP transport), puts each blob, latches producer-closed, exits.
+Usage: python tests/shm_ring_worker.py <ring_name> <seed> <count>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_trajectories(seed: int, count: int) -> list:
+    """The shared fixture: mixed-dtype pytrees incl. a nested dict and a
+    bool field, deterministic from `seed` (bit-for-bit across processes)."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(count):
+        T = 4 + (i % 3)
+        out.append({
+            "obs": rng.randint(0, 255, (T, 6, 6, 2)).astype(np.uint8),
+            "reward": rng.standard_normal(T).astype(np.float32),
+            "done": rng.rand(T) < 0.2,
+            "action": rng.randint(0, 4, T).astype(np.int32),
+            "nested": {"h": rng.standard_normal((T, 8)).astype(np.float32),
+                       "step": np.int64(i)},
+        })
+    return out
+
+
+def main() -> None:
+    from distributed_reinforcement_learning_tpu.data import codec
+    from distributed_reinforcement_learning_tpu.runtime.shm_ring import ShmRing
+
+    name, seed, count = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    ring = ShmRing.attach(name)
+    try:
+        for traj in make_trajectories(seed, count):
+            assert ring.put_blob(codec.encode(traj), timeout=30.0)
+        ring.close_producer()
+    finally:
+        ring.close()
+
+
+if __name__ == "__main__":
+    main()

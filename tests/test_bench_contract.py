@@ -41,6 +41,7 @@ _TRIMMED = {
     "BENCH_R2D2": "0", "BENCH_APEX": "0", "BENCH_XIMPALA": "0",
     "BENCH_APEX_INGEST": "0", "BENCH_INGEST": "0",
     "BENCH_ANAKIN": "0", "BENCH_ANAKIN_R2D2": "0",
+    "BENCH_TRANSPORT": "0",
 }
 
 
@@ -90,6 +91,42 @@ def test_watchdog_force_emits_while_main_thread_is_wedged(tmp_path):
     assert proc.returncode == 0
     assert last["metric"] and "value" in last
     assert "watchdog" in last["extra"], last["extra"]
+
+
+class TestTransportCompare:
+    """bench_transport_compare: the TCP-vs-shm-ring PUT A/B whose verdict
+    gates runtime/shm_ring's auto-enable. Driven directly at a tiny
+    config (CPU, host-only) — the committed hardware-adjudication
+    numbers live in benchmarks/transport_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv("DRL_SHM_RING_MB", "4")  # tiny test segment
+        bench = _load_bench()
+        from distributed_reinforcement_learning_tpu.agents.impala import ImpalaConfig
+
+        cfg = ImpalaConfig(obs_shape=(8,), num_actions=2, trajectory=8,
+                           lstm_size=16)
+        r = bench.bench_transport_compare(cfg, n_unrolls=32, reps=1)
+        for side in ("tcp", "ring"):
+            assert r[side]["frames_per_s"] > 0, r
+            assert r[side]["enqueue_wait_ms_p99"] >= r[side]["enqueue_wait_ms_p50"]
+        assert r["ring_vs_tcp"] > 0
+        assert r["auto_enable"] == (r["ring_vs_tcp"] >= 1.2)
+        assert r["verdict"].startswith("ring ") and (
+            "auto-on" in r["verdict"] or "opt-in" in r["verdict"])
+
+    def test_committed_verdict_file_consistent(self):
+        """The committed adjudication parses, and ring_enabled() follows
+        it when DRL_SHM_RING is unset."""
+        verdict = json.loads(
+            (REPO / "benchmarks" / "transport_verdict.json").read_text())
+        assert isinstance(verdict["auto_enable"], bool)
+        assert verdict["ratio_runs"] and verdict["bar"] == 1.2
+        from distributed_reinforcement_learning_tpu.runtime.shm_ring import (
+            ring_auto_enabled)
+
+        assert ring_auto_enabled() is verdict["auto_enable"]
 
 
 class TestDeviceChunkGate:

@@ -529,6 +529,7 @@ class TestCliAndTreeGate:
         deleting one silently disables the race check for that class."""
         expected = {
             "runtime/transport.py": 2,   # TransportServer + TransportClient
+            "runtime/shm_ring.py": 3,    # ShmRing (doc form) + drainer + queue
             "runtime/weights.py": 1,
             "runtime/publishing.py": 1,  # empty-map documentation form
             "runtime/inference.py": 1,

@@ -1,0 +1,326 @@
+"""Shared-memory SPSC ring transport (runtime/shm_ring.py): framing and
+wraparound unit tests, full/empty boundary behavior, a randomized
+producer/consumer fuzz, the two-process e2e proving the ring delivers
+BIT-IDENTICAL decoded trajectories to the TCP path, and the
+fallback-to-TCP wiring for attach failure and mid-run ring death.
+
+All CPU-only, tier-1 safe; segments are tmp-named per test and unlinked
+in teardown.
+"""
+
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from distributed_reinforcement_learning_tpu.data import codec
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+from distributed_reinforcement_learning_tpu.runtime.shm_ring import (
+    RingClosed,
+    RingDrainer,
+    RingQueue,
+    ShmRing,
+    attach_ring_queue,
+    ring_enabled,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = REPO / "tests" / "shm_ring_worker.py"
+
+sys.path.insert(0, str(REPO / "tests"))
+from shm_ring_worker import make_trajectories  # noqa: E402
+
+
+@pytest.fixture
+def ring():
+    r = ShmRing.create(f"drltest-{os.getpid()}-{time.monotonic_ns()}", 16384)
+    yield r
+    r.close()
+    r.unlink()
+
+
+def _leaves(tree, out):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            _leaves(tree[k], out)
+    else:
+        out.append(np.asarray(tree))
+    return out
+
+
+def assert_trees_bit_identical(a, b):
+    la, lb = _leaves(a, []), _leaves(b, [])
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert x.dtype == y.dtype and x.shape == y.shape
+        assert x.tobytes() == y.tobytes()  # bit-for-bit, not approx
+
+
+class TestRingFraming:
+    def test_roundtrip_variable_sizes_including_empty(self, ring):
+        blobs = [b"", b"x", os.urandom(7), os.urandom(8), os.urandom(700)]
+        for b in blobs:
+            assert ring.put_blob(b, timeout=1.0)
+        for b in blobs:
+            assert ring.get_blob(timeout=1.0) == b
+
+    def test_wraparound_preserves_content_and_order(self, ring):
+        """Blobs sized to land records on every wrap case: contiguous,
+        wrap-marker (4 <= space-left < record), and implicit skip
+        (space-left < 4, no room for a marker)."""
+        rng = np.random.RandomState(0)
+        sizes = [1, 2, 3, 700, 3000, 3500, 8, 4090, 4084, 4085, 2, 3999,
+                 5, 4091, 4086, 13]
+        blobs = [rng.bytes(n) for n in sizes] * 8  # many laps of the ring
+        got = []
+
+        def consume():
+            for _ in blobs:
+                got.append(ring.get_blob(timeout=10.0))
+
+        t = threading.Thread(target=consume)
+        t.start()
+        for b in blobs:
+            assert ring.put_blob(b, timeout=10.0)
+        t.join(timeout=30.0)
+        assert got == blobs
+
+    def test_exact_fit_to_end_of_buffer(self):
+        r = ShmRing.create(f"drltest-fit-{os.getpid()}", 4096)
+        try:
+            # Four 1024-byte records (blob 1020 + 4B header) tile the
+            # buffer exactly: the fourth ends AT capacity, so the fifth
+            # starts at pos 0 with no wrap marker or skip in between.
+            blob = bytes(1020)
+            for _ in range(4):
+                assert r.put_blob(blob, timeout=1.0)
+                assert r.get_blob(timeout=1.0) == blob
+            assert r._head % r.capacity == 0  # fully wrapped, no pad
+            assert r.put_blob(b"after", timeout=1.0)
+            assert r.get_blob(timeout=1.0) == b"after"
+        finally:
+            r.close()
+            r.unlink()
+
+    def test_full_ring_times_out_then_drains(self, ring):
+        blob = os.urandom(4000)
+        accepted = 0
+        while ring.put_blob(blob, timeout=0.02):
+            accepted += 1
+        assert accepted >= 2  # 16KB ring holds >= 2 4KB records
+        assert ring.get_blob(timeout=0.1) == blob  # frees a slot
+        assert ring.put_blob(blob, timeout=1.0)    # fits again
+
+    def test_empty_ring_get_times_out(self, ring):
+        assert ring.get_blob(timeout=0.05) is None
+
+    def test_oversize_blob_rejected(self, ring):
+        with pytest.raises(ValueError):
+            ring.put_blob(os.urandom(ring.capacity // 2 + 16))
+
+    def test_consumer_close_fails_producer_fast(self, ring):
+        ring.close_consumer()
+        with pytest.raises(RingClosed):
+            ring.put_blob(b"x", timeout=5.0)
+
+    def test_drained_only_after_close_and_empty(self, ring):
+        assert not ring.drained()
+        ring.put_blob(b"tail", timeout=1.0)
+        ring.close_producer()
+        assert not ring.drained()  # closed but not yet empty
+        assert ring.get_blob(timeout=1.0) == b"tail"
+        assert ring.drained()
+
+    def test_used_bytes_tracks_depth(self, ring):
+        assert ring.used_bytes() == 0
+        ring.put_blob(os.urandom(100), timeout=1.0)
+        assert ring.used_bytes() == 104  # 4B header + 100, 8-aligned
+        ring.get_blob(timeout=1.0)
+        assert ring.used_bytes() == 0
+
+
+class TestRingFuzz:
+    def test_randomized_producer_consumer(self):
+        """500 random-size random-content blobs through a small ring
+        with both sides free-running: order and content must survive
+        arbitrary interleavings and many wraparounds."""
+        r = ShmRing.create(f"drltest-fuzz-{os.getpid()}", 16384)
+        rng = np.random.RandomState(42)
+        blobs = [rng.bytes(int(n)) for n in rng.randint(0, 5000, size=500)]
+        digests = [hashlib.sha1(b).digest() for b in blobs]
+        got: list = []
+
+        def consume():
+            for _ in blobs:
+                blob = r.get_blob(timeout=30.0)
+                got.append(None if blob is None else hashlib.sha1(blob).digest())
+
+        t = threading.Thread(target=consume)
+        t.start()
+        try:
+            for b in blobs:
+                assert r.put_blob(b, timeout=30.0)
+            t.join(timeout=60.0)
+            assert got == digests
+        finally:
+            r.close()
+            r.unlink()
+
+
+class TestTwoProcessE2E:
+    def test_ring_matches_tcp_path_bit_for_bit(self):
+        """A REAL child process PUTs encoded trajectories over the ring
+        (drained into a TrajectoryQueue); the same trajectories go
+        through the real TCP transport into a second queue. The decoded
+        pytrees must match bit-for-bit — the ring changes the transport,
+        never the data."""
+        from distributed_reinforcement_learning_tpu.runtime.transport import (
+            TransportClient, TransportServer)
+        from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+        seed, count = 7, 9
+        name = f"drltest-e2e-{os.getpid()}"
+        ring = ShmRing.create(name, 1 << 20)
+        ring_q = TrajectoryQueue(capacity=count + 2)
+        drainer = RingDrainer([ring], ring_q).start()
+        proc = subprocess.Popen(
+            [sys.executable, str(WORKER), name, str(seed), str(count)],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            ring_items = [ring_q.get(timeout=60.0) for _ in range(count)]
+            assert proc.wait(timeout=60) == 0, proc.stderr.read()[-800:]
+        finally:
+            drainer.stop()  # also unlinks the segment
+        assert all(item is not None for item in ring_items)
+        assert drainer.snapshot_stats()["unrolls_drained"] == count
+
+        # The same trajectories over real TCP.
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        tcp_q = TrajectoryQueue(capacity=count + 2)
+        server = TransportServer(tcp_q, WeightStore(), host="127.0.0.1",
+                                 port=port).start()
+        client = TransportClient("127.0.0.1", port)
+        try:
+            for traj in make_trajectories(seed, count):
+                assert client.put_trajectory(traj)
+            tcp_items = [tcp_q.get(timeout=10.0) for _ in range(count)]
+        finally:
+            client.close()
+            server.stop()
+        for ring_item, tcp_item in zip(ring_items, tcp_items):
+            assert_trees_bit_identical(ring_item, tcp_item)
+
+    def test_drainer_feeds_decoded_copies(self):
+        """The drained pytree must be a COPY: the shm slot is reused the
+        moment the blob is popped, and a view would be torn by the next
+        producer write."""
+        ring = ShmRing.create(f"drltest-copy-{os.getpid()}", 1 << 16)
+        queue = TrajectoryQueue(capacity=4)
+        drainer = RingDrainer([ring], queue).start()
+        try:
+            first = {"x": np.arange(64, dtype=np.int32)}
+            ring.put_blob(codec.encode(first), timeout=5.0)
+            got = queue.get(timeout=10.0)
+            # Overwrite the ring with different content, then check the
+            # already-dequeued item is untouched.
+            ring.put_blob(codec.encode({"x": np.zeros(64, np.int32)}),
+                          timeout=5.0)
+            queue.get(timeout=10.0)
+            np.testing.assert_array_equal(got["x"], np.arange(64))
+        finally:
+            drainer.stop()
+
+
+class _FakeClient:
+    """TCP-side stub recording what fell back to it."""
+
+    def __init__(self):
+        self.single: list = []
+        self.batches: list = []
+
+    def put_trajectory(self, item):
+        self.single.append(item)
+        return True
+
+    def put_trajectories(self, items):
+        self.batches.append(list(items))
+        return len(items)
+
+    def queue_size(self):
+        return 123
+
+
+class TestFallback:
+    def test_attach_failure_falls_back_to_tcp(self):
+        assert attach_ring_queue("drltest-never-created", _FakeClient(),
+                                 deadline_s=0.3) is None
+
+    def test_ring_death_demotes_to_tcp_mid_run(self):
+        ring = ShmRing.create(f"drltest-demote-{os.getpid()}", 1 << 16)
+        client = _FakeClient()
+        rq = RingQueue(ring, client)
+        try:
+            trajs = make_trajectories(3, 4)
+            assert rq.put_many(trajs[:2]) == 2
+            assert client.batches == []  # rode the ring
+            ring.close_consumer()        # learner side gone
+            assert rq.put_many(trajs[2:]) == 2
+            assert len(client.batches) == 1  # demoted, remainder over TCP
+            assert rq.snapshot_stats()["tcp_fallbacks"] == 1
+            # Demotion is permanent: subsequent puts go straight to TCP.
+            assert rq.put(trajs[0]) is True
+            assert len(client.single) == 1
+            assert rq.size() == 123  # control plane always TCP
+        finally:
+            rq.close()
+            ring.unlink()
+
+    def test_oversize_blob_demotes_to_tcp(self):
+        """A trajectory whose encoded blob cannot ever fit the ring
+        (mis-sized DRL_SHM_RING_MB vs the section's unroll) must demote
+        to TCP, not kill the actor."""
+        ring = ShmRing.create(f"drltest-big-{os.getpid()}", 8192)
+        client = _FakeClient()
+        rq = RingQueue(ring, client)
+        huge = {"obs": np.zeros(16384, np.uint8)}
+        try:
+            assert rq.put(huge) is True
+            assert len(client.single) == 1  # fell back, nothing lost
+            assert rq.snapshot_stats()["tcp_fallbacks"] == 1
+        finally:
+            rq.close()
+            ring.unlink()
+
+    def test_ring_enabled_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("DRL_SHM_RING", "1")
+        assert ring_enabled() is True
+        monkeypatch.setenv("DRL_SHM_RING", "0")
+        assert ring_enabled() is False
+
+
+class TestRingQueueBackpressure:
+    def test_full_ring_raises_connectionerror_after_window(self):
+        """The ring analogue of the TCP client's busy_timeout: a wedged
+        learner (nothing draining) must surface as ConnectionError so
+        the actor's elastic-grace loop owns the failure."""
+        ring = ShmRing.create(f"drltest-bp-{os.getpid()}", 8192)
+        rq = RingQueue(ring, _FakeClient(), full_timeout=0.2)
+        big = {"x": np.zeros(2048, np.uint8)}
+        try:
+            with pytest.raises(ConnectionError):
+                for _ in range(32):  # no consumer: fills, then times out
+                    rq.put(big)
+        finally:
+            rq.close()
+            ring.unlink()
