@@ -70,7 +70,8 @@ def _probe_backend(timeout: float) -> tuple[str | None, str | None]:
 # chunk_regressions: the device-chunk gate's failing section names (a
 # regression must survive into the compact line the driver reads).
 _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
-                 "watchdog", "chunk_regressions", "transport_verdict")
+                 "watchdog", "chunk_regressions", "transport_verdict",
+                 "codec_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -1223,6 +1224,221 @@ def bench_transport_compare(cfg, n_unrolls: int = 256,
     return out
 
 
+# Child-process actor for bench_codec_compare: encodes the deterministic
+# synthetic trees (rebuilt from argv, no pickling) and PUTs them over the
+# parent's real TCP server — the DEPLOYED co-hosted topology, so the
+# learner-side serve/ingest work genuinely overlaps the actor's encode
+# instead of time-slicing one GIL with it (the in-process transport_compare
+# caveat this section must not inherit: encode is exactly what is being
+# adjudicated here).
+_CODEC_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.data import codec
+from distributed_reinforcement_learning_tpu.runtime.transport import TransportClient
+from distributed_reinforcement_learning_tpu.utils.synthetic import (
+    synthetic_impala_batch)
+
+(host, port, T, n_unrolls, upp, reps,
+ obs_shape, num_actions, lstm) = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]), json.loads(sys.argv[7]),
+    int(sys.argv[8]), int(sys.argv[9]))
+batch = synthetic_impala_batch(1, T, tuple(obs_shape), num_actions, lstm,
+                               uniform_behavior=False)
+one = type(batch)(*[np.asarray(v)[0] for v in batch])
+trees = [one] * upp
+if len(obs_shape) == 3 and 2 <= obs_shape[-1] <= 8:
+    h, w, s = obs_shape
+    planes = np.random.RandomState(0).randint(
+        0, 255, (T + s - 1, h, w)).astype(np.uint8)
+    stacked = np.lib.stride_tricks.sliding_window_view(
+        planes, s, axis=0).copy()
+    # Distinct trees, and every third one carries a mid-unroll reset at
+    # a VARYING step: real actor traffic has per-trajectory reset
+    # positions, so the dedup plan cache (keyed on them) must not be
+    # allowed a 100%-hit fantasy the deployment can't reach.
+    trees = []
+    for i in range(upp):
+        st = stacked.copy()
+        if i % 3 == 2:
+            t_reset = 1 + (i % (T - 1))
+            st[t_reset] = 0
+            st[t_reset, :, :, -1] = planes[t_reset + s - 1]
+        trees.append(one._replace(state=st))
+client = TransportClient(host, port, busy_timeout=120.0)
+
+
+def pctl(sorted_ms, q):
+    return round(sorted_ms[min(int(q * (len(sorted_ms) - 1) + 0.5),
+                               len(sorted_ms) - 1)], 3)
+
+
+def run_variant(cache_env, dedup_env):
+    # The DEPLOYED client path end-to-end: put_trajectories encodes per
+    # tree (honoring DRL_OBS_DEDUP exactly as a real actor does), loops
+    # on the server's accepted count, and retries refused tails — so a
+    # variant that outruns the drain pays the backpressure instead of
+    # counting dropped unrolls as throughput.
+    os.environ["DRL_CODEC_CACHE"] = cache_env
+    os.environ["DRL_OBS_DEDUP"] = dedup_env
+    codec.refresh_flags()
+    codec.clear_caches()
+
+    def call():
+        sent = client.put_trajectories(trees)
+        assert sent == len(trees), f"dropped {len(trees) - sent} unrolls"
+
+    call()  # warm the connection, caches, and server buffers
+    best = None
+    for _ in range(reps):
+        lat = []
+        t0 = time.perf_counter()
+        for _ in range(max(n_unrolls // upp, 1)):
+            c0 = time.perf_counter()
+            call()
+            lat.append((time.perf_counter() - c0) * 1e3)
+        elapsed = time.perf_counter() - t0
+        fps = (len(lat) * upp * T) / elapsed
+        if best is None or fps > best[0]:
+            best = (fps, lat)
+    lat = sorted(best[1])
+    return {"frames_per_s": round(best[0], 1),
+            "unrolls_per_s": round(best[0] / T, 1),
+            "put_ms_p50": pctl(lat, 0.50), "put_ms_p99": pctl(lat, 0.99)}
+
+
+out = {"unroll_bytes": len(codec.encode(trees[0])),
+       "packed_bytes": len(codec.encode(trees[0], dedup=True)),
+       "cold": run_variant("0", "0"),
+       "cached": run_variant("1", "0"),
+       "dedup": run_variant("1", "1")}
+client.close()
+print("CODEC_CHILD=" + json.dumps(out))
+"""
+
+
+def bench_codec_compare(cfg, n_unrolls: int = 192,
+                        unrolls_per_put: int = 16, reps: int = 3) -> dict:
+    """Old-vs-new ENCODE+PUT A/B for the actor->learner hot path: the
+    same trajectory trees are codec-encoded per call (this is the stage
+    the schema cache and frame-stack dedup attack — transport_compare
+    deliberately pre-encodes and so never sees encode cost) and shipped
+    over real loopback TCP (batched OP_PUT_TRAJ_N) into the default
+    queue backend, a drain thread keeping backpressure honest.
+
+    TWO PROCESSES, the deployed co-hosted topology: the actor side runs
+    in a child process (`_CODEC_CHILD`) so the learner-side serve +
+    ingest (incl. the dedup reconstruction in `fifo.blob_ingest`)
+    overlaps the actor's encode on its own core instead of sharing one
+    GIL with the stage under adjudication.
+
+    Three child variants: `cold` (DRL_CODEC_CACHE=0 — the pre-cache
+    codec, the adjudication baseline), `cached` (schema + layout caches
+    on), `dedup` (caches + frame-stack packing; the observation leaf is
+    synthesized with real newest-last stacking so the packer sees the
+    deployed redundancy). Verdicts per the repo's 1.2x adjudication
+    bar: `cache_auto_enable` from cached/cold, `dedup_auto_enable` from
+    dedup/cached; the committed decision lives in
+    `benchmarks/codec_verdict.json`, which `codec.cache_enabled()` /
+    `codec.obs_dedup_enabled()` consult when their env knobs are unset.
+    Host-only, link-independent.
+    """
+    import subprocess
+
+    from distributed_reinforcement_learning_tpu.data import codec
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        TransportServer, _make_queue)
+    from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+    T = cfg.trajectory
+    out: dict = {
+        "n_unrolls": n_unrolls, "unrolls_per_call": unrolls_per_put,
+        "note": ("encode included per call (the stage under test), real "
+                 "loopback TCP + default queue + drain thread; actor side "
+                 "is a separate PROCESS (deployed co-hosted topology), so "
+                 "serve/ingest overlap the encode under adjudication")}
+
+    queue = _make_queue(128)
+    server = TransportServer(queue, WeightStore(), host="127.0.0.1",
+                             port=_free_port()).start()
+    stop = threading.Event()
+
+    def drain_loop():
+        raw = hasattr(queue, "put_bytes")
+        cap = 1 << 16
+        while not stop.is_set():
+            try:
+                if raw:
+                    got = queue._q.get_batch_raw(16, cap, timeout=0.2)
+                    if got is not None:
+                        cap = got[1]  # keep the learned stride: the pop
+                        # regrows it internally with a fresh buffer per
+                        # doubling, and repaying that every iteration
+                        # would depress all three variants' ratios
+                else:
+                    queue.get(timeout=0.2)
+            except RuntimeError:
+                return
+
+    dt = threading.Thread(target=drain_loop, daemon=True)
+    dt.start()
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("DRL_CODEC_CACHE", "DRL_OBS_DEDUP")}
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"  # the child never touches a device
+    # LEARNER side of the A/B: the only server-side codec work is the
+    # dedup variant's reconstruction (plain blobs pass blob_ingest on a
+    # substring scan), and an opted-in deployment opts in both roles —
+    # so this process runs it CACHED, not at the committed default.
+    saved_parent = {"DRL_CODEC_CACHE": os.environ.get("DRL_CODEC_CACHE")}
+    os.environ["DRL_CODEC_CACHE"] = "1"
+    codec.refresh_flags()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _CODEC_CHILD, "127.0.0.1", str(server.port),
+             str(T), str(n_unrolls), str(unrolls_per_put), str(reps),
+             json.dumps(list(cfg.obs_shape)), str(cfg.num_actions),
+             str(cfg.lstm_size)],
+            env=env, capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"codec_compare child rc={proc.returncode}: "
+                f"{proc.stderr.strip()[-500:]}")
+        line = next(ln for ln in proc.stdout.splitlines()
+                    if ln.startswith("CODEC_CHILD="))
+        out.update(json.loads(line.split("=", 1)[1]))
+    finally:
+        if saved_parent["DRL_CODEC_CACHE"] is None:
+            os.environ.pop("DRL_CODEC_CACHE", None)
+        else:
+            os.environ["DRL_CODEC_CACHE"] = saved_parent["DRL_CODEC_CACHE"]
+        codec.refresh_flags()
+        stop.set()
+        server.stop()
+        queue.close()
+        dt.join(timeout=2.0)
+
+    r_cache = out["cached"]["frames_per_s"] / max(out["cold"]["frames_per_s"], 1e-9)
+    r_dedup = out["dedup"]["frames_per_s"] / max(out["cached"]["frames_per_s"], 1e-9)
+    out["cached_vs_cold"] = round(r_cache, 2)
+    out["dedup_vs_cached"] = round(r_dedup, 2)
+    out["cache_auto_enable"] = r_cache >= 1.2  # the repo's adjudication bar
+    out["dedup_auto_enable"] = r_dedup >= 1.2
+    out["verdict"] = (
+        f"codec cache {r_cache:.2f}x cold "
+        f"({'auto-on' if out['cache_auto_enable'] else 'opt-in'}), "
+        f"dedup {r_dedup:.2f}x cached "
+        f"({'auto-on' if out['dedup_auto_enable'] else 'opt-in'})")
+    print(f"[bench] codec_compare: cold {out['cold']['frames_per_s']:,.0f} "
+          f"f/s vs cached {out['cached']['frames_per_s']:,.0f} f/s vs "
+          f"dedup {out['dedup']['frames_per_s']:,.0f} f/s -> {out['verdict']}",
+          file=sys.stderr)
+    return out
+
+
 def bench_r2d2_learn(B: int, iters: int) -> dict:
     """R2D2 learn-step throughput (env-frames/s) at the reference replay
     shape — the training hot path that runs the fused Pallas LSTM
@@ -2049,6 +2265,18 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["transport_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] transport_compare failed: {e}", file=sys.stderr)
+
+    # Host-only encode+PUT A/B (the auto-enable adjudication for the
+    # codec schema cache and frame-stack dedup, data/codec.py).
+    if os.environ.get("BENCH_CODEC", "1") == "1" and _ok("codec_compare", 120):
+        try:
+            r = bench_codec_compare(cfg)
+            extra["codec_compare"] = r
+            if "verdict" in r:
+                extra["codec_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["codec_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] codec_compare failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_KERNELS", "1") == "1" and _ok("kernel_compare", 240):
         try:

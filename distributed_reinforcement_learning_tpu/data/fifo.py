@@ -15,6 +15,7 @@ the multi-process data plane.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -38,16 +39,35 @@ def blob_ingest(queue: Any) -> tuple[Any, Any]:
     The single definition of blob-ingest semantics, shared by the TCP
     transport server and the shm-ring drainer so the two transports
     cannot drift: blob-native queues (`put_bytes`, the C++ backend) take
-    the raw bytes; pytree queues take a decoded COPY — the blob's buffer
-    may be reused or unmapped by the caller the moment `prepare` returns.
+    the raw bytes — routed through `codec.unpack_blob` so a dedup-packed
+    wire blob (DRL_OBS_DEDUP) is reconstructed to the plain layout
+    BEFORE the queue (the native batch-gather assumes it; a plain blob
+    passes through as the same object, no copy); pytree queues take a
+    decoded COPY — the blob's buffer may be reused or unmapped by the
+    caller the moment `prepare` returns, and decode reconstructs packed
+    leaves bit-identically as part of that copy. Either way, replay,
+    prioritization, and training see byte-for-byte the trajectories a
+    dedup-off run would see.
     `put(item, timeout=...)` follows the queue's blocking-put contract
     (False on timeout, RuntimeError once closed).
     """
-    if hasattr(queue, "put_bytes"):
-        return (lambda blob: blob), queue.put_bytes
     from distributed_reinforcement_learning_tpu.data import codec
 
+    if hasattr(queue, "put_bytes"):
+        return codec.unpack_blob, queue.put_bytes
     return (lambda blob: codec.decode(blob, copy=True)), queue.put
+
+
+def put_batch_size() -> int:
+    """The actor's PUT batch size: how many unrolls ride one batched
+    exchange (`DRL_PUT_BATCH`). 0 (the default) keeps today's behavior —
+    the whole extract() round in one OP_PUT_TRAJ_N exchange (and, for
+    the Ape-X actor's per-step puts, one unroll per put). Sizing
+    guidance vs actor count: docs/performance.md ("PUT batch sizing")."""
+    try:
+        return max(0, int(os.environ.get("DRL_PUT_BATCH", "0") or 0))
+    except ValueError:
+        return 0
 
 
 def put_round(queue: Any, items: list[Any]) -> None:
@@ -58,13 +78,20 @@ def put_round(queue: Any, items: list[Any]) -> None:
     whole round (OP_PUT_TRAJ_N) instead of N request/replies — the
     actor-side fix for the reference's per-item-RPC anti-pattern
     (`buffer_queue.py:416-435`). In-process queues just loop.
+    `DRL_PUT_BATCH=k` chunks the round into k-unroll exchanges (smaller
+    server-side enqueue bursts under many actors, at more round trips).
     """
     put_many = getattr(queue, "put_many", None)
-    if put_many is not None:
-        put_many(items)
-    else:
+    if put_many is None:
         for item in items:
             queue.put(item)
+        return
+    chunk = put_batch_size()
+    if chunk <= 0 or chunk >= len(items):
+        put_many(items)
+    else:
+        for i in range(0, len(items), chunk):
+            put_many(items[i:i + chunk])
 
 
 class TrajectoryQueue:

@@ -24,7 +24,12 @@ import numpy as np
 import jax
 
 from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexBatch
-from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees
+from distributed_reinforcement_learning_tpu.data.fifo import (
+    TrajectoryQueue,
+    put_batch_size,
+    put_round,
+    stack_pytrees,
+)
 from distributed_reinforcement_learning_tpu.data.replay import UniformBuffer, make_replay
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
@@ -85,12 +90,23 @@ class ApexActor:
             self._params, self._version = got
 
     def run_steps(self, num_steps: int) -> int:
-        """Step the envs `num_steps` times; push buffer re-samples when warm."""
+        """Step the envs `num_steps` times; push buffer re-samples when warm.
+
+        PUT batching: `DRL_PUT_BATCH=k` aggregates the per-step sampled
+        unrolls into k-unroll batched exchanges (`put_round` ->
+        OP_PUT_TRAJ_N over the wire) instead of one request/reply per
+        unroll; unset keeps the reference's per-step put. Pending
+        unrolls are flushed before a normal return; an exception
+        mid-round (transport outage) abandons the local pending list —
+        harmless, since these are RE-SAMPLES of the actor's buffer, not
+        the only copy (at-most-once, like every PUT on this path)."""
         if self.remote_act is None:
             if self._steps % self.sync_every_steps == 0 or self._params is None:
                 self._sync_params()
             if self._params is None:
                 raise RuntimeError("no weights published yet")
+        put_batch = max(1, put_batch_size())
+        pending: list = []
 
         for _ in range(num_steps):
             if self.remote_act is not None:
@@ -136,7 +152,18 @@ class ApexActor:
 
             if len(self._buffer) > self.warmup:
                 unroll = stack_pytrees(self._buffer.sample(self.unroll_size))
-                self.queue.put(unroll)
+                if put_batch <= 1:
+                    with _OBS.span("actor_put"):
+                        self.queue.put(unroll)
+                else:
+                    pending.append(unroll)
+                    if len(pending) >= put_batch:
+                        with _OBS.span("actor_put"):
+                            put_round(self.queue, pending)
+                        pending.clear()
+        if pending:
+            with _OBS.span("actor_put"):
+                put_round(self.queue, pending)
         return num_steps * self._obs.shape[0]
 
 

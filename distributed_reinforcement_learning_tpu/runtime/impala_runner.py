@@ -132,7 +132,12 @@ class ImpalaActor:
             for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 
-        put_round(self.queue, acc.extract())
+        # Timed separately from the enclosing actor_round span: this is
+        # the encode+PUT stage the codec fast path (schema cache /
+        # DRL_OBS_DEDUP dedup / DRL_PUT_BATCH) optimizes — obs_report's
+        # stage table shows its p50/p99 directly.
+        with _OBS.span("actor_put"):
+            put_round(self.queue, acc.extract())
         return n * cfg.trajectory
 
 

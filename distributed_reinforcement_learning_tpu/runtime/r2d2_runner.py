@@ -150,7 +150,10 @@ class R2D2Actor:
             for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 
-        put_round(self.queue, acc.extract())
+        # encode+PUT stage span (the codec fast path's target; see
+        # impala_runner.run_unroll).
+        with _OBS.span("actor_put"):
+            put_round(self.queue, acc.extract())
         return n * cfg.seq_len
 
 

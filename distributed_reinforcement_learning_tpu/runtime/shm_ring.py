@@ -573,7 +573,9 @@ class RingQueue(_LockedStatsMixin):
         if self._ring is None:
             return self._client.put_trajectory(item)
         try:
-            self._put_blob(codec.encode(item))
+            # Same dedup gating as the TCP client's trajectory PUTs: the
+            # drainer's blob_ingest reconstructs before the queue.
+            self._put_blob(codec.encode(item, dedup=codec.obs_dedup_enabled()))
             return True
         except (RingClosed, ValueError):
             # ValueError = blob too large for this ring's capacity: TCP
@@ -587,9 +589,10 @@ class RingQueue(_LockedStatsMixin):
         if self._ring is None:
             return self._client.put_trajectories(items)
         sent = 0
+        dedup = codec.obs_dedup_enabled()
         for item in items:
             try:
-                self._put_blob(codec.encode(item))
+                self._put_blob(codec.encode(item, dedup=dedup))
                 sent += 1
             except (RingClosed, ValueError):  # dead ring / oversize blob
                 self._demote()

@@ -603,7 +603,9 @@ class TransportClient(_LockedStatsMixin):
         learner (queue permanently full) must surface as TransportError so
         the actor-side elastic-recovery grace deadline owns the failure,
         instead of this loop blocking the actor forever."""
-        blob = codec.encode(tree)
+        # Trajectory PUTs are the dedup-eligible wire traffic (frame-stacked
+        # observation leaves); weights/inference encodes stay plain.
+        blob = codec.encode(tree, dedup=codec.obs_dedup_enabled())
         busy_since: float | None = None
         while True:
             try:
@@ -643,7 +645,8 @@ class TransportClient(_LockedStatsMixin):
         so far), bounded ST-BUSY-equivalent retries of the NOT-enqueued
         tail on partial acceptance.
         """
-        blobs = [codec.encode(t) for t in trees]
+        dedup = codec.obs_dedup_enabled()
+        blobs = [codec.encode(t, dedup=dedup) for t in trees]
         sent = 0
         busy_since: float | None = None
         while sent < len(blobs):
@@ -958,6 +961,12 @@ def run_role(
                     _OBS.sample(f"ring/{key}",
                                 lambda k=key: ring_drainer.stat(k),
                                 kind="counter")
+            # Codec fast-path counters (data/codec.py): decode layout-cache
+            # hits on the serve/drain threads; the locked accessor is
+            # polled from the telemetry flush thread.
+            for key in codec.cache_stats():
+                _OBS.sample(f"codec/{key}", lambda k=key: codec.cache_stat(k),
+                            kind="counter")
         print(f"[learner] serving on :{serve_port}; training {num_updates} updates")
         try:
             _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
@@ -1023,6 +1032,12 @@ def run_role(
                     _OBS.sample(f"ring/{key}",
                                 lambda k=key: actor_queue.stat(k),
                                 kind="counter")
+            # Actor-side codec counters: schema-cache hit rate on the
+            # encode path and dedup bytes saved (the wire-byte cut the
+            # obs_report "Codec" section renders).
+            for key in codec.cache_stats():
+                _OBS.sample(f"codec/{key}", lambda k=key: codec.cache_stat(k),
+                            kind="counter")
             _OBS.sample("actor/weight_version_held",
                         lambda: getattr(actor, "_version", -1))
         print(f"[actor {task}] connected to {server_ip}:{port}")

@@ -26,6 +26,7 @@ from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round
 from distributed_reinforcement_learning_tpu.data.structures import XformerSequenceAccumulator
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 from distributed_reinforcement_learning_tpu.runtime.r2d2_runner import (
     R2D2Learner,
     run_sync,  # noqa: F401  (re-exported: the sync loop is topology-only)
@@ -155,5 +156,8 @@ class XformerActor:
             for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 
-        put_round(self.queue, acc.extract())
+        # encode+PUT stage span (the codec fast path's target; see
+        # impala_runner.run_unroll).
+        with _OBS.span("actor_put"):
+            put_round(self.queue, acc.extract())
         return n * cfg.seq_len

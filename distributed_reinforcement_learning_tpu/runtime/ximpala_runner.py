@@ -27,6 +27,7 @@ from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaAgent
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round
 from distributed_reinforcement_learning_tpu.data.structures import XImpalaTrajectoryAccumulator
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 from distributed_reinforcement_learning_tpu.runtime.impala_runner import (
     ImpalaLearner,
     run_async,  # noqa: F401  (re-exported: topology-only)
@@ -155,5 +156,8 @@ class XImpalaActor:
             for ret in completed_returns(infos, done):
                 self.episode_returns.append(float(ret))
 
-        put_round(self.queue, acc.extract())
+        # encode+PUT stage span (the codec fast path's target; see
+        # impala_runner.run_unroll).
+        with _OBS.span("actor_put"):
+            put_round(self.queue, acc.extract())
         return n * cfg.trajectory

@@ -291,8 +291,8 @@ def build_report(tdir: str, merge: bool = True) -> str:
     any_counter = False
     for shard in shards:
         for name, stats in sorted(shard.counter_rates().items()):
-            if name.startswith("staleness_bucket/"):
-                continue  # rendered as the staleness histogram below
+            if name.startswith(("staleness_bucket/", "codec/")):
+                continue  # rendered as their own sections below
             any_counter = True
             out(f"  {shard_label(shard):<14} {name:<28} "
                 f"total {stats['total']:>12.0f}   {stats['rate']:>10.1f}/s")
@@ -351,6 +351,38 @@ def build_report(tdir: str, merge: bool = True) -> str:
         out("")
         out("-- Shm ring (co-hosted data plane) --")
         lines.extend(ring_lines)
+
+    # Codec fast path (data/codec.py): schema-cache hit rates and the
+    # dedup wire-byte cut. Section only appears when a run recorded the
+    # codec counters (telemetry on + codec providers registered).
+    codec_lines: list[str] = []
+    for shard in shards:
+        rates = shard.counter_rates()
+        if not any(k.startswith("codec/") for k in rates):
+            continue
+
+        def total(key, rates=rates):
+            return rates.get(key, {}).get("total", 0)
+
+        for side, label in (("encode", "encode schema-cache"),
+                            ("decode", "decode schema-cache"),
+                            ("dedup_plan", "dedup plan-cache")):
+            hits, misses = total(f"codec/{side}_hits"), total(f"codec/{side}_misses")
+            if hits + misses > 0:
+                codec_lines.append(
+                    f"  {shard_label(shard)}: {label} "
+                    f"{100 * hits / (hits + misses):.1f}% hit "
+                    f"({hits:.0f}/{hits + misses:.0f})")
+        blobs, saved = total("codec/dedup_blobs"), total("codec/dedup_bytes_saved")
+        if blobs > 0:
+            codec_lines.append(
+                f"  {shard_label(shard)}: dedup packed {blobs:.0f} blobs, "
+                f"saved {saved / 1e6:.1f} MB on the wire "
+                f"({saved / blobs / 1e3:.0f} KB/blob)")
+    if codec_lines:
+        out("")
+        out("-- Codec fast path (schema cache + frame-stack dedup) --")
+        lines.extend(codec_lines)
 
     out("")
     out("-- Weight publication --")

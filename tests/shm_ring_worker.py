@@ -3,7 +3,12 @@
 Attaches the named ring, encodes a DETERMINISTIC trajectory set (the
 parent test builds the identical set from the same seed and ships it
 over the TCP transport), puts each blob, latches producer-closed, exits.
-Usage: python tests/shm_ring_worker.py <ring_name> <seed> <count>
+Usage: python tests/shm_ring_worker.py <ring_name> <seed> <count> [stacked]
+
+`stacked` selects the frame-stacked fixture (newest-last planes, like
+envs/atari.py), and the worker honors DRL_OBS_DEDUP exactly like the
+real actor put path — the dedup two-process e2e sets it in the child's
+env and asserts the drained trajectories are bit-identical anyway.
 """
 
 import os
@@ -32,15 +37,40 @@ def make_trajectories(seed: int, count: int) -> list:
     return out
 
 
+def make_stacked_trajectories(seed: int, count: int) -> list:
+    """Frame-stacked fixture: `[T, H, W, S]` uint8 obs built from a
+    shared plane timeline (obs[t,:,:,j] = plane[t+j], newest-last), with
+    a mid-unroll discontinuity (episode-reset analogue) every third
+    trajectory — the shape the dedup packer targets."""
+    rng = np.random.RandomState(seed)
+    out = []
+    T, H, W, S = 10, 24, 24, 4
+    for i in range(count):
+        planes = rng.randint(0, 255, (T + S - 1, H, W)).astype(np.uint8)
+        obs = np.lib.stride_tricks.sliding_window_view(planes, S, axis=0).copy()
+        if i % 3 == 2:  # reset mid-unroll: zeroed stack, fresh newest plane
+            obs[T // 2] = 0
+            obs[T // 2, :, :, -1] = planes[T // 2 + S - 1]
+        out.append({
+            "obs": obs,
+            "reward": rng.standard_normal(T).astype(np.float32),
+            "action": rng.randint(0, 4, T).astype(np.int32),
+        })
+    return out
+
+
 def main() -> None:
     from distributed_reinforcement_learning_tpu.data import codec
     from distributed_reinforcement_learning_tpu.runtime.shm_ring import ShmRing
 
     name, seed, count = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    fixture = (make_stacked_trajectories if "stacked" in sys.argv[4:]
+               else make_trajectories)
     ring = ShmRing.attach(name)
     try:
-        for traj in make_trajectories(seed, count):
-            assert ring.put_blob(codec.encode(traj), timeout=30.0)
+        for traj in fixture(seed, count):
+            blob = codec.encode(traj, dedup=codec.obs_dedup_enabled())
+            assert ring.put_blob(blob, timeout=30.0)
         ring.close_producer()
     finally:
         ring.close()

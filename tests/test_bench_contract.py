@@ -41,7 +41,7 @@ _TRIMMED = {
     "BENCH_R2D2": "0", "BENCH_APEX": "0", "BENCH_XIMPALA": "0",
     "BENCH_APEX_INGEST": "0", "BENCH_INGEST": "0",
     "BENCH_ANAKIN": "0", "BENCH_ANAKIN_R2D2": "0",
-    "BENCH_TRANSPORT": "0",
+    "BENCH_TRANSPORT": "0", "BENCH_CODEC": "0",
 }
 
 
@@ -127,6 +127,65 @@ class TestTransportCompare:
             ring_auto_enabled)
 
         assert ring_auto_enabled() is verdict["auto_enable"]
+
+
+class TestCodecCompare:
+    """bench_codec_compare: the old-vs-new encode+PUT A/B whose verdict
+    gates the codec schema cache and frame-stack dedup defaults
+    (data/codec.py). Driven directly at a tiny stacked config — the
+    committed adjudication lives in benchmarks/codec_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        # Ambient shell may export the documented knobs; the A/B itself
+        # must run the same regardless (the child strips them).
+        monkeypatch.delenv("DRL_CODEC_CACHE", raising=False)
+        monkeypatch.delenv("DRL_OBS_DEDUP", raising=False)
+        bench = _load_bench()
+        from distributed_reinforcement_learning_tpu.agents.impala import ImpalaConfig
+        from distributed_reinforcement_learning_tpu.data import codec
+
+        cfg = ImpalaConfig(obs_shape=(12, 12, 4), num_actions=2, trajectory=8,
+                           lstm_size=16)
+        r = bench.bench_codec_compare(cfg, n_unrolls=32, reps=1)
+        for side in ("cold", "cached", "dedup"):
+            assert r[side]["frames_per_s"] > 0, r
+            assert r[side]["put_ms_p99"] >= r[side]["put_ms_p50"]
+        # The stacked leaf must actually have packed (dedup saw the
+        # redundancy), and the A/B must restore the caller's env.
+        assert r["packed_bytes"] < r["unroll_bytes"]
+        assert r["cached_vs_cold"] > 0 and r["dedup_vs_cached"] > 0
+        assert r["cache_auto_enable"] == (r["cached_vs_cold"] >= 1.2)
+        assert r["dedup_auto_enable"] == (r["dedup_vs_cached"] >= 1.2)
+        assert r["verdict"].startswith("codec cache ")
+        assert os.environ.get("DRL_CODEC_CACHE") is None
+        codec.refresh_flags()
+
+    def test_compact_line_carries_codec_verdict_key(self):
+        bench = _load_bench()
+        assert "codec_verdict" in bench._COMPACT_KEYS
+
+    def test_committed_verdict_file_consistent(self):
+        """The committed adjudication parses, and the codec gates follow
+        it when the env knobs are unset."""
+        verdict = json.loads(
+            (REPO / "benchmarks" / "codec_verdict.json").read_text())
+        assert isinstance(verdict["cache_auto_enable"], bool)
+        assert isinstance(verdict["dedup_auto_enable"], bool)
+        assert verdict["cache_ratio_runs"] and verdict["bar"] == 1.2
+        from distributed_reinforcement_learning_tpu.data import codec
+
+        old = {k: os.environ.pop(k, None)
+               for k in ("DRL_CODEC_CACHE", "DRL_OBS_DEDUP")}
+        try:
+            codec.refresh_flags()
+            assert codec.cache_enabled() is verdict["cache_auto_enable"]
+            assert codec.obs_dedup_enabled() is verdict["dedup_auto_enable"]
+        finally:
+            for k, v in old.items():
+                if v is not None:
+                    os.environ[k] = v
+            codec.refresh_flags()
 
 
 class TestDeviceChunkGate:
