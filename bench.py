@@ -71,7 +71,7 @@ def _probe_backend(timeout: float) -> tuple[str | None, str | None]:
 # regression must survive into the compact line the driver reads).
 _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
                  "watchdog", "chunk_regressions", "transport_verdict",
-                 "codec_verdict")
+                 "codec_verdict", "weights_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -1439,6 +1439,291 @@ def bench_codec_compare(cfg, n_unrolls: int = 192,
     return out
 
 
+# Child-process actor for bench_weights_compare: the deployed co-hosted
+# actor loop at one remove — each round PUTs a batch of pre-encoded
+# trajectory blobs over the real TCP transport AND polls the weight
+# plane (TCP GET_WEIGHTS vs the shm board, selected by argv), so the
+# learner-side publish/serve work genuinely overlaps the pulls under
+# adjudication instead of time-slicing one GIL with them.
+_WEIGHTS_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.data import codec
+from distributed_reinforcement_learning_tpu.runtime.transport import (
+    OP_PUT_TRAJ_N, RemoteWeights, TransportClient, pack_batch)
+from distributed_reinforcement_learning_tpu.utils.synthetic import (
+    synthetic_impala_batch)
+
+(host, port, board_name, T, rounds, upp, obs_shape, num_actions, lstm) = (
+    sys.argv[1], int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]), json.loads(sys.argv[7]),
+    int(sys.argv[8]), int(sys.argv[9]))
+batch = synthetic_impala_batch(1, T, tuple(obs_shape), num_actions, lstm,
+                               uniform_behavior=False)
+one = type(batch)(*[np.asarray(v)[0] for v in batch])
+blob = bytes(codec.encode(one))
+parts = pack_batch([blob] * upp)
+client = TransportClient(host, port, busy_timeout=120.0)
+if board_name:
+    from distributed_reinforcement_learning_tpu.runtime import weight_board
+
+    src = weight_board.attach_board_weights(board_name, client,
+                                            deadline_s=10.0)
+    assert src is not None and src._board is not None, "board attach failed"
+else:
+    src = RemoteWeights(client)
+
+
+def put_call():
+    status, resp = client._exchange(OP_PUT_TRAJ_N, parts, retry=False,
+                                    resend=False)
+    assert status == 0, f"put failed: status {status}"
+
+
+put_call()  # warm the connection + server buffers
+have = -1
+got = src.get_if_newer(have)  # warm the pull path (and any codec caches)
+if got is not None:
+    have = got[1]
+pull_ms = []
+pulled = 0
+t0 = time.perf_counter()
+for _ in range(rounds):
+    c0 = time.perf_counter()
+    got = src.get_if_newer(have)
+    pull_ms.append((time.perf_counter() - c0) * 1e3)
+    if got is not None:
+        have = got[1]
+        pulled += 1
+    put_call()
+elapsed = time.perf_counter() - t0
+out = {"frames_per_s": round(rounds * upp * T / elapsed, 1),
+       "pull_ms": [round(ms, 4) for ms in pull_ms],
+       "weight_pulls": pulled, "last_version": have}
+if board_name and hasattr(src, "snapshot_stats"):
+    out["board_stats"] = src.snapshot_stats()
+print("WEIGHTS_CHILD=" + json.dumps(out))
+"""
+
+
+def bench_weights_compare(cfg, n_actors: int = 2, rounds: int = 96,
+                          unrolls_per_put: int = 8,
+                          publish_period_s: float = 0.04) -> dict:
+    """Two-process A/B of the learner->actor WEIGHT plane for co-hosted
+    topologies: TCP GET_WEIGHTS pulls (the deployed wire path, already
+    encode-once via `WeightStore.get_blob`) vs the shared-memory weight
+    board (runtime/weight_board.py — a pull is a shm version peek plus
+    one memcpy only when the version changed). Both variants run the
+    SAME params pytree, the same publish cadence through the real
+    `PublishCadenceMixin.maybe_publish` (async publication, handoff +
+    bounded-staleness stall stages recorded per invocation), and the
+    same actor-side trajectory PUT load from `n_actors` REAL child
+    processes — so the learner-side serve work overlaps the pulls on
+    its own core and e2e frames/s reflects what the weight plane costs
+    the data plane.
+
+    The verdict follows the repo's adjudication bar (Pallas-LSTM rule):
+    the board ships enabled-by-default ONLY if the A/B shows >= 1.2x
+    e2e frames/s; the committed `benchmarks/weights_verdict.json`
+    carries the decision `runtime/weight_board.board_enabled()` consults.
+    Host-only, link-independent.
+    """
+    import contextlib
+
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.runtime import weight_board
+    from distributed_reinforcement_learning_tpu.runtime.publishing import (
+        PublishCadenceMixin)
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        TransportServer, _make_queue)
+    from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+    T = cfg.trajectory
+    # A mid-sized conv-net-shaped params pytree (~4 MB), identical for
+    # both variants — the blob the weight plane actually moves.
+    rng = np.random.RandomState(0)
+    params = {
+        f"layer{i}": {"w": rng.standard_normal((256, 512)).astype(np.float32),
+                      "b": rng.standard_normal(512).astype(np.float32)}
+        for i in range(8)
+    }
+    params["step"] = np.zeros((), np.int64)
+
+    class _RecTimer:
+        """StageTimer.stage duck-type keeping per-invocation samples —
+        maybe_publish's publish/publish_handoff/publish_stall split."""
+
+        def __init__(self):
+            self.samples: dict[str, list[float]] = {}
+
+        @contextlib.contextmanager
+        def stage(self, name):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                self.samples.setdefault(name, []).append(
+                    (time.perf_counter() - t0) * 1e3)
+
+    class _Publisher(PublishCadenceMixin):
+        publish_interval = 1
+
+        def __init__(self, weights):
+            self.weights = weights
+            self.train_steps = 0
+            self.timer = _RecTimer()
+
+            class _State:
+                pass
+
+            self.state = _State()
+            self.state.params = params
+
+    def pctl(sorted_ms, q):
+        return round(sorted_ms[min(int(q * (len(sorted_ms) - 1) + 0.5),
+                                   len(sorted_ms) - 1)], 3)
+
+    def stage_p(samples: dict, name: str) -> dict:
+        vals = sorted(samples.get(name, []))
+        if not vals:
+            return {"p50_ms": 0.0, "p99_ms": 0.0, "n": 0}
+        return {"p50_ms": pctl(vals, 0.50), "p99_ms": pctl(vals, 0.99),
+                "n": len(vals)}
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"  # the children never touch a device
+
+    def run_variant(board_name: str) -> dict:
+        queue = _make_queue(128)
+        weights = WeightStore()
+        board = None
+        if board_name:
+            board = weight_board.WeightBoard.create(
+                board_name, weight_board.board_capacity_bytes())
+            weights.attach_board(board)
+        server = TransportServer(queue, weights, host="127.0.0.1",
+                                 port=_free_port()).start()
+        stop = threading.Event()
+
+        def drain_loop():
+            raw = hasattr(queue, "put_bytes")
+            cap = 1 << 16
+            while not stop.is_set():
+                try:
+                    if raw:
+                        got = queue._q.get_batch_raw(16, cap, timeout=0.2)
+                        if got is not None:
+                            cap = got[1]
+                    else:
+                        queue.get(timeout=0.2)
+                except RuntimeError:
+                    return
+
+        pub = _Publisher(weights)
+        pub.train_steps = 1
+        pub.maybe_publish()  # version 1 lands before any child attaches
+        assert weights.flush_async(timeout=30.0)
+
+        def pub_loop():
+            while not stop.wait(publish_period_s):
+                params["step"] = np.asarray(pub.train_steps + 1, np.int64)
+                pub.train_steps += 1
+                pub.maybe_publish()
+
+        threads = [threading.Thread(target=drain_loop, daemon=True),
+                   threading.Thread(target=pub_loop, daemon=True)]
+        for t in threads:
+            t.start()
+        try:
+            procs = [subprocess.Popen(
+                [sys.executable, "-c", _WEIGHTS_CHILD, "127.0.0.1",
+                 str(server.port), board_name, str(T), str(rounds),
+                 str(unrolls_per_put), json.dumps(list(cfg.obs_shape)),
+                 str(cfg.num_actions), str(cfg.lstm_size)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True) for _ in range(n_actors)]
+            results = []
+            for proc in procs:
+                out_s, err_s = proc.communicate(timeout=600)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"weights_compare child rc={proc.returncode}: "
+                        f"{err_s.strip()[-500:]}")
+                line = next(ln for ln in out_s.splitlines()
+                            if ln.startswith("WEIGHTS_CHILD="))
+                results.append(json.loads(line.split("=", 1)[1]))
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+            weights.close()
+            server.stop()
+            queue.close()
+            if board is not None:
+                board.close_writer()
+                board.close()
+                board.unlink()
+        pull_ms = sorted(ms for r in results for ms in r["pull_ms"])
+        samples = pub.timer.samples
+        out = {
+            "frames_per_s": round(sum(r["frames_per_s"] for r in results), 1),
+            "weight_pulls": sum(r["weight_pulls"] for r in results),
+            "weight_pull_ms_p50": pctl(pull_ms, 0.50),
+            "weight_pull_ms_p99": pctl(pull_ms, 0.99),
+            "publish": stage_p(samples, "publish"),
+            "publish_handoff": stage_p(samples, "publish_handoff"),
+            "publish_stall": stage_p(samples, "publish_stall"),
+            "versions_published": pub.train_steps,
+        }
+        if board_name:
+            # Aggregate EVERY child's board counters — and refuse to
+            # record a "board" number that silently measured TCP: a
+            # child that demoted mid-run (tcp_fallbacks > 0) would
+            # poison the adjudication artifact with a mislabeled ratio.
+            agg: dict = {}
+            for r in results:
+                for k, v in r.get("board_stats", {}).items():
+                    agg[k] = agg.get(k, 0) + v
+            out["board_stats"] = agg
+            if agg.get("tcp_fallbacks", 0):
+                raise RuntimeError(
+                    f"board variant demoted to TCP mid-run "
+                    f"(tcp_fallbacks={agg['tcp_fallbacks']}): the measurement "
+                    f"is not a board number; rerun on a quiet host")
+        return out
+
+    from distributed_reinforcement_learning_tpu.data import codec as _codec
+
+    blob_bytes = len(_codec.encode(params, cache=True))
+    out: dict = {
+        "params_bytes": blob_bytes, "n_actors": n_actors,
+        "rounds_per_actor": rounds, "unrolls_per_put": unrolls_per_put,
+        "publish_period_s": publish_period_s,
+        "note": ("same params pytree + publish cadence + PUT load both "
+                 "sides; actors are separate PROCESSES (deployed "
+                 "co-hosted topology), learner publishes via the real "
+                 "async PublishCadenceMixin path")}
+    out["tcp"] = run_variant("")
+    out["board"] = run_variant(f"drlwb-bench-{os.getpid()}")
+    ratio = out["board"]["frames_per_s"] / max(out["tcp"]["frames_per_s"], 1e-9)
+    pull_ratio = out["tcp"]["weight_pull_ms_p50"] / max(
+        out["board"]["weight_pull_ms_p50"], 1e-9)
+    out["board_vs_tcp"] = round(ratio, 2)
+    out["pull_p50_speedup"] = round(pull_ratio, 2)
+    out["auto_enable"] = ratio >= 1.2  # the repo's adjudication bar
+    out["verdict"] = (f"board {ratio:.2f}x tcp e2e "
+                      f"(pull p50 {pull_ratio:.1f}x): "
+                      + ("auto-on" if out["auto_enable"] else "opt-in"))
+    print(f"[bench] weights_compare: tcp {out['tcp']['frames_per_s']:,.0f} "
+          f"f/s vs board {out['board']['frames_per_s']:,.0f} f/s "
+          f"-> {out['verdict']}", file=sys.stderr)
+    return out
+
+
 def bench_r2d2_learn(B: int, iters: int) -> dict:
     """R2D2 learn-step throughput (env-frames/s) at the reference replay
     shape — the training hot path that runs the fused Pallas LSTM
@@ -2277,6 +2562,18 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["codec_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] codec_compare failed: {e}", file=sys.stderr)
+
+    # Two-process weight-plane A/B (the auto-enable adjudication for the
+    # shm weight board, runtime/weight_board.py).
+    if os.environ.get("BENCH_WEIGHTS", "1") == "1" and _ok("weights_compare", 120):
+        try:
+            r = bench_weights_compare(cfg)
+            extra["weights_compare"] = r
+            if "verdict" in r:
+                extra["weights_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["weights_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] weights_compare failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_KERNELS", "1") == "1" and _ok("kernel_compare", 240):
         try:

@@ -493,7 +493,7 @@ def _blob_from_plan(plan: _EncodePlan, leaves: list[np.ndarray],
     return buf
 
 
-def encode(tree: Any, dedup: bool = False) -> np.ndarray:
+def encode(tree: Any, dedup: bool = False, cache: bool | None = None) -> np.ndarray:
     """Pack a pytree of numpy arrays into one contiguous blob.
 
     Returns a uint8 ndarray (bytes-like everywhere it's consumed) and
@@ -505,9 +505,14 @@ def encode(tree: Any, dedup: bool = False) -> np.ndarray:
     (see the module docstring); decode reconstructs bit-identically, and
     when no leaf qualifies the blob is byte-identical to a plain encode.
     Schema-cached when `cache_enabled()`: a warm encode skips the
-    `_flatten` walk and the json header build entirely.
+    `_flatten` walk and the json header build entirely. `cache`
+    overrides that gate per call (cache-hit blobs are byte-identical to
+    cold encodes, so overriding changes cost, never bytes): the weight
+    plane forces it on — its per-version publish encode has a stable
+    schema and is not what the committed trajectory-path verdict
+    adjudicated.
     """
-    if not cache_enabled():
+    if not (cache_enabled() if cache is None else cache):
         # Pre-cache behavior, kept as the adjudication baseline and the
         # DRL_CODEC_CACHE=0 escape hatch.
         pairs: list[tuple[str, np.ndarray]] = []
@@ -564,12 +569,13 @@ def parse_layout(blob: bytes | memoryview) -> tuple[Any, list[dict], int]:
     return plan.skel, metas, plan.payload_start
 
 
-def _layout_plan(view: memoryview) -> _DecodePlan:
+def _layout_plan(view: memoryview, cache: bool | None = None) -> _DecodePlan:
     if int.from_bytes(view[0:4], "little") != _MAGIC:
         raise ValueError("bad magic: not a codec blob")
     header_len = int.from_bytes(view[4:8], "little")
     header = bytes(view[8:8 + header_len])
-    if cache_enabled():
+    use_cache = cache_enabled() if cache is None else cache
+    if use_cache:
         plan = _CACHES.lookup_decode(header)
         if plan is not None:
             return plan
@@ -591,7 +597,7 @@ def _layout_plan(view: memoryview) -> _DecodePlan:
         leaves.append((dtype, shape, nbytes, meta["offset"], pack))
         end = max(end, meta["offset"] + stored)
     plan = _DecodePlan(skel, metas, payload_start, tuple(leaves), packed, end)
-    if cache_enabled():
+    if use_cache:
         _CACHES.store_decode(header, plan)
     return plan
 
@@ -643,16 +649,18 @@ def unpack_blob(blob):
     return encode(decode(blob))
 
 
-def decode(blob: bytes | memoryview, copy: bool = False) -> Any:
+def decode(blob: bytes | memoryview, copy: bool = False,
+           cache: bool | None = None) -> Any:
     """Unpack a blob; arrays view the blob unless copy=True (packed
     leaves are always materialized as owned arrays).
 
     copy=True allocates ONE owned payload buffer and copies the blob's
     payload region into it in a single memcpy — not one slice+copy per
-    leaf, which double-touched multi-MB observation leaves.
+    leaf, which double-touched multi-MB observation leaves. `cache`
+    overrides the layout-cache gate per call (see `encode`).
     """
     view = memoryview(blob)
-    plan = _layout_plan(view)
+    plan = _layout_plan(view, cache)
     payload_start = plan.payload_start
     src = view
     base_off = payload_start

@@ -214,6 +214,7 @@ class TransportServer(_LockedStatsMixin):
         "_conns": "_conns_lock",
         "_threads": "_conns_lock",
         "_enc_cache": "_enc_lock",
+        "_encoding": "_enc_lock",
     }
 
     def __init__(self, queue, weights, host: str = "0.0.0.0", port: int = 8000,
@@ -229,6 +230,7 @@ class TransportServer(_LockedStatsMixin):
         self._conns_lock = threading.Lock()
         self._enc_lock = threading.Lock()
         self._enc_cache: tuple[int, bytes] = (-1, b"")
+        self._encoding = False  # one thread encodes; the rest stale-serve
         # Data-plane observability (the 20-actor scale demo and
         # tests/test_actor_scale.py read these): accepted unrolls,
         # ST_BUSY replies, partial batched accepts, weight sends.
@@ -331,13 +333,43 @@ class TransportServer(_LockedStatsMixin):
                 self._threads.append(t)
 
     def _weights_blob(self) -> tuple[int, bytes]:
-        # Read-then-cache entirely under the lock, and only move the cache
-        # forward: a preempted thread holding an older (params, version) pair
-        # must not regress the cache and hand stale weights to actors.
+        # Fast path: the weight store publishes pre-encoded blobs
+        # (encode-ONCE per version, at publish time, off the serve
+        # threads — runtime/weights.py) and this just hands them out.
+        # No cache to keep coherent, and a rollback republish serves the
+        # store's truth (the backward version) instead of a pinned max.
+        get_blob = getattr(self.weights, "get_blob", None)
+        if get_blob is not None:
+            blob, version = get_blob()
+            if blob is None:
+                return -1, b""
+            return version, blob
+        # Fallback for stores without blobs: encode OUTSIDE `_enc_lock`,
+        # double-checked, only-forward (a preempted thread holding an
+        # older (params, version) pair must not regress the cache). While
+        # one thread encodes a new version, concurrent pulls serve the
+        # PREVIOUS cached version instead of stalling N actors behind one
+        # full-params encode — weights are stale-tolerant by design, a
+        # serialized encode convoy is the publish-p99 spike this exists
+        # to kill.
         with self._enc_lock:
-            params, version = self.weights.get()
-            if version > self._enc_cache[0] and params is not None:
-                self._enc_cache = (version, codec.encode(params))
+            version, blob = self._enc_cache
+            if self._encoding:
+                return version, blob  # stale-serve while the encoder runs
+            params, cur = self.weights.get()
+            if cur <= version or params is None:
+                return version, blob
+            self._encoding = True
+        try:
+            new_blob = codec.encode(params)
+        except BaseException:
+            with self._enc_lock:
+                self._encoding = False
+            raise
+        with self._enc_lock:
+            self._encoding = False
+            if cur > self._enc_cache[0]:  # double-checked, only-forward
+                self._enc_cache = (cur, new_blob)
             return self._enc_cache
 
     def _serve(self, conn: socket.socket) -> None:
@@ -893,6 +925,20 @@ def run_role(
         from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 
         weights = WeightStore()
+        # Co-hosted actors' publish-once weight plane (runtime/
+        # weight_board.py): the launcher names one board per learner;
+        # this side creates the segment and the WeightStore mirrors every
+        # landed publication into it (one memcpy, independent of actor
+        # count). Failure leaves TCP-only weight pulls.
+        board = None
+        board_name = os.environ.get("DRL_SHM_WEIGHTS_CREATE", "").strip()
+        if board_name:
+            from distributed_reinforcement_learning_tpu.runtime import weight_board
+
+            board = weight_board.serve_board(board_name)
+            if board is not None:
+                weights.attach_board(board)
+                print("[learner] shm weight board serving co-hosted actors")
         learner = launch.make_learner(
             algo, agent_cfg, rt, queue, weights, logger=logger,
             rng=jax.random.PRNGKey(seed),
@@ -978,6 +1024,11 @@ def run_role(
             server.stop()
             if ring_drainer is not None:
                 ring_drainer.stop()  # closes, unlinks the shm segments
+            if board is not None:
+                weights.close()        # drain pending async publishes
+                board.close_writer()   # attached actors demote to TCP
+                board.close()
+                board.unlink()
             if inference is not None:
                 inference.stop()
             _OBS.close()  # final shard flush + trace terminator
@@ -1014,8 +1065,21 @@ def run_role(
             if rq is not None:
                 actor_queue = rq
                 print(f"[actor {task}] shm ring attached: {ring_name}")
+        # Publish-once weight plane: when the launcher named a board, a
+        # weight pull becomes a shared-memory version peek (no syscall)
+        # plus one memcpy only when the version actually changed. Attach
+        # failure or a dead board falls back to TCP pulls.
+        actor_weights: Any = RemoteWeights(client)
+        board_name = os.environ.get("DRL_SHM_WEIGHTS_NAME")
+        if board_name:
+            from distributed_reinforcement_learning_tpu.runtime import weight_board
+
+            bw = weight_board.attach_board_weights(board_name, client)
+            if bw is not None:
+                actor_weights = bw
+                print(f"[actor {task}] shm weight board attached: {board_name}")
         actor = launch.make_actor(
-            algo, agent_cfg, rt, task, actor_queue, RemoteWeights(client),
+            algo, agent_cfg, rt, task, actor_queue, actor_weights,
             seed=seed + 1 + task,
             remote_act=RemoteInference(client) if remote_act else None,
         )
@@ -1031,6 +1095,11 @@ def run_role(
                 for key in actor_queue.snapshot_stats():
                     _OBS.sample(f"ring/{key}",
                                 lambda k=key: actor_queue.stat(k),
+                                kind="counter")
+            if hasattr(actor_weights, "snapshot_stats"):  # BoardWeights only
+                for key in actor_weights.snapshot_stats():
+                    _OBS.sample(f"board/{key}",
+                                lambda k=key: actor_weights.stat(k),
                                 kind="counter")
             # Actor-side codec counters: schema-cache hit rate on the
             # encode path and dedup bytes saved (the wire-byte cut the
@@ -1085,6 +1154,8 @@ def run_role(
         finally:
             if hasattr(actor_queue, "close"):  # RingQueue: release the shm map
                 actor_queue.close()
+            if hasattr(actor_weights, "close"):  # BoardWeights: ditto
+                actor_weights.close()
             client.close()
             _OBS.close()  # final shard flush + trace terminator
     else:

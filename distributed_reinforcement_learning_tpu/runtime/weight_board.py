@@ -1,0 +1,524 @@
+"""Shared-memory weight board: publish-once broadcast for co-hosted actors.
+
+The learner->actor mirror of `runtime/shm_ring.py`'s trajectory path.
+Today a remote weight pull is a TCP round trip carrying the full encoded
+params blob per actor per new version; co-hosted actors pay the wire
+frame, two kernel copies, and the RTT for bytes that already live on
+their own host — the broadcast asymmetry IMPALA (arXiv:1802.01561) and
+the Podracer architectures (arXiv:2104.06272) identify as the scaling
+limit of actor-learner topologies. This module is the fix for the
+co-hosted half: ONE seqlock-style double-buffered shared-memory segment,
+written once per published version by the learner's weight store and
+read by every co-hosted actor:
+
+- a PUBLISH is one memcpy of the already-encoded blob
+  (`WeightStore.get_blob`'s bytes) into the INACTIVE slot plus an
+  atomic meta flip — cost independent of actor count;
+- a PULL is a pure shared-memory version peek (no syscall, no wire) and,
+  only when the version actually changed, one memcpy out.
+
+Memory layout (offsets in the shared segment; cache-line-spaced like
+`shm_ring`):
+
+    0    magic u32 | version u32 | slot_bytes u64
+    64   meta_seq u64     — seqlock word: odd = meta write in progress
+    72   active u64       — which slot holds the committed blob (0/1)
+    80   version i64      — the committed publication's version
+    88   blob_len u64
+    128  slot0_seq u64    — per-slot seqlock word (odd = being written)
+    192  slot1_seq u64
+    256  writer_closed u32
+    320  slot0[slot_bytes] | slot1[slot_bytes]
+
+Write protocol (single writer — the weight store, under its lock):
+slot_seq[target]+1 (odd) -> payload memcpy -> slot_seq[target]+1 (even)
+-> meta_seq+1 (odd) -> {active, version, len} -> meta_seq+1 (even).
+Readers read meta under the meta seqlock, then copy the active slot and
+validate the slot's seq was even and unchanged across the copy. Double
+buffering makes retries RARE, not merely detectable: a publish never
+touches the slot a reader selected — only a second publish during one
+read does, and that is exactly what the slot seq catches (pinned by
+tests/test_weight_board.py's mid-pull flip test).
+
+Why this is safe without atomics — and WHERE: same argument as
+`shm_ring` (single writer per word, aligned 8-byte stores/loads through
+a memoryview are single memcpys CPython never tears, x86-64 TSO orders
+payload stores before the seq/meta publish stores). On weakly-ordered
+CPUs that argument does not hold, so `board_enabled()` refuses to
+auto-enable off x86-64 (DRL_SHM_WEIGHTS=1 still forces, for
+single-machine testing) and a read that never stabilizes fails LOUDLY
+(BoardClosed -> the actor's permanent TCP fallback) instead of decoding
+garbage.
+
+Lifecycle: the LEARNER creates the board (`serve_board`, name from
+`DRL_SHM_WEIGHTS_CREATE`), attaches it to its WeightStore, and unlinks
+at exit (atexit backstop; the local-cluster launcher additionally reaps
+leaked segments). Actors attach by name (`DRL_SHM_WEIGHTS_NAME`) with a
+bounded retry and FALL BACK to TCP pulls when the board never appears,
+the writer latches closed, or a read fails. `DRL_SHM_WEIGHTS` gates the
+feature: 1 forces on, 0 off, unset defers to the committed
+`benchmarks/weights_verdict.json` adjudication written from bench.py's
+`weights_compare` section (the repo's Pallas-LSTM rule: no
+un-adjudicated fast path ships enabled).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import struct
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+from distributed_reinforcement_learning_tpu.runtime.shm_ring import _attach_shm
+from distributed_reinforcement_learning_tpu.runtime.transport import _LockedStatsMixin
+
+_MAGIC = 0x44525742  # "DRWB"
+_VERSION = 1
+_META_SEQ_OFF = 64
+_ACTIVE_OFF = 72
+_VER_OFF = 80
+_LEN_OFF = 88
+_SLOT_SEQ_OFF = (128, 192)
+_WCLOSED_OFF = 256
+_DATA_OFF = 320
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_I64 = struct.Struct("<q")
+_SPIN = 200          # bounded spin before the first sleep (shm_ring's)
+_SLEEP_MIN = 50e-6
+_SLEEP_MAX = 1e-3
+
+
+def _align8(n: int) -> int:
+    return (n + 7) & ~7
+
+
+class BoardClosed(ConnectionError):
+    """The board is unusable (writer gone/latched closed, or a read that
+    never stabilized — torn publish on a weakly-ordered CPU). Subclasses
+    ConnectionError so actor loops treat it like a transport outage."""
+
+
+class WeightBoard:
+    """One double-buffered versioned blob board. Exactly one process
+    writes (`publish_blob` — the learner's WeightStore, serialized under
+    its lock); any number of co-hosted processes read (`read_blob`);
+    the creator additionally owns `unlink`.
+
+    Concurrency map (tools/drlint lock-discipline): deliberately EMPTY
+    and kept as documentation — the board is lock-free by construction.
+    Every shared word has a single writer (the learner side), readers
+    validate via the seqlocks, and the local attributes (`_active`,
+    `read_retries`) are each touched by exactly one side's single
+    thread. Cross-process visibility goes through the shared segment,
+    never through Python attributes.
+    """
+
+    _GUARDED_BY: dict = {}
+
+    def __init__(self, shm, slot_bytes: int, owner: bool):
+        self._shm = shm
+        self._buf = shm.buf
+        self.slot_bytes = slot_bytes
+        self.name = shm.name.lstrip("/")
+        self._owner = owner
+        self._closed = False
+        self._active = int(self._read_u64(_ACTIVE_OFF))  # writer-side only
+        self.read_retries = 0  # reader-side only (seqlock retry count)
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def create(cls, name: str, slot_bytes: int) -> "WeightBoard":
+        from multiprocessing import shared_memory
+
+        slot_bytes = _align8(max(slot_bytes, 4096))
+        shm = shared_memory.SharedMemory(
+            name=name, create=True, size=_DATA_OFF + 2 * slot_bytes)
+        board = cls(shm, slot_bytes, owner=True)
+        # Magic is written LAST: the header's commit word (an attacher
+        # racing this constructor either sees no magic and retries, or a
+        # fully-initialized header — never a zero slot size).
+        board._write_u64(8, slot_bytes)
+        board._write_u64(_META_SEQ_OFF, 0)
+        board._write_u64(_ACTIVE_OFF, 0)
+        board._write_i64(_VER_OFF, -1)  # nothing published yet
+        board._write_u64(_LEN_OFF, 0)
+        board._write_u64(_SLOT_SEQ_OFF[0], 0)
+        board._write_u64(_SLOT_SEQ_OFF[1], 0)
+        board._write_u32(_WCLOSED_OFF, 0)
+        board._write_u32(4, _VERSION)
+        board._write_u32(0, _MAGIC)
+        return board
+
+    @classmethod
+    def attach(cls, name: str) -> "WeightBoard":
+        shm = _attach_shm(name)
+        view = shm.buf
+        magic = _U32.unpack_from(view, 0)[0]
+        version = _U32.unpack_from(view, 4)[0]
+        slot_bytes = int(_U64.unpack_from(view, 8)[0])
+        if (magic != _MAGIC or version != _VERSION or slot_bytes <= 0
+                or shm.size < _DATA_OFF + 2 * slot_bytes):
+            shm.close()
+            raise ValueError(f"{name}: not an initialized v{_VERSION} "
+                             f"shm weight board")
+        return cls(shm, slot_bytes, owner=False)
+
+    # -- raw header access -------------------------------------------------
+
+    def _read_u32(self, off: int) -> int:
+        return _U32.unpack_from(self._buf, off)[0]
+
+    def _write_u32(self, off: int, value: int) -> None:
+        _U32.pack_into(self._buf, off, value)
+
+    def _read_u64(self, off: int) -> int:
+        return _U64.unpack_from(self._buf, off)[0]
+
+    def _write_u64(self, off: int, value: int) -> None:
+        _U64.pack_into(self._buf, off, value)
+
+    def _read_i64(self, off: int) -> int:
+        return _I64.unpack_from(self._buf, off)[0]
+
+    def _write_i64(self, off: int, value: int) -> None:
+        _I64.pack_into(self._buf, off, value)
+
+    @property
+    def writer_closed(self) -> bool:
+        return self._read_u32(_WCLOSED_OFF) != 0
+
+    # -- writer side -------------------------------------------------------
+
+    def publish_blob(self, blob, version: int) -> None:
+        """One memcpy into the inactive slot + the meta flip. Single
+        writer; the caller's buffer is consumed by value. Raises
+        ValueError when the blob cannot fit a slot (the store latches
+        the board off and stays on TCP)."""
+        n = len(blob)
+        if n > self.slot_bytes:
+            raise ValueError(
+                f"weight blob of {n} bytes cannot fit a {self.slot_bytes}-"
+                f"byte board slot (raise DRL_SHM_WEIGHTS_MB)")
+        target = 1 - self._active
+        seq_off = _SLOT_SEQ_OFF[target]
+        s = self._read_u64(seq_off)
+        self._write_u64(seq_off, s + 1)  # odd: slot write in progress
+        off = _DATA_OFF + target * self.slot_bytes
+        if n:
+            self._buf[off:off + n] = memoryview(blob).cast("B")
+        self._write_u64(seq_off, s + 2)  # even: slot committed
+        m = self._read_u64(_META_SEQ_OFF)
+        self._write_u64(_META_SEQ_OFF, m + 1)  # odd: meta write in progress
+        self._write_u64(_ACTIVE_OFF, target)
+        self._write_i64(_VER_OFF, version)
+        self._write_u64(_LEN_OFF, n)
+        self._write_u64(_META_SEQ_OFF, m + 2)  # even: publication committed
+        self._active = target
+        if _OBS.enabled:
+            _OBS.count("board/publishes")
+            _OBS.count("board/published_bytes", n)
+
+    def close_writer(self) -> None:
+        """Latch 'no more publications' so readers demote to TCP."""
+        self._write_u32(_WCLOSED_OFF, 1)
+
+    # -- reader side -------------------------------------------------------
+
+    def _read_meta(self) -> tuple[int, int, int, int] | None:
+        """One consistent (slot, version, blob_len, meta_seq), or None to
+        retry. The meta_seq is part of the result: `read_blob` must
+        prove its slot-seq read happened while this meta was still
+        current (see below), so the validation word travels with the
+        values it validated."""
+        s0 = self._read_u64(_META_SEQ_OFF)
+        if s0 & 1:
+            return None
+        slot = int(self._read_u64(_ACTIVE_OFF))
+        version = self._read_i64(_VER_OFF)
+        n = int(self._read_u64(_LEN_OFF))
+        if self._read_u64(_META_SEQ_OFF) != s0 or slot not in (0, 1) \
+                or n > self.slot_bytes:
+            return None
+        return slot, version, n, s0
+
+    def version(self, timeout: float = 1.0) -> int:
+        """The committed publication's version — a pure shared-memory
+        read (-1 before the first publish). BoardClosed if the meta
+        seqlock never stabilizes (writer died mid-publish)."""
+        deadline = time.monotonic() + timeout
+        spins, sleep_s = 0, _SLEEP_MIN
+        while True:
+            meta = self._read_meta()
+            if meta is not None:
+                return meta[1]
+            self.read_retries += 1
+            spins += 1
+            if spins <= _SPIN:
+                continue
+            if time.monotonic() >= deadline:
+                raise BoardClosed(
+                    f"board {self.name}: meta seqlock never stabilized "
+                    f"(writer died mid-publish?)")
+            time.sleep(sleep_s)
+            sleep_s = min(2 * sleep_s, _SLEEP_MAX)
+
+    def _pre_slot_read(self) -> None:
+        """No-op seam between the meta read and the slot-seq read, so
+        tests can inject the exact two-publish race the meta re-check
+        above exists to catch."""
+
+    def _copy_slot(self, slot: int, n: int) -> np.ndarray:
+        """One memcpy of the slot's first n bytes into an owned buffer
+        (split out so tests can inject a racing publish mid-copy)."""
+        out = np.empty(n, np.uint8)
+        off = _DATA_OFF + slot * self.slot_bytes
+        memoryview(out)[:] = self._buf[off:off + n]
+        return out
+
+    def read_blob(self, have_version: int = -2,
+                  timeout: float = 5.0) -> tuple[np.ndarray, int] | None:
+        """The committed blob as an OWNED copy, or None when the
+        committed version equals `have_version` (version IDENTITY, like
+        the TCP server: a rollback republish's backward version must
+        still reach actors) or nothing is published yet. Retries while a
+        publish overlaps the read; BoardClosed if it never stabilizes.
+        """
+        deadline = time.monotonic() + timeout
+        spins, sleep_s = 0, _SLEEP_MIN
+        while True:
+            meta = self._read_meta()
+            if meta is not None:
+                slot, version, n = meta[0], meta[1], meta[2]
+                if version < 0 or version == have_version:
+                    return None
+                self._pre_slot_read()  # test hook (no-op in production)
+                d0 = self._read_u64(_SLOT_SEQ_OFF[slot])
+                # d0 must predate any re-targeting of `slot`: a writer
+                # can only rewrite the ACTIVE slot after first flipping
+                # meta away from it, so an unchanged meta_seq here proves
+                # d0 was read while the slot still held version's bytes.
+                # Without this check, TWO publishes completing between
+                # the meta read and the d0 read would pair the new slot
+                # contents with the OLD (version, len) — stable seqs,
+                # wrong label.
+                if not d0 & 1 and \
+                        self._read_u64(_META_SEQ_OFF) == meta[3]:
+                    out = self._copy_slot(slot, n)
+                    if self._read_u64(_SLOT_SEQ_OFF[slot]) == d0:
+                        return out, version
+            # Meta mid-write, slot mid-write, a publish committed between
+            # the meta and slot-seq reads, or the slot was re-targeted by
+            # a second publish during the copy: go around.
+            self.read_retries += 1
+            spins += 1
+            if spins <= _SPIN:
+                continue
+            if time.monotonic() >= deadline:
+                raise BoardClosed(
+                    f"board {self.name}: read never stabilized "
+                    f"(torn publish?)")
+            time.sleep(sleep_s)
+            sleep_s = min(2 * sleep_s, _SLEEP_MAX)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Release this process's mapping (idempotent; both sides)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._buf = None
+        self._shm.close()
+
+    def unlink(self) -> None:
+        """Remove the segment from /dev/shm (creator only; idempotent)."""
+        if not self._owner:
+            return
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+# -- adjudication gate -------------------------------------------------------
+
+_VERDICT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "benchmarks", "weights_verdict.json")
+
+
+def board_auto_enabled(verdict_path: str = _VERDICT_PATH) -> bool:
+    """The committed `weights_compare` verdict (bench.py): the board
+    ships enabled-by-default only if the A/B showed >= 1.2x, mirroring
+    the repo's Pallas-LSTM adjudication bar."""
+    try:
+        with open(verdict_path) as f:
+            return bool(json.load(f).get("auto_enable", False))
+    except (OSError, ValueError):
+        return False
+
+
+def board_enabled() -> bool:
+    """DRL_SHM_WEIGHTS=1 forces the board on, =0 off; unset/auto defers
+    to the committed adjudication — but never auto-enables off x86-64,
+    where the seqlock's store-ordering argument does not hold (module
+    docstring); the stabilization check + TCP fallback make a forced =1
+    survivable for single-machine experimentation there."""
+    env = os.environ.get("DRL_SHM_WEIGHTS", "").strip().lower()
+    if env in ("1", "true", "yes", "on"):
+        return True
+    if env in ("0", "false", "no", "off"):
+        return False
+    import platform
+
+    if platform.machine().lower() not in ("x86_64", "amd64"):
+        return False
+    return board_auto_enabled()
+
+
+def board_capacity_bytes() -> int:
+    """Per-slot capacity. /dev/shm pages are committed on first touch,
+    so a generous default costs address space, not memory, until a blob
+    of that size is actually published."""
+    return int(float(os.environ.get("DRL_SHM_WEIGHTS_MB", "64")) * 1e6)
+
+
+# -- learner side: create + attach to the WeightStore -------------------------
+
+
+def serve_board(name: str) -> WeightBoard | None:
+    """Learner-side wiring: create the board the co-hosted actors will
+    attach. Returns None (TCP-only operation continues) if the segment
+    cannot be created — the board is an optimization, never a
+    prerequisite. The segment is unlinked at stop and again via atexit
+    (crash backstop)."""
+    import sys
+
+    try:
+        board = WeightBoard.create(name, board_capacity_bytes())
+    except (OSError, ValueError) as e:
+        print(f"[weight_board] WARNING: cannot create board segment "
+              f"({e}); weights stay on TCP", file=sys.stderr)
+        return None
+    atexit.register(board.unlink)
+    return board
+
+
+# -- actor side: get_if_newer surface with graceful TCP fallback --------------
+
+
+class BoardWeights(_LockedStatsMixin):
+    """The actor-runner weights surface (`get_if_newer`) with the data
+    plane on the shm board and the TCP client as fallback. Mirrors
+    `RemoteWeights` semantics exactly — version identity (a rollback
+    republish's backward version still lands), decoded owned pytrees —
+    and demotes PERMANENTLY to TCP pulls on any board failure (writer
+    latched closed at learner shutdown, a read that never stabilizes)
+    rather than killing the actor.
+
+    Concurrency map (tools/drlint lock-discipline): `stats` is bumped on
+    the actor loop thread and polled by the telemetry flush thread's
+    providers (accessors from transport._LockedStatsMixin). `_board` and
+    `_retries_seen` are only ever touched by the actor loop thread (the
+    fallback demotion included), so they need no lock — same contract as
+    shm_ring.RingQueue._ring.
+    """
+
+    _GUARDED_BY = {"stats": "_stats_lock"}
+
+    def __init__(self, board: WeightBoard, client):
+        self._board: WeightBoard | None = board
+        self._client = client
+        self._retries_seen = 0
+        self.stats = {"board_pulls": 0, "board_checks": 0,
+                      "tcp_fallbacks": 0, "seqlock_retries": 0}
+        self._stats_lock = threading.Lock()
+
+    def _demote(self) -> None:
+        import sys
+
+        board, self._board = self._board, None
+        if board is not None:
+            board.close()
+        self._bump("tcp_fallbacks")
+        print("[weight_board] WARNING: board closed under the actor; "
+              "falling back to TCP weight pulls", file=sys.stderr)
+
+    def get_if_newer(self, have_version: int) -> tuple[Any, int] | None:
+        from distributed_reinforcement_learning_tpu.data import codec
+
+        board = self._board
+        if board is None:
+            return self._client.get_weights_if_newer(have_version)
+        t0 = time.perf_counter()  # unconditional (see TCP client note)
+        try:
+            if board.writer_closed:
+                raise BoardClosed(f"board {board.name}: writer closed")
+            got = board.read_blob(have_version)
+            if got is not None:
+                # Decode inside the guarded region: a blob that fails to
+                # decode can only mean the seqlock contract broke (e.g. a
+                # weakly-ordered CPU with DRL_SHM_WEIGHTS forced) — treat
+                # it like any other board failure, never kill the actor.
+                got = (codec.decode(got[0]), got[1])
+        except (BoardClosed, ValueError):
+            self._demote()
+            return self._client.get_weights_if_newer(have_version)
+        self._bump("board_checks")
+        retries = board.read_retries - self._retries_seen
+        if retries:
+            self._retries_seen = board.read_retries
+            self._bump("seqlock_retries", retries)
+        if got is None:  # already newest: the no-syscall common case
+            if _OBS.enabled:
+                _OBS.gauge("actor/weight_pull_ms",
+                           (time.perf_counter() - t0) * 1e3)
+            return None
+        # The copy out of the slot is OWNED, so the decode viewed it
+        # (no second copy) — same ownership the TCP decode(copy=True)
+        # hands back, byte-identical content (test-pinned).
+        params, version = got
+        self._bump("board_pulls")
+        if _OBS.enabled:
+            _OBS.gauge("actor/weight_pull_ms", (time.perf_counter() - t0) * 1e3)
+            _OBS.gauge("actor/weight_version", version)
+        return params, version
+
+    def close(self) -> None:
+        board, self._board = self._board, None
+        if board is not None:
+            board.close()
+
+
+def attach_board_weights(name: str, client,
+                         deadline_s: float | None = None) -> BoardWeights | None:
+    """Actor-side wiring: attach the named board with a bounded retry
+    and wrap it in a BoardWeights. None = stay on plain TCP pulls.
+
+    Short window on purpose (same reasoning as shm_ring's attach): this
+    runs after the TransportClient connected, and the learner creates
+    its board before serving — a missing segment a few seconds later
+    almost certainly means the learner declined."""
+    import sys
+
+    if deadline_s is None:
+        deadline_s = float(os.environ.get("DRL_SHM_WEIGHTS_ATTACH_S", "5"))
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return BoardWeights(WeightBoard.attach(name), client)
+        except (FileNotFoundError, ValueError) as e:
+            if time.monotonic() >= deadline:
+                print(f"[weight_board] WARNING: cannot attach board "
+                      f"{name!r} ({e}); falling back to TCP weight pulls",
+                      file=sys.stderr)
+                return None
+            time.sleep(0.2)

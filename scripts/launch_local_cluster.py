@@ -141,30 +141,42 @@ def main() -> None:
     # shm_ring.ring_enabled, the canonical definition) because importing
     # the package here pulls jax into the launcher parent — a measured
     # ~2s tax on every launch just to read an env var and a JSON file.
-    ring_names: dict[int, str] = {}
-    gate = os.environ.get("DRL_SHM_RING", "").strip().lower()
-    if gate in ("1", "true", "yes", "on"):
-        use_rings = True
-    elif gate in ("0", "false", "no", "off"):
-        use_rings = False
-    else:
+    def shm_gate(env_key: str, verdict_file: str) -> bool:
+        gate = os.environ.get(env_key, "").strip().lower()
+        if gate in ("1", "true", "yes", "on"):
+            return True
+        if gate in ("0", "false", "no", "off"):
+            return False
         import json
         import platform
 
-        use_rings = False
-        if platform.machine().lower() in ("x86_64", "amd64"):
-            try:
-                with open(os.path.join(REPO, "benchmarks",
-                                       "transport_verdict.json")) as f:
-                    use_rings = bool(json.load(f).get("auto_enable", False))
-            except (OSError, ValueError):
-                pass
-    if use_rings:
-        tag = os.urandom(4).hex()
-        ring_names = {task: f"drlring-{os.getpid()}-{tag}-{task}"
+        if platform.machine().lower() not in ("x86_64", "amd64"):
+            return False
+        try:
+            with open(os.path.join(REPO, "benchmarks", verdict_file)) as f:
+                return bool(json.load(f).get("auto_enable", False))
+        except (OSError, ValueError):
+            return False
+
+    ring_names: dict[int, str] = {}
+    board_names: dict[int, str] = {}
+    tag = f"{os.getpid()}-{os.urandom(4).hex()}"
+    if shm_gate("DRL_SHM_RING", "transport_verdict.json"):
+        ring_names = {task: f"drlring-{tag}-{task}"
                       for task in range(args.actors)}
         print(f"[cluster] shm rings enabled for {args.actors} co-hosted "
               f"actor(s)", file=sys.stderr)
+    # The weight plane's mirror: ONE board per learner, shared by every
+    # actor partitioned to it (runtime/weight_board.py) — publish is one
+    # memcpy + flip regardless of actor count, pulls are shared-memory
+    # reads. Same gate shape as the rings: env forces, unset defers to
+    # the committed weights_compare adjudication on x86-64 only (the
+    # gate is INLINED for the same import-cost reason as above).
+    if shm_gate("DRL_SHM_WEIGHTS", "weights_verdict.json"):
+        board_names = {pid: f"drlwboard-{tag}-{pid}"
+                       for pid in range(args.learners)}
+        print(f"[cluster] shm weight board(s) enabled for {args.actors} "
+              f"co-hosted actor(s)", file=sys.stderr)
     learners = []
     if args.learners > 1:
         env["DRL_COORDINATOR"] = f"localhost:{_free_port()}"
@@ -177,6 +189,8 @@ def main() -> None:
                 if t % args.learners == pid]
         if mine:
             lenv["DRL_SHM_RING_CREATE"] = ",".join(mine)
+        if pid in board_names:
+            lenv["DRL_SHM_WEIGHTS_CREATE"] = board_names[pid]
         learners.append(spawn(
             f"learner{pid}" if args.learners > 1 else "learner",
             learner_cmd, lenv))
@@ -188,6 +202,8 @@ def main() -> None:
         aenv = {**env, "DRL_LEARNER_INDEX": str(task % args.learners)}
         if task in ring_names:
             aenv["DRL_SHM_RING_NAME"] = ring_names[task]
+        if task % args.learners in board_names:
+            aenv["DRL_SHM_WEIGHTS_NAME"] = board_names[task % args.learners]
         spawn(f"actor{task}", actor_cmd, aenv)
 
     def shutdown(*_):
@@ -230,17 +246,18 @@ def main() -> None:
         # Drain the relay threads: without the join, the children's final
         # lines (e.g. the learner's "done: N updates") race sys.exit.
         t.join(timeout=5.0)
-    # Ring reaper: the learner unlinks its segments on a clean stop, but
-    # a SIGKILLed/crashed learner leaves them in /dev/shm — sweep every
-    # name this launch created, best-effort, after the children are dead.
-    for name in ring_names.values():
+    # Shm reaper: the learner unlinks its segments (rings AND weight
+    # boards) on a clean stop, but a SIGKILLed/crashed learner leaves
+    # them in /dev/shm — sweep every name this launch created,
+    # best-effort, after the children are dead.
+    for name in [*ring_names.values(), *board_names.values()]:
         try:
             from multiprocessing import shared_memory
 
             seg = shared_memory.SharedMemory(name=name)
             seg.close()
             seg.unlink()
-            print(f"[cluster] reaped leaked shm ring {name}", file=sys.stderr)
+            print(f"[cluster] reaped leaked shm segment {name}", file=sys.stderr)
         except FileNotFoundError:
             pass  # the learner cleaned up, as it should
         except OSError:

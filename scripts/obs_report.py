@@ -291,7 +291,7 @@ def build_report(tdir: str, merge: bool = True) -> str:
     any_counter = False
     for shard in shards:
         for name, stats in sorted(shard.counter_rates().items()):
-            if name.startswith(("staleness_bucket/", "codec/")):
+            if name.startswith(("staleness_bucket/", "codec/", "board/")):
                 continue  # rendered as their own sections below
             any_counter = True
             out(f"  {shard_label(shard):<14} {name:<28} "
@@ -395,6 +395,25 @@ def build_report(tdir: str, merge: bool = True) -> str:
         out(f"  {shard_label(shard)}: publish latency mean "
             f"{stats['mean']:.2f}ms  max {stats['max']:.2f}ms  "
             f"({stats['n']} publishes)")
+    # The publish p99 SPLIT from the trace spans (runtime/publishing.py
+    # sub-stages): handoff = the device-side copy dispatch on the learn
+    # thread, stall = the bounded-staleness flush — the attribution the
+    # fat `publish` mean can't give.
+    pub_rows = {(r["proc"], r["stage"]): r for r in rows
+                if r["stage"] in ("publish", "publish_handoff",
+                                  "publish_stall")}
+    for proc in sorted({p for p, _ in pub_rows}):
+        parts = []
+        for stage in ("publish", "publish_handoff", "publish_stall"):
+            r = pub_rows.get((proc, stage))
+            if r is not None:
+                parts.append(f"{stage} p99 {r['p99_ms']:.2f}ms "
+                             f"(n={r['count']})")
+        if parts:
+            any_pub = True
+            out(f"  {proc}: " + "  ".join(parts))
+    # Per-rank pull latency (both transports gauge the same name, so a
+    # board run and a TCP run read identically here).
     for shard in shards:
         stats = shard.gauge_stats("actor/weight_pull_ms")
         if stats is not None:
@@ -402,6 +421,29 @@ def build_report(tdir: str, merge: bool = True) -> str:
             out(f"  {shard_label(shard)}: weight pull mean "
                 f"{stats['mean']:.2f}ms  max {stats['max']:.2f}ms  "
                 f"({stats['n']} pulls)")
+    # Shm weight board (runtime/weight_board.py): pull/check/fallback
+    # counters per actor rank; lines only appear when a run used the
+    # board.
+    for shard in shards:
+        rates = shard.counter_rates()
+
+        def total(key, rates=rates):
+            return rates.get(key, {}).get("total", 0)
+
+        if not total("board/board_checks"):
+            continue  # learner shards carry only the publish counters
+        any_pub = True
+        out(f"  {shard_label(shard)}: board pulls {total('board/board_pulls'):.0f} "
+            f"of {total('board/board_checks'):.0f} checks, "
+            f"{total('board/seqlock_retries'):.0f} seqlock retries, "
+            f"{total('board/tcp_fallbacks'):.0f} tcp fallbacks")
+    for shard in shards:
+        rates = shard.counter_rates()
+        pubs = rates.get("board/publishes", {}).get("total", 0)
+        if pubs:
+            nbytes = rates.get("board/published_bytes", {}).get("total", 0)
+            out(f"  {shard_label(shard)}: board published {pubs:.0f} "
+                f"versions ({nbytes / 1e6:.1f} MB total)")
     if not any_pub:
         out("  (no publish/pull gauges)")
 

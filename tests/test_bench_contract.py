@@ -41,7 +41,7 @@ _TRIMMED = {
     "BENCH_R2D2": "0", "BENCH_APEX": "0", "BENCH_XIMPALA": "0",
     "BENCH_APEX_INGEST": "0", "BENCH_INGEST": "0",
     "BENCH_ANAKIN": "0", "BENCH_ANAKIN_R2D2": "0",
-    "BENCH_TRANSPORT": "0", "BENCH_CODEC": "0",
+    "BENCH_TRANSPORT": "0", "BENCH_CODEC": "0", "BENCH_WEIGHTS": "0",
 }
 
 
@@ -186,6 +186,59 @@ class TestCodecCompare:
                 if v is not None:
                     os.environ[k] = v
             codec.refresh_flags()
+
+
+class TestWeightsCompare:
+    """bench_weights_compare: the two-process TCP-vs-shm-board weight
+    pull A/B whose verdict gates runtime/weight_board's auto-enable.
+    Driven directly at a tiny config (CPU, host-only) — the committed
+    adjudication numbers live in benchmarks/weights_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        # Small test board — but it must still fit the section's ~4.2 MB
+        # params blob per slot (undersized slots are the latch-off test
+        # in test_weight_board.py, not this contract).
+        monkeypatch.setenv("DRL_SHM_WEIGHTS_MB", "8")
+        bench = _load_bench()
+        from distributed_reinforcement_learning_tpu.agents.impala import ImpalaConfig
+
+        cfg = ImpalaConfig(obs_shape=(8,), num_actions=2, trajectory=8,
+                           lstm_size=16)
+        r = bench.bench_weights_compare(cfg, n_actors=1, rounds=16,
+                                        publish_period_s=0.005)
+        for side in ("tcp", "board"):
+            assert r[side]["frames_per_s"] > 0, r
+            assert (r[side]["weight_pull_ms_p99"]
+                    >= r[side]["weight_pull_ms_p50"])
+            # The publish-stage split the section exists to record.
+            for stage in ("publish", "publish_handoff", "publish_stall"):
+                assert {"p50_ms", "p99_ms", "n"} <= set(r[side][stage])
+            assert r[side]["publish"]["n"] > 0
+        # The warm pull alone guarantees at least one full board pull
+        # even if the timed rounds all raced ahead of the publisher.
+        assert r["board"]["board_stats"]["board_pulls"] >= 1
+        assert r["board"]["board_stats"]["tcp_fallbacks"] == 0
+        assert r["board_vs_tcp"] > 0 and r["pull_p50_speedup"] > 0
+        assert r["auto_enable"] == (r["board_vs_tcp"] >= 1.2)
+        assert r["verdict"].startswith("board ") and (
+            "auto-on" in r["verdict"] or "opt-in" in r["verdict"])
+
+    def test_compact_line_carries_weights_verdict_key(self):
+        bench = _load_bench()
+        assert "weights_verdict" in bench._COMPACT_KEYS
+
+    def test_committed_verdict_file_consistent(self, monkeypatch):
+        """The committed adjudication parses, and board_enabled() follows
+        it when DRL_SHM_WEIGHTS is unset."""
+        verdict = json.loads(
+            (REPO / "benchmarks" / "weights_verdict.json").read_text())
+        assert isinstance(verdict["auto_enable"], bool)
+        assert verdict["ratio_runs"] and verdict["bar"] == 1.2
+        from distributed_reinforcement_learning_tpu.runtime.weight_board import (
+            board_auto_enabled)
+
+        assert board_auto_enabled() is verdict["auto_enable"]
 
 
 class TestDeviceChunkGate:

@@ -531,6 +531,7 @@ class TestCliAndTreeGate:
             "runtime/transport.py": 2,   # TransportServer + TransportClient
             "runtime/shm_ring.py": 3,    # ShmRing (doc form) + drainer + queue
             "runtime/weights.py": 1,
+            "runtime/weight_board.py": 2,  # WeightBoard (doc form) + BoardWeights
             "runtime/publishing.py": 1,  # empty-map documentation form
             "runtime/inference.py": 1,
             "data/fifo.py": 1,

@@ -165,6 +165,102 @@ class TestProtocol:
             TransportClient("127.0.0.1", _free_port(), connect_retries=2, retry_interval=0.05)
 
 
+class _PlainStore:
+    """A weight store WITHOUT get_blob: exercises the server's fallback
+    encode path (outside `_enc_lock`, double-checked, only-forward)."""
+
+    def __init__(self):
+        self._params = None
+        self._version = -1
+        self._lock = threading.Lock()
+
+    def publish(self, params, version):
+        with self._lock:
+            self._params, self._version = params, version
+
+    @property
+    def version(self):
+        with self._lock:
+            return self._version
+
+    def get(self):
+        with self._lock:
+            return self._params, self._version
+
+
+class TestServerEncodeFallback:
+    """Stores without pre-encoded blobs: the serve path must still work,
+    with the per-version encode OUTSIDE the lock and the only-forward
+    cache preserved."""
+
+    def test_pull_and_cache_only_forward(self):
+        queue, weights = TrajectoryQueue(8), _PlainStore()
+        port = _free_port()
+        server = TransportServer(queue, weights, host="127.0.0.1",
+                                 port=port).start()
+        client = TransportClient("127.0.0.1", port)
+        try:
+            assert client.get_weights_if_newer(-1) is None
+            weights.publish({"w": np.full(4, 1.0, np.float32)}, 3)
+            params, v = client.get_weights_if_newer(-1)
+            assert v == 3 and float(params["w"][0]) == 1.0
+            assert client.get_weights_if_newer(3) is None
+            # Only-forward: a backward version must NOT regress the
+            # cache on this path (the blob-store fast path serves the
+            # store's truth instead; this fallback pins the old rule).
+            weights.publish({"w": np.full(4, 2.0, np.float32)}, 1)
+            assert client.get_weights_if_newer(3) is None
+            weights.publish({"w": np.full(4, 5.0, np.float32)}, 7)
+            params2, v2 = client.get_weights_if_newer(3)
+            assert v2 == 7 and float(params2["w"][0]) == 5.0
+        finally:
+            client.close()
+            server.stop()
+
+    def test_concurrent_pulls_during_new_version(self):
+        """Many clients pulling while versions advance: every reply must
+        be a consistent (version, params) pair — the stale-serve window
+        may hand out the previous version, never a torn one."""
+        queue, weights = TrajectoryQueue(8), _PlainStore()
+        port = _free_port()
+        server = TransportServer(queue, weights, host="127.0.0.1",
+                                 port=port).start()
+        blobs = {v: np.full(256, float(v), np.float32) for v in range(20)}
+        weights.publish({"w": blobs[0]}, 0)
+        errors: list = []
+        stop = threading.Event()
+
+        def pull_loop():
+            client = TransportClient("127.0.0.1", port)
+            have = -1
+            try:
+                while not stop.is_set():
+                    got = client.get_weights_if_newer(have)
+                    if got is None:
+                        continue
+                    params, v = got
+                    if not np.array_equal(params["w"], blobs[v]):
+                        errors.append(f"torn weights at version {v}")
+                        return
+                    have = v
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=pull_loop) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for v in range(1, 20):
+                weights.publish({"w": blobs[v]}, v)
+                time.sleep(0.01)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+            server.stop()
+        assert not errors, errors[:3]
+
+
 class TestDistributedImpala:
     def test_actor_feeds_learner_over_tcp(self):
         """Reference topology on localhost (`README.md:37-46`): learner serves,

@@ -1,0 +1,244 @@
+"""WeightStore contract: encode-once publication, read-only snapshots,
+the async worker's Condition pacing, seq arbitration, and the
+bounded-staleness publish_stall trigger (runtime/weights.py,
+runtime/publishing.py)."""
+
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_reinforcement_learning_tpu.data import codec
+from distributed_reinforcement_learning_tpu.runtime.publishing import (
+    PublishCadenceMixin,
+)
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+
+def _params(seed: int):
+    rng = np.random.RandomState(seed)
+    return {"w": rng.standard_normal((16, 8)).astype(np.float32),
+            "b": {"c": rng.randint(0, 9, 4).astype(np.int32)}}
+
+
+class TestReadOnlySnapshots:
+    def test_published_leaves_are_not_writeable(self):
+        """The published snapshot is shared BY REFERENCE with every
+        in-process consumer (actors, inference, the transport's blob) —
+        a consumer mutating it must fail loudly, not silently corrupt
+        all readers."""
+        ws = WeightStore()
+        ws.publish(_params(1), 1)
+        params, _ = ws.get()
+        with pytest.raises(ValueError):
+            params["w"][0, 0] = 99.0
+        with pytest.raises(ValueError):
+            params["b"]["c"][:] = 0
+        got = ws.get_if_newer(-1)
+        with pytest.raises(ValueError):
+            got[0]["w"][0] = 0
+
+    def test_values_bit_identical_after_publish(self):
+        ws = WeightStore()
+        original = _params(2)
+        ws.publish(original, 3)
+        params, version = ws.get()
+        assert version == 3
+        np.testing.assert_array_equal(params["w"], original["w"])
+        np.testing.assert_array_equal(params["b"]["c"], original["b"]["c"])
+        assert params["w"].dtype == np.float32
+
+    def test_get_blob_is_the_canonical_encode(self):
+        """The stored blob is the exact bytes codec.encode produces for
+        the snapshot — what the transport serves and the board copies;
+        one encode per version, ever."""
+        ws = WeightStore()
+        assert ws.get_blob() == (None, -1)
+        original = _params(3)
+        ws.publish(original, 4)
+        blob, version = ws.get_blob()
+        assert version == 4
+        assert bytes(np.asarray(blob)) == bytes(
+            np.asarray(codec.encode(original, cache=True)))
+
+
+class TestUnencodableFallback:
+    def test_decode_failure_falls_back_and_does_not_freeze_caller(self):
+        """A pytree the codec cannot ROUND-TRIP (object-dtype leaves
+        encode but fail to decode) must take the per-leaf fallback —
+        landing the publish with blob=None — and the fallback must
+        snapshot COPIES: freezing the caller's own arrays in place
+        would make the learner's live params read-only."""
+        ws = WeightStore()
+        mine = {"w": np.ones(4, np.float32),
+                "bad": np.array([object()], dtype=object)}
+        ws.publish(mine, 1)
+        params, version = ws.get()
+        assert version == 1
+        assert ws.get_blob() == (None, 1)  # nothing for the wire/board
+        np.testing.assert_array_equal(params["w"], np.ones(4, np.float32))
+        with pytest.raises(ValueError):
+            params["w"][0] = 9.0  # the published snapshot is frozen...
+        mine["w"][0] = 5.0  # ...but the caller's own array is NOT
+        np.testing.assert_array_equal(params["w"],
+                                      np.ones(4, np.float32))  # and is a copy
+
+
+class TestAsyncContract:
+    def test_latest_wins_under_publish_burst(self):
+        """A burst of async publishes may drop intermediate versions but
+        the LAST submit must be what lands."""
+        ws = WeightStore()
+        for i in range(30):
+            ws.publish_async({"w": np.full(8, i, np.float32)}, i)
+        assert ws.flush_async(timeout=30.0)
+        params, version = ws.get()
+        assert version == 29
+        np.testing.assert_array_equal(params["w"], np.full(8, 29, np.float32))
+        ws.close()
+
+    def test_rollback_republish_seq_arbitration(self):
+        """Version going BACKWARD must still land: publish order (seq),
+        not version number, arbitrates — a checkpoint-rollback republish
+        at a restored step is the legitimate backward case."""
+        ws = WeightStore()
+        ws.publish_async(_params(1), 50)
+        assert ws.flush_async(timeout=30.0)
+        ws.publish_async(_params(2), 12)
+        assert ws.flush_async(timeout=30.0)
+        params, version = ws.get()
+        assert version == 12
+        np.testing.assert_array_equal(params["w"], _params(2)["w"])
+        # And a sync publish racing nothing still respects submit order.
+        ws.publish(_params(3), 5)
+        assert ws.version == 5
+        ws.close()
+
+    def test_post_close_sync_fallback(self):
+        """publish_async after close() must not lose the item: it falls
+        back to a synchronous publish (visible before returning)."""
+        ws = WeightStore()
+        ws.publish_async(_params(1), 1)
+        ws.close()
+        ws.publish_async(_params(2), 2)
+        params, version = ws.get()  # no flush needed: it was synchronous
+        assert version == 2
+        np.testing.assert_array_equal(params["w"], _params(2)["w"])
+
+    def test_flush_wakes_on_completion_not_poll(self):
+        """The Condition-paced worker must complete a flush well inside
+        the old poll quantum once the pending item lands (loose bound:
+        this is a liveness check, not a latency benchmark)."""
+        ws = WeightStore()
+        ws.publish_async(_params(1), 1)
+        t0 = time.perf_counter()
+        assert ws.flush_async(timeout=30.0)
+        assert time.perf_counter() - t0 < 5.0
+        assert ws.version == 1
+        ws.close()
+
+    def test_flush_timeout_returns_false(self):
+        """A worker wedged mid-publish must surface as flush False, not
+        a hang."""
+        ws = WeightStore()
+        release = threading.Event()
+        orig = codec.encode
+
+        def slow_encode(tree, *a, **kw):
+            release.wait(10.0)
+            return orig(tree, *a, **kw)
+
+        import distributed_reinforcement_learning_tpu.runtime.weights as wmod
+
+        old = wmod.codec.encode
+        wmod.codec.encode = slow_encode
+        try:
+            ws.publish_async(_params(1), 1)
+            assert ws.flush_async(timeout=0.3) is False
+        finally:
+            release.set()
+            wmod.codec.encode = old
+            ws.flush_async(timeout=10.0)
+            ws.close()
+
+
+class _RecordingTimer:
+    """StageTimer.stage duck-type collecting per-invocation samples."""
+
+    def __init__(self):
+        self.calls: list[str] = []
+
+    @contextlib.contextmanager
+    def stage(self, name):
+        self.calls.append(name)
+        yield
+
+
+class _LaggingStore:
+    """WeightStore stand-in whose visible version lags far behind the
+    submitted one until flushed — the async-worker-behind scenario the
+    bounded-staleness stall exists for."""
+
+    def __init__(self):
+        self.version = 0
+        self.flushes = 0
+        self.publishes: list[int] = []
+
+    def publish_async(self, params, version):
+        self.publishes.append(version)  # version does NOT advance: lag
+
+    def flush_async(self, timeout=30.0):
+        self.flushes += 1
+        self.version = self.publishes[-1]
+        return True
+
+
+class TestPublishStall:
+    def _host(self, weights, interval=2):
+        class Host(PublishCadenceMixin):
+            pass
+
+        host = Host()
+        host.weights = weights
+        host.publish_interval = interval
+        host.train_steps = 0
+        host.timer = _RecordingTimer()
+
+        class _State:
+            params = {"w": np.zeros(4, np.float32)}
+
+        host.state = _State()
+        return host
+
+    def test_stall_triggers_when_worker_lags_past_bound(self, monkeypatch):
+        """maybe_publish must block on flush_async (the publish_stall
+        stage) once the landed version lags the submitted train step by
+        more than 3 publish intervals — and not before."""
+        monkeypatch.setenv("DRL_ASYNC_PUBLISH", "1")
+        store = _LaggingStore()
+        host = self._host(store, interval=2)
+        host.train_steps = 2
+        assert host.maybe_publish()
+        # version 0 vs step 2: lag 2 <= 3*2, no stall yet.
+        assert store.flushes == 0
+        assert "publish_stall" not in host.timer.calls
+        host.train_steps = 8
+        assert host.maybe_publish()
+        # version still 0 vs step 8: lag 8 > 6 -> bounded-staleness flush.
+        assert store.flushes == 1
+        assert store.version == 8
+        assert host.timer.calls.count("publish_stall") == 1
+        assert host.timer.calls.count("publish_handoff") == 2
+
+    def test_no_stall_when_worker_keeps_up(self, monkeypatch):
+        monkeypatch.setenv("DRL_ASYNC_PUBLISH", "1")
+        ws = WeightStore()
+        host = self._host(ws, interval=1)
+        for step in range(1, 6):
+            host.train_steps = step
+            host.maybe_publish()
+        ws.flush_async(timeout=30.0)
+        assert ws.version == 5
+        ws.close()
