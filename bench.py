@@ -71,7 +71,7 @@ def _probe_backend(timeout: float) -> tuple[str | None, str | None]:
 # regression must survive into the compact line the driver reads).
 _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
                  "watchdog", "chunk_regressions", "transport_verdict",
-                 "codec_verdict", "weights_verdict")
+                 "codec_verdict", "weights_verdict", "replay_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -1724,6 +1724,236 @@ def bench_weights_compare(cfg, n_actors: int = 2, rounds: int = 96,
     return out
 
 
+# Child-process actor for bench_replay_compare: PUTs deterministic Ape-X
+# unrolls over the real TCP client path (put_trajectories, accepted
+# counts honored — a variant that outruns ingest pays the backpressure
+# instead of counting dropped unrolls as throughput). No jax import: the
+# unroll is a structural ApexBatch namedtuple, exactly what the server
+# side decodes either way.
+_REPLAY_CHILD = r"""
+import sys
+from collections import namedtuple
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.data import codec  # noqa: F401
+from distributed_reinforcement_learning_tpu.runtime.transport import TransportClient
+
+host, port, n_unrolls, upp, steps, obs_dim = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]))
+ApexBatch = namedtuple("ApexBatch", ["state", "next_state", "previous_action",
+                                     "action", "reward", "done"])
+rng = np.random.RandomState(0)
+trees = []
+for _ in range(upp):
+    trees.append(ApexBatch(
+        state=rng.rand(steps, obs_dim).astype(np.float32),
+        next_state=rng.rand(steps, obs_dim).astype(np.float32),
+        previous_action=rng.randint(0, 2, steps).astype(np.int32),
+        action=rng.randint(0, 2, steps).astype(np.int32),
+        reward=rng.randn(steps).astype(np.float32),
+        done=(rng.rand(steps) < 0.1)))
+client = TransportClient(host, port, busy_timeout=120.0)
+sent = 0
+while sent < n_unrolls:
+    chunk = trees[: min(upp, n_unrolls - sent)]
+    got = client.put_trajectories(chunk)
+    assert got == len(chunk), f"dropped {len(chunk) - got} unrolls"
+    sent += got
+client.close()
+print("REPLAY_CHILD_DONE")
+"""
+
+
+def bench_replay_compare(n_unrolls: int = 192, unrolls_per_put: int = 8,
+                         steps: int = 32, obs_dim: int = 64,
+                         num_shards: int = 2, reps: int = 1) -> dict:
+    """Two-process A/B of the Ape-X INGEST plane: monolithic replay (the
+    learner thread decodes, TD-scores, and sum-tree-inserts every unroll
+    it drains — `apex_runner.ingest_many`) vs the sharded service
+    (data/replay_service.py: the SERVE thread decodes + scores + inserts
+    at ingest; the learner only gathers samples). A real child process
+    PUTs identical blobs over loopback TCP into each variant while the
+    learner loop trains continuously — so the number measured is
+    PUT-to-replay throughput UNDER training load, which is exactly the
+    contention the service exists to remove.
+
+    The verdict follows the repo's adjudication bar (Pallas-LSTM rule):
+    shards ship enabled-by-default ONLY at >= 1.2x monolithic
+    ingest+train frames/s; the committed `benchmarks/replay_verdict.json`
+    carries the decision `runtime/replay_shard.shard_count()` consults.
+    """
+    from collections import namedtuple
+
+    import jax
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.agents.apex import (
+        ApexAgent, ApexConfig)
+    from distributed_reinforcement_learning_tpu.data import codec
+    from distributed_reinforcement_learning_tpu.data.replay_service import (
+        ShardedReplayService)
+    from distributed_reinforcement_learning_tpu.runtime import apex_runner
+    from distributed_reinforcement_learning_tpu.runtime.replay_shard import (
+        ReplayIngestFifo)
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        TransportServer, _make_queue)
+    from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+    acfg = ApexConfig(obs_shape=(obs_dim,), num_actions=2)
+    agent = ApexAgent(acfg)  # ONE jit cache shared by both variants
+    rng = np.random.RandomState(0)
+    # The child's structural namedtuple (no jax import over there); the
+    # warm path round-trips the codec so the learner compiles against
+    # the same reconstructed class the wire path yields.
+    cls = namedtuple("ApexBatch", ["state", "next_state", "previous_action",
+                                   "action", "reward", "done"])
+
+    def warm_unrolls(count):
+        out = []
+        for _ in range(count):
+            out.append(bytes(codec.encode(cls(
+                state=rng.rand(steps, obs_dim).astype(np.float32),
+                next_state=rng.rand(steps, obs_dim).astype(np.float32),
+                previous_action=rng.randint(0, 2, steps).astype(np.int32),
+                action=rng.randint(0, 2, steps).astype(np.int32),
+                reward=rng.randn(steps).astype(np.float32),
+                done=rng.rand(steps) < 0.1))))
+        return out
+
+    def pctl(sorted_ms, q):
+        return round(sorted_ms[min(int(q * (len(sorted_ms) - 1) + 0.5),
+                                   len(sorted_ms) - 1)], 3)
+
+    def run_variant(sharded: bool) -> dict:
+        queue = _make_queue(64)
+        svc = None
+        ingest_q = queue
+        if sharded:
+            svc = ShardedReplayService(num_shards, 16384, mode="transition",
+                                       scorer="max", seed=0)
+            ingest_q = ReplayIngestFifo(svc, queue)
+        weights = WeightStore()
+        learner = apex_runner.ApexLearner(
+            agent, queue, weights, batch_size=32, replay_capacity=16384,
+            rng=jax.random.PRNGKey(0), replay_service=svc)
+        # Warm + compile OUTSIDE the timed window: prefill past the
+        # warm-up gate, run one train (td_error + learn compile).
+        from distributed_reinforcement_learning_tpu.data.fifo import blob_ingest
+
+        prepare, put = blob_ingest(ingest_q)
+        for blob in warm_unrolls(12):
+            put(prepare(blob))
+        while learner.ingest_many(timeout=0.0):
+            pass
+        assert learner.train() is not None
+        server = TransportServer(ingest_q, weights, host="127.0.0.1",
+                                 port=_free_port()).start()
+
+        def ingested() -> int:
+            return (svc.ingested_blobs() if sharded
+                    else learner.ingested_unrolls)
+
+        base = ingested()
+        target = base + n_unrolls
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _REPLAY_CHILD, "127.0.0.1",
+             str(server.port), str(n_unrolls), str(unrolls_per_put),
+             str(steps), str(obs_dim)],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        train_ms: list[float] = []
+        train_steps0 = learner.train_steps
+        try:
+            # Clock starts at the FIRST observed arrival (child startup
+            # excluded; in the mono variant arrival is queue depth — the
+            # learner loop below is what drains it) and stops when every
+            # unroll landed in replay.
+            while ingested() == base and queue.size() == 0:
+                if proc.poll() is not None and proc.returncode != 0:
+                    raise RuntimeError(
+                        f"child died: {proc.stderr.read()[-500:]}")
+                time.sleep(0.001)
+            t0 = time.perf_counter()
+            counted_from = ingested()
+            while ingested() < target:
+                # A child that died nonzero mid-run (busy_timeout, a
+                # dropped-unroll assert) can never reach `target`: fail
+                # THIS section instead of spinning until the bench
+                # watchdog kills every later one.
+                if proc.poll() is not None and proc.returncode != 0:
+                    raise RuntimeError(
+                        f"child died mid-run: {proc.stderr.read()[-500:]}")
+                drained = False
+                while learner.ingest_many(timeout=0.002):
+                    drained = True
+                c0 = time.perf_counter()
+                m = learner.train()
+                train_ms.append((time.perf_counter() - c0) * 1e3)
+                if m is None and not drained:
+                    time.sleep(0.001)
+            elapsed = time.perf_counter() - t0
+            assert proc.wait(timeout=60) == 0, proc.stderr.read()[-500:]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            server.stop()
+            queue.close()
+        # Post-run sample latency on the variant's active replay.
+        replay = learner._active_replay()
+        sample_ms = []
+        sample_rng = np.random.RandomState(1)
+        for _ in range(50):
+            s0 = time.perf_counter()
+            replay.sample(32, sample_rng)
+            sample_ms.append((time.perf_counter() - s0) * 1e3)
+        sample_ms.sort()
+        train_ms.sort()
+        frames = (target - counted_from) * steps
+        out = {"frames_per_s": round(frames / elapsed, 1),
+               "unrolls_per_s": round(frames / steps / elapsed, 1),
+               "train_steps_in_window": learner.train_steps - train_steps0,
+               "train_ms_p50": pctl(train_ms, 0.50) if train_ms else 0.0,
+               "sample_ms_p50": pctl(sample_ms, 0.50),
+               "sample_ms_p99": pctl(sample_ms, 0.99)}
+        if svc is not None:
+            out["shards"] = num_shards
+            stats = svc.shard_stats()
+            out["shard_fill"] = [round(s["fill"], 4) for s in stats]
+            svc.close()
+        learner.close()
+        return out
+
+    one_blob = warm_unrolls(1)[0]
+    out: dict = {
+        "unroll_bytes": len(one_blob), "n_unrolls": n_unrolls,
+        "note": ("real two-process A/B: child PUTs identical unrolls over "
+                 "loopback TCP (put_trajectories, accepted counts "
+                 "honored) while the learner trains; mono pays "
+                 "decode+TD+insert on the learn thread, sharded pays it "
+                 "on the serve thread")}
+    best_m = best_s = None
+    for _ in range(reps):
+        m = run_variant(sharded=False)
+        s = run_variant(sharded=True)
+        if best_m is None or m["frames_per_s"] > best_m["frames_per_s"]:
+            best_m = m
+        if best_s is None or s["frames_per_s"] > best_s["frames_per_s"]:
+            best_s = s
+    out["mono"] = best_m
+    out["sharded"] = best_s
+    ratio = best_s["frames_per_s"] / max(best_m["frames_per_s"], 1e-9)
+    out["sharded_vs_mono"] = round(ratio, 2)
+    out["auto_enable"] = ratio >= 1.2  # the repo's adjudication bar
+    out["verdict"] = (f"replay shards {ratio:.2f}x mono ingest+train: "
+                      + ("auto-on" if out["auto_enable"] else "opt-in"))
+    print(f"[bench] replay_compare: mono {best_m['frames_per_s']:,.0f} "
+          f"f/s vs sharded {best_s['frames_per_s']:,.0f} f/s "
+          f"-> {out['verdict']}", file=sys.stderr)
+    return out
+
+
 def bench_r2d2_learn(B: int, iters: int) -> dict:
     """R2D2 learn-step throughput (env-frames/s) at the reference replay
     shape — the training hot path that runs the fused Pallas LSTM
@@ -2574,6 +2804,18 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["weights_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] weights_compare failed: {e}", file=sys.stderr)
+
+    # Two-process Ape-X ingest-plane A/B (the auto-enable adjudication
+    # for the sharded replay service, data/replay_service.py).
+    if os.environ.get("BENCH_REPLAY", "1") == "1" and _ok("replay_compare", 150):
+        try:
+            r = bench_replay_compare()
+            extra["replay_compare"] = r
+            if "verdict" in r:
+                extra["replay_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["replay_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] replay_compare failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_KERNELS", "1") == "1" and _ok("kernel_compare", 240):
         try:

@@ -657,7 +657,11 @@ def decode(blob: bytes | memoryview, copy: bool = False,
     copy=True allocates ONE owned payload buffer and copies the blob's
     payload region into it in a single memcpy — not one slice+copy per
     leaf, which double-touched multi-MB observation leaves. `cache`
-    overrides the layout-cache gate per call (see `encode`).
+    overrides the layout-cache gate per call (see `encode`): the weight
+    plane and the replay shards' decode-at-ingest
+    (data/replay_service.py) both force it on — each sees ONE stable
+    schema per run, so the layout cache is a pure win there regardless
+    of the committed trajectory-path verdict.
     """
     view = memoryview(blob)
     plan = _layout_plan(view, cache)

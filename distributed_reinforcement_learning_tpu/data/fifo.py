@@ -38,21 +38,32 @@ def blob_ingest(queue: Any) -> tuple[Any, Any]:
 
     The single definition of blob-ingest semantics, shared by the TCP
     transport server and the shm-ring drainer so the two transports
-    cannot drift: blob-native queues (`put_bytes`, the C++ backend) take
-    the raw bytes — routed through `codec.unpack_blob` so a dedup-packed
-    wire blob (DRL_OBS_DEDUP) is reconstructed to the plain layout
-    BEFORE the queue (the native batch-gather assumes it; a plain blob
-    passes through as the same object, no copy); pytree queues take a
-    decoded COPY — the blob's buffer may be reused or unmapped by the
-    caller the moment `prepare` returns, and decode reconstructs packed
-    leaves bit-identically as part of that copy. Either way, replay,
-    prioritization, and training see byte-for-byte the trajectories a
-    dedup-off run would see.
+    cannot drift. Three queue shapes, most specific first:
+
+    - replay-shard facades (`ingest_blob`,
+      runtime/replay_shard.ReplayIngestFifo) take the RAW wire blob
+      untouched — the owning shard decodes it ONCE on the transport
+      thread (a dedup-packed blob decodes straight to the plain pytree,
+      skipping the unpack->re-encode round trip blob-native queues pay);
+    - blob-native queues (`put_bytes`, the C++ backend) take the raw
+      bytes routed through `codec.unpack_blob` so a dedup-packed wire
+      blob (DRL_OBS_DEDUP) is reconstructed to the plain layout BEFORE
+      the queue (the native batch-gather assumes it; a plain blob passes
+      through as the same object, no copy);
+    - pytree queues take a decoded COPY — the blob's buffer may be
+      reused or unmapped by the caller the moment `prepare` returns, and
+      decode reconstructs packed leaves bit-identically as part of that
+      copy.
+
+    Either way, replay, prioritization, and training see byte-for-byte
+    the trajectories a dedup-off run would see.
     `put(item, timeout=...)` follows the queue's blocking-put contract
     (False on timeout, RuntimeError once closed).
     """
     from distributed_reinforcement_learning_tpu.data import codec
 
+    if hasattr(queue, "ingest_blob"):
+        return (lambda blob: blob), queue.ingest_blob
     if hasattr(queue, "put_bytes"):
         return codec.unpack_blob, queue.put_bytes
     return (lambda blob: codec.decode(blob, copy=True)), queue.put

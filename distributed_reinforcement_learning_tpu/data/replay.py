@@ -140,9 +140,9 @@ class PrioritizedReplay:
         _observe_replay(self, inserted=len(idxs))
         return idxs
 
-    def sample(self, n: int, rng: np.random.RandomState | None = None):
-        rng = rng or self._default_rng
-        self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
+    def _pick(self, n: int, rng) -> tuple[list, np.ndarray, np.ndarray]:
+        """Stratified pick -> (items, tree_idxs, raw priorities); the
+        sampling policy shared by sample() and the sharded gather."""
         segment = self.tree.total / n
         idxs = np.empty(n, np.int64)
         priorities = np.empty(n, np.float64)
@@ -164,11 +164,24 @@ class PrioritizedReplay:
             idxs[i] = idx
             priorities[i] = p
             items.append(data)
+        return items, idxs, priorities
+
+    def sample(self, n: int, rng: np.random.RandomState | None = None):
+        rng = rng or self._default_rng
+        self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
+        items, idxs, priorities = self._pick(n, rng)
         probs = priorities / self.tree.total
         weights = np.power(len(self.tree) * probs, -self.beta)
         weights /= weights.max()
         _observe_replay(self, sampled=n)
         return items, idxs, weights.astype(np.float32)
+
+    def sample_with_priorities(self, n: int, rng=None):
+        """(items, tree_idxs, RAW priorities) — no IS weights, no beta
+        annealing: the sharded service (data/replay_service.py) gathers
+        slices from several backends and computes global IS weights with
+        its own annealed beta."""
+        return self._pick(n, rng or self._default_rng)
 
     def update(self, idx: int, error: float) -> None:
         self.tree.set_priority(int(idx), self._priority(error))
@@ -301,17 +314,26 @@ class NativePrioritizedReplay:
         _observe_replay(self, sampled=n)
         return out
 
-    def _sample_locked(self, n: int, rng):
-        rng = rng or self._default_rng
-        self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
+    def _pick_locked(self, n: int, rng) -> tuple[list, np.ndarray, np.ndarray]:
         cap = self.tree.capacity
         idxs, priorities = _stratified_pick(
             self.tree, len(self.tree), n, rng,
             is_written=lambda slots: np.array(
                 [self._data[int(s)] is not None for s in slots]))
         items = [self._data[int(i) - (cap - 1)] for i in idxs]
+        return items, idxs, priorities
+
+    def _sample_locked(self, n: int, rng):
+        rng = rng or self._default_rng
+        self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
+        items, idxs, priorities = self._pick_locked(n, rng)
         return items, idxs, _is_weights(priorities, self.tree.total,
                                         len(self.tree), self.beta)
+
+    def sample_with_priorities(self, n: int, rng=None):
+        """See `PrioritizedReplay.sample_with_priorities`."""
+        with self._lock:
+            return self._pick_locked(n, rng or self._default_rng)
 
     def update(self, idx: int, error: float) -> None:
         self.update_batch(np.array([idx]), np.array([error]))
@@ -426,22 +448,32 @@ class ArrayPrioritizedReplay:
         return int(self.add_batch_stacked(
             np.array([error]), jax.tree.map(lambda x: np.asarray(x)[None], sample))[0])
 
-    def sample(self, n: int, rng: np.random.RandomState | None = None):
+    def _pick_locked(self, n: int, rng) -> tuple[Any, np.ndarray, np.ndarray]:
         import jax
 
+        count = len(self.tree)
+        idxs, priorities = _stratified_pick(
+            self.tree, count, n, rng,
+            is_written=lambda slots: slots < count)
+        slots = idxs - (self.tree.capacity - 1)
+        batch = jax.tree.map(lambda store: store[slots], self._store)
+        return batch, idxs, priorities
+
+    def sample(self, n: int, rng: np.random.RandomState | None = None):
         rng = rng or self._default_rng
         with self._lock:
             self.beta = min(1.0, self.beta + self.BETA_INCREMENT)
-            count = len(self.tree)
-            idxs, priorities = _stratified_pick(
-                self.tree, count, n, rng,
-                is_written=lambda slots: slots < count)
-            slots = idxs - (self.tree.capacity - 1)
-            batch = jax.tree.map(lambda store: store[slots], self._store)
+            batch, idxs, priorities = self._pick_locked(n, rng)
             out = batch, idxs, _is_weights(priorities, self.tree.total,
-                                           count, self.beta)
+                                           len(self.tree), self.beta)
         _observe_replay(self, sampled=n)
         return out
+
+    def sample_with_priorities(self, n: int, rng=None):
+        """See `PrioritizedReplay.sample_with_priorities` (stacked batch
+        instead of an item list, like sample())."""
+        with self._lock:
+            return self._pick_locked(n, rng or self._default_rng)
 
     def update(self, idx: int, error: float) -> None:
         self.update_batch(np.array([idx]), np.array([error]))

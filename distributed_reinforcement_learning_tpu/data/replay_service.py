@@ -1,0 +1,645 @@
+"""Sharded replay service with ingest-time prioritization.
+
+Ape-X's core claim (arXiv:1803.00933) is that distributed prioritized
+replay scales when priority computation moves OFF the learner — yet the
+monolithic topology funnels every trajectory through the learner
+thread's own ingest loop (`apex_runner.ingest_many`: decode + TD forward
++ sum-tree insert), and both committed honest-negative A/Bs
+(benchmarks/codec_verdict.json, transport_verdict.json) diagnosed
+exactly that learner-side path as the bound. "In-network experience
+sampling" (arXiv:2110.13506) points the same way: compute priorities
+and store experience on the TRANSPORT path, not the train path.
+
+This module is that service, in-process form: N `ReplayShard`s, each
+owned by one ingest thread (a TCP serve thread or a shm-ring drainer —
+`runtime/replay_shard.py` wires the thread->shard affinity through the
+`fifo.blob_ingest` seam). A shard decodes its blobs, computes INITIAL
+priorities at ingest (max-priority by default, or a pluggable TD-proxy
+scorer — same per-transition granularity and `(|err|+eps)^alpha`
+transform as the reference learner's scoring at `train_apex.py:106-122`,
+with the network TD replaced by a host-computable proxy), and inserts
+into its local prioritized backend. The learner's ingest stages shrink
+to a gather-from-shards sample call:
+
+- `sample(n)` allocates the batch across shards PROPORTIONALLY to total
+  shard priority mass (largest-remainder rounding, so the marginal
+  per-item probability matches the monolithic sampler's p_i/total), each
+  shard runs its own stratified pick, and IS weights are computed from
+  the GLOBAL total/count and normalized by the global max — the exact
+  `(N * p)^-beta / max` semantics of `data/replay.py`. Distribution
+  equivalence and bit-identical trajectory contents against the
+  monolithic backend are pinned by tests/test_replay_service.py.
+- Sample indexes pack (shard id, shard epoch, tree idx) into one int64
+  (`pack_index`), so `update_batch` can route each priority update back
+  to its owning shard ASYNCHRONOUSLY (a router thread drains a bounded
+  deque; under backlog the OLDEST pending batch is dropped — latest
+  wins, matching the advisory nature of re-prioritization). An update
+  whose epoch no longer matches its shard (the shard restarted) is
+  dropped loss-free: restarted shards re-ingest at max-priority, so no
+  item can be starved by a lost update.
+
+Failure containment mirrors the repo's demote-on-failure transports
+(shm ring -> TCP, weight board -> TCP): a shard whose ingest raises is
+marked dead and excluded from sampling; when every shard is dead the
+ingest facade (`runtime/replay_shard.ReplayIngestFifo`) demotes
+PERMANENTLY to the learner's monolithic queue+replay path.
+
+Gated by `DRL_REPLAY_SHARDS` (0 off, N>=1 forces N shards; unset defers
+to the committed `benchmarks/replay_verdict.json` adjudication — the
+repo's no-un-adjudicated-fast-path rule, bench.py `replay_compare`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.data.replay import make_replay
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+
+# -- packed sample indexes ----------------------------------------------------
+#
+# [tag:1][epoch:8][shard:8][tree_idx:46] in an int64. The tag bit keeps
+# packed indexes disjoint from any monolithic tree index (< 2*capacity),
+# so a learner that demoted mid-run can never mis-route an update from a
+# pre-demotion batch into the monolithic tree.
+
+_IDX_BITS = 46
+_SHARD_BITS = 8
+_EPOCH_BITS = 8
+_TAG = np.int64(1) << np.int64(_IDX_BITS + _SHARD_BITS + _EPOCH_BITS)
+_IDX_MASK = (np.int64(1) << np.int64(_IDX_BITS)) - np.int64(1)
+_SHARD_MASK = (np.int64(1) << np.int64(_SHARD_BITS)) - np.int64(1)
+_EPOCH_MASK = (np.int64(1) << np.int64(_EPOCH_BITS)) - np.int64(1)
+
+MAX_SHARDS = 1 << _SHARD_BITS
+
+
+def pack_index(shard: int, epoch: int, tree_idx):
+    """(shard id, shard epoch, backend tree idx) -> tagged int64 (vectorized)."""
+    idx = np.asarray(tree_idx, np.int64)
+    return (_TAG
+            | (np.int64(epoch & int(_EPOCH_MASK)) << np.int64(_IDX_BITS + _SHARD_BITS))
+            | (np.int64(shard & int(_SHARD_MASK)) << np.int64(_IDX_BITS))
+            | (idx & _IDX_MASK))
+
+
+def unpack_index(packed):
+    """Tagged int64 -> (shard ids, epochs, tree idxs) as int64 arrays."""
+    p = np.asarray(packed, np.int64)
+    return ((p >> np.int64(_IDX_BITS)) & _SHARD_MASK,
+            (p >> np.int64(_IDX_BITS + _SHARD_BITS)) & _EPOCH_MASK,
+            p & _IDX_MASK)
+
+
+def is_packed_index(packed) -> np.ndarray:
+    """Bool mask: which indexes carry the shard tag bit."""
+    return (np.asarray(packed, np.int64) & _TAG) != 0
+
+
+# -- ingest-time scorers ------------------------------------------------------
+
+
+def _reward_done_of(tree: Any) -> tuple[np.ndarray, np.ndarray]:
+    """(reward, done) leaves of a trajectory pytree (namedtuple or dict)."""
+    if hasattr(tree, "reward"):
+        return np.asarray(tree.reward), np.asarray(tree.done)
+    return np.asarray(tree["reward"]), np.asarray(tree["done"])
+
+
+def td_proxy_scorer(tree: Any, per_transition: bool) -> np.ndarray:
+    """Host-computable stand-in for the learner's ingest-time TD score.
+
+    Same granularity and downstream transform as the reference's
+    learner-side scoring (`train_apex.py:106-122`: one |err| per
+    transition through `(|err|+eps)^alpha`), with the network TD error
+    replaced by |clip(r)| + terminal bonus — the reward-driven part of
+    the one-step TD target, computable on the ingest thread without
+    touching the net. Sequence-mode shards (R2D2: one priority per
+    sequence) reduce the per-step proxy by its mean, mirroring the
+    reference's |mean TD| sequence priority (`train_r2d2.py:100-119`).
+    """
+    reward, done = _reward_done_of(tree)
+    per_step = np.abs(np.clip(reward, -1.0, 1.0)) + done.astype(np.float64)
+    if per_transition:
+        return per_step.astype(np.float64).reshape(-1)
+    return np.atleast_1d(np.float64(per_step.mean()))
+
+
+def make_scorer(name: str) -> Callable[[Any, bool], np.ndarray] | None:
+    """'max' -> None (max-priority fill, the Ape-X default for items the
+    learner has not yet seen: every new item is sampled at least once);
+    'td_proxy' -> `td_proxy_scorer`."""
+    if name in ("", "max"):
+        return None
+    if name == "td_proxy":
+        return td_proxy_scorer
+    raise ValueError(f"unknown replay scorer {name!r} (one of: max, td_proxy)")
+
+
+# -- one shard ----------------------------------------------------------------
+
+
+class ReplayShard:
+    """One ingest thread's local prioritized store.
+
+    `mode` is "transition" (Ape-X: a decoded unroll's leading axis is
+    the item axis — one priority per transition) or "sequence" (R2D2
+    family: the whole decoded tree is one item). All backend access and
+    the max-priority bookkeeping run under one lock: the owning ingest
+    thread inserts, the learner thread gathers samples, and the update
+    router re-prioritizes — three threads on one small mutex, which is
+    exactly the contention the per-shard split bounds (vs the monolithic
+    design's single global tree).
+    """
+
+    # Concurrency map (tools/drlint lock-discipline): the backend handle
+    # itself is swapped on restart() and read by sample/update paths;
+    # counters are bumped by ingest/router threads and read by telemetry
+    # providers; `epoch`/`dead` gate the router's stale-update drop.
+    _GUARDED_BY = {
+        "backend": "_lock",
+        "_max_error": "_lock",
+        "epoch": "_lock",
+        "dead": "_lock",
+        "ingested_blobs": "_lock",
+        "ingested_items": "_lock",
+        "updates_applied": "_lock",
+    }
+
+    def __init__(self, shard_id: int, capacity: int, mode: str = "transition",
+                 scorer: Callable[[Any, bool], np.ndarray] | None = None,
+                 backend: str = "auto", seed: int = 0):
+        if mode not in ("transition", "sequence"):
+            raise ValueError(f"unknown shard mode {mode!r}")
+        self.shard_id = shard_id
+        self.capacity = capacity
+        self.mode = mode
+        self.scorer = scorer
+        self._backend_kind = backend
+        self._seed = seed
+        self._lock = threading.Lock()
+        self.backend = make_replay(capacity, backend=backend,
+                                   seed=seed + 101 * shard_id)
+        self.epoch = 0
+        self.dead = False
+        self._max_error = 1.0  # error-domain running max (transform is monotone)
+        self.ingested_blobs = 0
+        self.ingested_items = 0
+        self.updates_applied = 0
+
+    # -- ingest (owning drainer thread) -----------------------------------
+
+    def ingest_blob(self, blob) -> int:
+        """Decode one wire blob and insert it; returns items inserted.
+
+        decode(cache=True) forces the layout cache regardless of the
+        trajectory-path codec verdict: shard ingest sees one stable
+        schema per run, the same argument that has the weight plane
+        force its own encode cache (`runtime/weights.py`).
+        """
+        from distributed_reinforcement_learning_tpu.data import codec
+
+        return self.ingest(codec.decode(blob, copy=True, cache=True))
+
+    def ingest(self, tree: Any) -> int:
+        """Score + insert one decoded trajectory pytree."""
+        per_transition = self.mode == "transition"
+        if self.scorer is not None:
+            errors = np.asarray(self.scorer(tree, per_transition), np.float64)
+        else:
+            errors = None
+        with self._lock:
+            if self.dead:
+                raise RuntimeError(f"replay shard {self.shard_id} is dead")
+            if errors is None:
+                n = (int(np.asarray(_first_leaf(tree)).shape[0])
+                     if per_transition else 1)
+                errors = np.full(n, self._max_error, np.float64)
+            else:
+                self._max_error = max(self._max_error, float(errors.max()))
+            n = self._insert_locked(errors, tree, per_transition)
+            self.ingested_blobs += 1
+            self.ingested_items += n
+        return n
+
+    def _insert_locked(self, errors: np.ndarray, tree: Any,
+                       per_transition: bool) -> int:
+        import jax
+
+        if per_transition:
+            if getattr(self.backend, "stacked_samples", False):
+                self.backend.add_batch_stacked(errors, tree)
+            else:
+                self.backend.add_batch(
+                    errors,
+                    [jax.tree.map(lambda x: x[i], tree)
+                     for i in range(len(errors))])
+            return len(errors)
+        self.backend.add(float(errors[0]), tree)
+        return 1
+
+    # -- gather-side (learner thread) -------------------------------------
+
+    def stats(self) -> dict:
+        """Fill / priority-mass / counters snapshot (telemetry providers
+        and the obs_report 'Replay shards' section poll this)."""
+        with self._lock:
+            return {
+                "count": len(self.backend),
+                "fill": len(self.backend) / self.capacity,
+                "priority_mass": float(self.backend.tree.total),
+                "ingested_blobs": self.ingested_blobs,
+                "ingested_items": self.ingested_items,
+                "updates_applied": self.updates_applied,
+                "epoch": self.epoch,
+                "dead": self.dead,
+            }
+
+    def mass_count(self) -> tuple[float, int, bool]:
+        with self._lock:
+            if self.dead:
+                return 0.0, 0, True
+            return float(self.backend.tree.total), len(self.backend), False
+
+    def sample_with_priorities(self, n: int, rng) -> tuple[Any, np.ndarray,
+                                                           np.ndarray, int]:
+        """-> (items_or_stacked, tree_idxs, raw priorities, epoch): this
+        shard's slice of a gather. Raw (already-transformed) priorities,
+        NOT IS weights — the service computes those globally."""
+        with self._lock:
+            out = self.backend.sample_with_priorities(n, rng)
+            return (*out, self.epoch)
+
+    # -- update router side ------------------------------------------------
+
+    def update(self, tree_idxs: np.ndarray, errors: np.ndarray,
+               epoch: int) -> int:
+        """Apply a routed priority-update batch; stale-epoch batches are
+        dropped loss-free (see module docstring). Returns applied count."""
+        with self._lock:
+            if self.dead or epoch != self.epoch:
+                return 0
+            self.backend.update_batch(tree_idxs, errors)
+            self._max_error = max(self._max_error,
+                                  float(np.abs(errors).max()))
+            self.updates_applied += len(tree_idxs)
+            return len(tree_idxs)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def mark_dead(self) -> None:
+        with self._lock:
+            self.dead = True
+
+    def restart(self) -> None:
+        """Fresh backend under a new epoch: in-flight updates against the
+        old contents are dropped by the epoch check, and everything
+        re-ingested starts at max-priority — nothing can be starved."""
+        with self._lock:
+            self.backend = make_replay(self.capacity, backend=self._backend_kind,
+                                       seed=self._seed + 101 * self.shard_id)
+            self.epoch = (self.epoch + 1) & int(_EPOCH_MASK)
+            self.dead = False
+            self._max_error = 1.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return self.backend.snapshot()
+
+    def restore_part(self, priorities, items) -> None:
+        with self._lock:
+            self.backend.restore({"priorities": np.asarray(priorities, np.float64),
+                                  "items": list(items),
+                                  "beta": float(self.backend.beta)})
+            # ingested_blobs stays in BLOB units (unrolls/sequences): a
+            # transition-mode snapshot restores per-transition items
+            # whose originating blob count is unknown here, and the
+            # learner's own restored counter covers its warm gate — so
+            # only sequence mode (item == blob) counts toward it.
+            if self.mode == "sequence":
+                self.ingested_blobs += len(items)
+            self.ingested_items += len(items)
+
+
+def _first_leaf(tree: Any):
+    import jax
+
+    return jax.tree.leaves(tree)[0]
+
+
+# -- batch allocation ---------------------------------------------------------
+
+
+def allocate_proportional(n: int, masses: np.ndarray) -> np.ndarray:
+    """Split a batch of n across shards proportionally to priority mass,
+    by largest remainder: sum(out) == n exactly, every share within 1 of
+    n * mass_i / sum(masses), zero-mass shards get zero."""
+    masses = np.asarray(masses, np.float64)
+    total = masses.sum()
+    if n <= 0 or total <= 0:
+        return np.zeros(len(masses), np.int64)
+    exact = n * masses / total
+    out = np.floor(exact).astype(np.int64)
+    remainder = n - int(out.sum())
+    if remainder > 0:
+        frac = exact - out
+        frac[masses <= 0] = -1.0  # never round a zero-mass shard up
+        for i in np.argsort(-frac)[:remainder]:
+            out[i] += 1
+    return out
+
+
+def merge_is_weights(priorities: np.ndarray, global_total: float,
+                     global_count: int, beta: float) -> np.ndarray:
+    """Monolithic `(N * p / total)^-beta / max` IS semantics over a
+    gathered batch: N and total are GLOBAL (summed over shards), the
+    normalizing max is the merged batch's max — so a one-shard service
+    reproduces `data/replay._is_weights` bit-for-bit."""
+    probs = np.asarray(priorities, np.float64) / global_total
+    weights = np.power(global_count * probs, -beta)
+    weights /= weights.max()
+    return weights.astype(np.float32)
+
+
+# -- the service --------------------------------------------------------------
+
+
+class ShardedReplayService:
+    """N-shard replay with the monolithic backend's sampling surface.
+
+    Implements the slice of the `data/replay.py` interface the
+    prioritized learners use — `sample`, `update_batch`, `__len__`,
+    `beta`, `snapshot`/`restore`, `stacked_samples` — so
+    `apex_runner`/`r2d2_runner`/`replay_train` swap it in for the
+    monolithic backend without touching the train math.
+    """
+
+    EPS = 0.001
+    ALPHA = 0.6
+    BETA_INCREMENT = 0.001
+
+    # Concurrency map (tools/drlint lock-discipline): `_pending` is the
+    # async update queue (learner thread appends, router thread pops,
+    # flush_updates waits on it); `_applying` marks a popped batch still
+    # being applied so flush can't return early; `beta` anneals on the
+    # learner thread but is read by checkpoint code; `healthy` latches
+    # false on all-shards-dead demotion (facade + learner read it).
+    _GUARDED_BY = {
+        "_pending": ("_lock", "_work"),
+        "_applying": ("_lock", "_work"),
+        "_closed": ("_lock", "_work"),
+        "_beta": ("_lock", "_work"),
+        "_healthy": ("_lock", "_work"),
+        "updates_dropped": ("_lock", "_work"),
+    }
+
+    def __init__(self, num_shards: int, capacity: int,
+                 mode: str = "transition", scorer: str = "max",
+                 backend: str = "auto", beta: float = 0.4, seed: int = 0,
+                 max_pending_updates: int = 256):
+        if not 1 <= num_shards <= MAX_SHARDS:
+            raise ValueError(f"num_shards must be in [1, {MAX_SHARDS}]")
+        per_shard = max(1, capacity // num_shards)
+        score_fn = make_scorer(scorer)
+        self.scorer_name = scorer or "max"
+        self.shards = [
+            ReplayShard(i, per_shard, mode=mode, scorer=score_fn,
+                        backend=backend, seed=seed)
+            for i in range(num_shards)
+        ]
+        self.mode = mode
+        self.stacked_samples = bool(
+            getattr(self.shards[0].backend, "stacked_samples", False))
+        self._beta = beta
+        self._healthy = True
+        self.updates_dropped = 0
+        self._np_rng = np.random.RandomState(seed + 7)
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        # Bounded latest-wins backlog: appends on the learner thread,
+        # popleft on the router; a full deque drops the OLDEST batch.
+        self._pending: deque = deque(maxlen=max_pending_updates)
+        self._applying = False
+        self._closed = False
+        self._router = threading.Thread(target=self._route_loop, daemon=True,
+                                        name="replay-update-router")
+        self._router.start()
+
+    # -- size / warm-gate accounting ---------------------------------------
+
+    @property
+    def beta(self) -> float:
+        """Annealed IS exponent; a plain locked attribute so the generic
+        learner checkpoint code (`replay.beta = ...`) works unchanged."""
+        with self._lock:
+            return self._beta
+
+    @beta.setter
+    def beta(self, value: float) -> None:
+        with self._lock:
+            self._beta = float(value)
+
+    @property
+    def healthy(self) -> bool:
+        """False once every shard died — the learner and the ingest
+        facade both demote PERMANENTLY to the monolithic path."""
+        with self._lock:
+            return self._healthy
+
+    def __len__(self) -> int:
+        return sum(s.mass_count()[1] for s in self.shards)
+
+    def ingested_blobs(self) -> int:
+        """Total blobs (unrolls / sequences) ingested across shards —
+        the learners' warm-up gate unit."""
+        return sum(s.stats()["ingested_blobs"] for s in self.shards)
+
+    def live_shards(self) -> list[ReplayShard]:
+        return [s for s in self.shards if not s.mass_count()[2]]
+
+    def note_shard_death(self, shard: ReplayShard) -> None:
+        """Ingest-side failure path: mark the shard dead; when none are
+        left, latch the service unhealthy (the facade and the learner
+        both demote to the monolithic path — never back)."""
+        shard.mark_dead()
+        if not self.live_shards():
+            with self._lock:
+                self._healthy = False
+
+    # -- sampling (learner thread) -----------------------------------------
+
+    def sample(self, n: int, rng=None):
+        """Gather a prioritized batch across shards; returns
+        (items_or_stacked, packed_idxs, is_weights) with monolithic
+        semantics (module docstring)."""
+        import jax
+
+        t0 = time.perf_counter()
+        rng = rng or self._np_rng
+        # ONE locked pass per shard: liveness rides the same snapshot
+        # (this runs once per train step, contending with ingest and
+        # router threads for the shard locks).
+        stats = [s.mass_count() for s in self.shards]
+        masses = np.array([m for m, _, dead in stats], np.float64)
+        global_total = float(masses.sum())
+        global_count = sum(c for _, c, _ in stats)
+        if all(dead for _, _, dead in stats) or global_count == 0 \
+                or global_total <= 0:
+            raise RuntimeError("sharded replay is empty or dead")
+        with self._lock:
+            self._beta = min(1.0, self._beta + self.BETA_INCREMENT)
+            beta = self._beta
+        alloc = allocate_proportional(n, masses)
+        parts: list[Any] = []
+        idx_parts: list[np.ndarray] = []
+        prio_parts: list[np.ndarray] = []
+        for shard, k in zip(self.shards, alloc):
+            if k == 0:
+                continue
+            items, idxs, prios, epoch = shard.sample_with_priorities(int(k), rng)
+            parts.append(items)
+            idx_parts.append(pack_index(shard.shard_id, epoch, idxs))
+            prio_parts.append(prios)
+        priorities = np.concatenate(prio_parts)
+        packed = np.concatenate(idx_parts)
+        weights = merge_is_weights(priorities, global_total, global_count, beta)
+        if self.stacked_samples:
+            batch = (parts[0] if len(parts) == 1 else
+                     jax.tree.map(lambda *xs: np.concatenate(xs), *parts))
+        else:
+            batch = [item for part in parts for item in part]
+        if _OBS.enabled:
+            _OBS.gauge("replay_shard/sample_ms",
+                       (time.perf_counter() - t0) * 1e3)
+            _OBS.count("replay_shard/samples", n)
+        return batch, packed, weights
+
+    # -- async priority updates --------------------------------------------
+
+    def update_batch(self, packed_idxs, errors) -> None:
+        """Enqueue a priority-update batch for the router thread; returns
+        immediately (the learner thread never walks a sum tree here).
+        Non-tagged indexes (a batch sampled from the monolithic fallback
+        after demotion) are ignored — the caller routes those itself."""
+        packed = np.asarray(packed_idxs, np.int64)
+        errs = np.asarray(errors, np.float64)
+        mask = is_packed_index(packed)
+        if not mask.all():
+            packed, errs = packed[mask], errs[mask]
+            if packed.size == 0:
+                return
+        with self._work:
+            if self._closed:
+                return
+            if len(self._pending) == self._pending.maxlen:
+                self.updates_dropped += 1  # latest-wins: oldest falls out
+            self._pending.append((packed, errs))
+            self._work.notify()
+
+    def _route_loop(self) -> None:
+        while True:
+            with self._work:
+                while not self._pending and not self._closed:
+                    self._work.wait()
+                if self._closed and not self._pending:
+                    return
+                packed, errs = self._pending.popleft()
+                self._applying = True
+            try:
+                self._apply_update(packed, errs)
+            finally:
+                with self._work:
+                    self._applying = False
+                    self._work.notify_all()
+
+    def _apply_update(self, packed: np.ndarray, errs: np.ndarray) -> None:
+        shard_ids, epochs, idxs = unpack_index(packed)
+        applied = 0
+        for sid in np.unique(shard_ids):
+            if not 0 <= sid < len(self.shards):
+                continue
+            pick = shard_ids == sid
+            for epoch in np.unique(epochs[pick]):
+                sel = pick & (epochs == epoch)
+                applied += self.shards[int(sid)].update(
+                    idxs[sel], errs[sel], int(epoch))
+        if _OBS.enabled and applied:
+            _OBS.count("replay_shard/updates_applied", applied)
+
+    def flush_updates(self, timeout: float | None = 5.0) -> bool:
+        """Block until every enqueued update batch has been applied (or
+        dropped); tests and checkpoint snapshots use this barrier."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._work:
+            while self._pending or self._applying:
+                wait = (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+                if wait is not None and wait <= 0:
+                    return False
+                self._work.wait(timeout=wait)
+            return True
+
+    # -- checkpoint round trip ---------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Merged shard snapshots in the list-backend format
+        (`utils/checkpoint.encode_replay_snapshot` consumes it as-is).
+        Pending updates are flushed first so priorities are current."""
+        from distributed_reinforcement_learning_tpu.data.replay import _snapshot_items
+
+        self.flush_updates()
+        prios: list[np.ndarray] = []
+        items: list[Any] = []
+        for shard in self.shards:
+            snap = shard.snapshot()
+            prios.append(np.asarray(snap["priorities"], np.float64))
+            items.extend(_snapshot_items(snap))
+        with self._lock:
+            beta = self._beta
+        return {"priorities": (np.concatenate(prios) if prios
+                               else np.zeros(0, np.float64)),
+                "items": items, "beta": beta}
+
+    def restore(self, snap: dict) -> None:
+        """Round-robin a (possibly monolithic) snapshot across live
+        shards; raw priorities are exact, shard placement is not part of
+        replay semantics (sampling is proportional either way)."""
+        from distributed_reinforcement_learning_tpu.data.replay import _snapshot_items
+
+        live = self.live_shards() or self.shards
+        items = _snapshot_items(snap)
+        prios = np.asarray(snap["priorities"], np.float64)
+        k = len(live)
+        for i, shard in enumerate(live):
+            sel = slice(i, len(items), k)
+            if prios[sel].size:
+                shard.restore_part(prios[sel], items[sel])
+        with self._lock:
+            self._beta = float(snap["beta"])
+
+    def approx_snapshot_nbytes(self) -> int:
+        """Sum of per-shard estimates when every backend can price its
+        snapshot (the SoA backends); 0 = unknown, let the encoder measure."""
+        total = 0
+        for shard in self.shards:
+            est = getattr(shard.backend, "approx_snapshot_nbytes", None)
+            if est is None:
+                return 0
+            total += est()
+        return total
+
+    # -- telemetry / lifecycle ---------------------------------------------
+
+    def shard_stats(self) -> list[dict]:
+        return [s.stats() for s in self.shards]
+
+    def close(self) -> None:
+        with self._work:
+            self._closed = True
+            self._work.notify_all()
+        self._router.join(timeout=2.0)

@@ -183,12 +183,17 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
         mesh=None,
         publish_interval: int = 1,
         updates_per_call: int = 1,
+        replay_service=None,
     ):
         self.agent = agent
         self.queue = queue
         self.weights = weights
         self.batch_size = batch_size
+        # Monolithic replay is ALWAYS built: it is the normal path when
+        # sharding is off, and the demotion target when a sharded
+        # service (data/replay_service.py) loses every shard.
         self.replay = make_replay(replay_capacity)
+        self.replay_service = replay_service
         self.target_sync_interval = target_sync_interval
         # K>1: K prioritized updates per learn_many dispatch
         # (runtime/replay_train.py; K-1-step-stale priorities).
@@ -241,20 +246,32 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
         self._profiler = ProfilerSession.from_env()
         weights.publish(self.state.params, 0)
 
+    def _warm_unrolls(self) -> int:
+        """Unrolls available to the warm-up gate: shard-side ingest
+        counts when the service is active, plus this learner's own
+        queue-path ingest (both feed training after a demotion)."""
+        svc = self.replay_service
+        shard_blobs = (svc.ingested_blobs()
+                       if svc is not None and svc.healthy else 0)
+        return max(self.ingested_unrolls, shard_blobs)
+
     def save_checkpoint(self, ckpt) -> None:
         """Persist TrainState (main+target nets, Adam moments) + host
         counters + a replay snapshot (contents AND priorities — without it
         a restarted learner resumes with an empty Memory while actors keep
         pushing stale-policy re-samples). The snapshot is size-capped /
-        disableable via DRL_CKPT_REPLAY* (utils/checkpoint.py)."""
+        disableable via DRL_CKPT_REPLAY* (utils/checkpoint.py). With the
+        sharded service active, the snapshot is the merged shard state
+        (pending async priority updates flushed first)."""
         from distributed_reinforcement_learning_tpu.utils.checkpoint import encode_replay_snapshot
 
         self._flush_pending_ingest()  # snapshot must include in-flight unrolls
-        blob = encode_replay_snapshot(self.replay)
+        replay = self._active_replay()
+        blob = encode_replay_snapshot(replay)
         ckpt.save(self.train_steps, self.state, {
             "train_steps": self.train_steps,
-            "replay_beta": float(self.replay.beta),
-            "ingested_unrolls": self.ingested_unrolls,
+            "replay_beta": float(replay.beta),
+            "ingested_unrolls": self._warm_unrolls(),
             **self._cadence_extra(),
         }, blobs={"replay": blob} if blob is not None else None)
 
@@ -266,14 +283,15 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
             return False
         self.state, extra, step = got
         self.train_steps = int(extra.get("train_steps", 0))
+        replay = self._active_replay()
         blob = ckpt.load_blob(step, "replay")
         if blob is not None:
-            self.replay.restore(decode_replay_snapshot(blob))
+            replay.restore(decode_replay_snapshot(blob))
             self.ingested_unrolls = int(extra.get("ingested_unrolls", 0))
         else:
             # No snapshot: the warm-up gate restarts, buffer refills live.
             self.ingested_unrolls = 0
-        self.replay.beta = float(extra.get("replay_beta", self.replay.beta))
+        replay.beta = float(extra.get("replay_beta", replay.beta))
         self.weights.publish(self.state.params, self.train_steps)
         self._restore_cadence(extra)
         return True
@@ -386,29 +404,19 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
     def train(self) -> dict | None:
         """One prioritized train call (`train_apex.py:124-155`); with
         `updates_per_call` K > 1, K scanned updates (replay_train.py)."""
-        if self.ingested_unrolls < self.train_start_unrolls:
+        if self._warm_unrolls() < self.train_start_unrolls:
             return None
-        if self.updates_per_call > 1:
-            from distributed_reinforcement_learning_tpu.runtime.replay_train import (
-                prioritized_train_call)
-
-            metrics = prioritized_train_call(self, self.updates_per_call)
-        else:
-            with self.timer.stage("replay_sample"):
-                items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
-                # SoA backend returns the stacked batch directly.
-                batch = items if getattr(self.replay, "stacked_samples", False) \
-                    else stack_pytrees(items)
-            with self.timer.stage("learn"):
-                if self._batch_sharding is not None:
-                    from distributed_reinforcement_learning_tpu.parallel import place_local_batch
-
-                    batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
-                self.state, td, metrics = self._learn(self.state, batch, is_weight)
-            with self.timer.stage("replay_update"):
-                # Deliberate sync: the re-prioritization targets the host
-                # sum-tree, so the TD errors must materialize here.
-                self.replay.update_batch(idxs, np.asarray(td))  # drlint: disable=host-sync
+        replay = self._active_replay()
+        if len(replay) == 0:
+            # Demotion raced the warm gate (the service counted warm,
+            # then lost its last shard): the monolithic replay is still
+            # empty — wait for it to refill through the demoted facade.
+            return None
+        # None = the service lost its last shard mid-call; the next
+        # train() resolves to the monolithic path.
+        metrics = self._train_guarded(replay)
+        if metrics is None:
+            return None
         self._finish_train_call()
         if _OBS.enabled:
             _OBS.count("learner/train_steps", self.updates_per_call)
@@ -418,6 +426,35 @@ class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
         # bounded MetricsPump (as the IMPALA learner does) instead of the
         # old per-step float() sync; sync loops still get host floats.
         return self.log_step_metrics(metrics)
+
+    def _train_once(self, replay) -> dict:
+        """The sample -> learn -> re-prioritize body of one train call,
+        against whichever replay `_active_replay()` resolved."""
+        if self.updates_per_call > 1:
+            from distributed_reinforcement_learning_tpu.runtime.replay_train import (
+                prioritized_train_call)
+
+            return prioritized_train_call(self, self.updates_per_call,
+                                          replay=replay)
+        with self.timer.stage("replay_sample"):
+            items, idxs, is_weight = replay.sample(self.batch_size, self._np_rng)
+            # SoA backend (and the sharded service over it) returns the
+            # stacked batch directly.
+            batch = items if getattr(replay, "stacked_samples", False) \
+                else stack_pytrees(items)
+        with self.timer.stage("learn"):
+            if self._batch_sharding is not None:
+                from distributed_reinforcement_learning_tpu.parallel import place_local_batch
+
+                batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
+            self.state, td, metrics = self._learn(self.state, batch, is_weight)
+        with self.timer.stage("replay_update"):
+            # Deliberate sync: the re-prioritization targets the host
+            # sum-tree, so the TD errors must materialize here. (The
+            # sharded service only enqueues here — its router thread
+            # walks the trees off the learn thread.)
+            replay.update_batch(idxs, np.asarray(td))  # drlint: disable=host-sync
+        return metrics
 
     def close(self) -> None:
         self.flush_publish()

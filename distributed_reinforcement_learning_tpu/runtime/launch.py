@@ -142,11 +142,14 @@ def make_agent(algo: str, agent_cfg: Any, rt: RuntimeConfig, mesh=None, actor: b
 
 def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
                  logger: MetricsLogger | None = None, rng: Any = None, agent=None,
-                 prefetch: bool = False, mesh=None):
+                 prefetch: bool = False, mesh=None, replay_service=None):
     """Learner runner over any queue/weight-store (in-process or served).
 
     `mesh`: optional `jax.sharding.Mesh` — the learn step is pjit-sharded
-    over it (batch on the data axis) instead of running single-device."""
+    over it (batch on the data axis) instead of running single-device.
+    `replay_service`: optional sharded replay (data/replay_service.py,
+    wired by run_role through runtime/replay_shard.py) — the prioritized
+    learners sample/update against it while it is healthy."""
     agent = agent or make_agent(algo, agent_cfg, rt, mesh=mesh)
     if algo in ("impala", "ximpala"):
         cls = (ximpala_runner.XImpalaLearner if algo == "ximpala"
@@ -161,7 +164,7 @@ def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
             replay_capacity=rt.replay_capacity,
             target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng,
             mesh=mesh, publish_interval=rt.publish_interval,
-            updates_per_call=rt.updates_per_call)
+            updates_per_call=rt.updates_per_call, replay_service=replay_service)
     cls = (xformer_runner.XformerLearner if algo == "xformer"
            else r2d2_runner.R2D2Learner)
     return cls(
@@ -169,7 +172,7 @@ def make_learner(algo: str, agent_cfg: Any, rt: RuntimeConfig, queue, weights,
         replay_capacity=rt.replay_capacity,
         target_sync_interval=rt.target_sync_interval, logger=logger, rng=rng,
         mesh=mesh, publish_interval=rt.publish_interval,
-        updates_per_call=rt.updates_per_call)
+        updates_per_call=rt.updates_per_call, replay_service=replay_service)
 
 
 def make_actor(algo: str, agent_cfg: Any, rt: RuntimeConfig, task: int, queue, weights,

@@ -172,6 +172,7 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
         mesh=None,
         publish_interval: int = 1,
         updates_per_call: int = 1,
+        replay_service=None,
     ):
         self.agent = agent
         self.queue = queue
@@ -196,14 +197,26 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
             maxlen=min(int(os.environ.get("DRL_R2D2_RECENT_WINDOW",
                                           str(8 * batch_size))),
                        replay_capacity))
+        # Monolithic replay is ALWAYS built: the normal path when
+        # sharding is off, and the demotion target when a sharded
+        # service (data/replay_service.py) loses every shard.
         self.replay = make_replay(
             replay_capacity,
             backend="python" if self.recent_fraction > 0 else "auto")
+        self.replay_service = replay_service
         if self.recent_fraction > 0 and updates_per_call > 1:
             raise ValueError(
                 "DRL_R2D2_RECENT_FRACTION does not compose with "
                 "updates_per_call > 1 (the scanned train call samples "
                 "inside one dispatch)")
+        if self.recent_fraction > 0 and replay_service is not None:
+            # Recent-mixing swaps rows via queue-path ingest bookkeeping
+            # the shards never populate; fail loudly instead of silently
+            # degrading to a plain prioritized sample.
+            raise ValueError(
+                "DRL_R2D2_RECENT_FRACTION does not compose with "
+                "DRL_REPLAY_SHARDS (shard ingest bypasses the recent "
+                "deque)")
         self.target_sync_interval = target_sync_interval
         # K>1: K prioritized updates per learn_many dispatch
         # (runtime/replay_train.py; K-1-step-stale priorities).
@@ -234,17 +247,26 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
         self._profiler = ProfilerSession.from_env()
         weights.publish(self.state.params, 0)
 
+    def _warm_sequences(self) -> int:
+        svc = self.replay_service
+        shard_blobs = (svc.ingested_blobs()
+                       if svc is not None and svc.healthy else 0)
+        return max(self.ingested_sequences, shard_blobs)
+
     def save_checkpoint(self, ckpt) -> None:
         """Persist TrainState + host counters + a replay snapshot of the
         sequence Memory (the reference's R2D2 agent had no Saver at all —
-        SURVEY §5.4). Snapshot gated by DRL_CKPT_REPLAY* (utils/checkpoint.py)."""
+        SURVEY §5.4). Snapshot gated by DRL_CKPT_REPLAY* (utils/checkpoint.py).
+        With the sharded service active, the snapshot is the merged shard
+        state (pending async priority updates flushed first)."""
         from distributed_reinforcement_learning_tpu.utils.checkpoint import encode_replay_snapshot
 
-        blob = encode_replay_snapshot(self.replay)
+        replay = self._active_replay()
+        blob = encode_replay_snapshot(replay)
         ckpt.save(self.train_steps, self.state, {
             "train_steps": self.train_steps,
-            "replay_beta": float(self.replay.beta),
-            "ingested_sequences": self.ingested_sequences,
+            "replay_beta": float(replay.beta),
+            "ingested_sequences": self._warm_sequences(),
             **self._cadence_extra(),
         }, blobs={"replay": blob} if blob is not None else None)
 
@@ -256,13 +278,14 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
             return False
         self.state, extra, step = got
         self.train_steps = int(extra.get("train_steps", 0))
+        replay = self._active_replay()
         blob = ckpt.load_blob(step, "replay")
         if blob is not None:
-            self.replay.restore(decode_replay_snapshot(blob))
+            replay.restore(decode_replay_snapshot(blob))
             self.ingested_sequences = int(extra.get("ingested_sequences", 0))
         else:
             self.ingested_sequences = 0  # replay refills from live traffic
-        self.replay.beta = float(extra.get("replay_beta", self.replay.beta))
+        replay.beta = float(extra.get("replay_beta", replay.beta))
         self.weights.publish(self.state.params, self.train_steps)
         self._restore_cadence(extra)
         return True
@@ -340,31 +363,19 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
 
     def train(self) -> dict | None:
         """One prioritized train step over sequences (`train_r2d2.py:121-164`)."""
-        if self.ingested_sequences < 2 * self.batch_size:  # `train_r2d2.py:121`
+        if self._warm_sequences() < 2 * self.batch_size:  # `train_r2d2.py:121`
             return None
-        if self.updates_per_call > 1:
-            from distributed_reinforcement_learning_tpu.runtime.replay_train import (
-                prioritized_train_call)
-
-            metrics = prioritized_train_call(self, self.updates_per_call)
-        else:
-            with self.timer.stage("replay_sample"):
-                items, idxs, is_weight = self.replay.sample(self.batch_size, self._np_rng)
-                if self.recent_fraction > 0:
-                    items, idxs, is_weight = self._mix_recent(items, idxs, is_weight)
-                # SoA backend returns the stacked batch directly.
-                batch = items if getattr(self.replay, "stacked_samples", False) \
-                    else stack_pytrees(items)
-            with self.timer.stage("learn"):
-                if self._batch_sharding is not None:
-                    from distributed_reinforcement_learning_tpu.parallel import place_local_batch
-
-                    batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
-                self.state, priorities, metrics = self._learn(self.state, batch, is_weight)
-            with self.timer.stage("replay_update"):
-                # Deliberate sync: re-prioritization targets the host
-                # sum-tree, so the priorities must materialize here.
-                self.replay.update_batch(idxs, np.asarray(priorities))  # drlint: disable=host-sync
+        replay = self._active_replay()
+        if len(replay) == 0:
+            # Demotion raced the warm gate (the service counted warm,
+            # then lost its last shard): the monolithic replay is still
+            # empty — wait for it to refill through the demoted facade.
+            return None
+        # None = the service lost its last shard mid-call; the next
+        # train() resolves to the monolithic path.
+        metrics = self._train_guarded(replay)
+        if metrics is None:
+            return None
         self._finish_train_call()
         if _OBS.enabled:
             _OBS.count("learner/train_steps", self.updates_per_call)
@@ -374,6 +385,37 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
         # bounded MetricsPump (as the IMPALA learner does) instead of the
         # old per-step float() sync; sync loops still get host floats.
         return self.log_step_metrics(metrics)
+
+    def _train_once(self, replay) -> dict:
+        """The sample -> learn -> re-prioritize body of one train call,
+        against whichever replay `_active_replay()` resolved."""
+        if self.updates_per_call > 1:
+            from distributed_reinforcement_learning_tpu.runtime.replay_train import (
+                prioritized_train_call)
+
+            return prioritized_train_call(self, self.updates_per_call,
+                                          replay=replay)
+        with self.timer.stage("replay_sample"):
+            items, idxs, is_weight = replay.sample(self.batch_size, self._np_rng)
+            if self.recent_fraction > 0:
+                items, idxs, is_weight = self._mix_recent(items, idxs, is_weight)
+            # SoA backend (and the sharded service over it) returns the
+            # stacked batch directly.
+            batch = items if getattr(replay, "stacked_samples", False) \
+                else stack_pytrees(items)
+        with self.timer.stage("learn"):
+            if self._batch_sharding is not None:
+                from distributed_reinforcement_learning_tpu.parallel import place_local_batch
+
+                batch, is_weight = place_local_batch((batch, is_weight), self._batch_sharding)
+            self.state, priorities, metrics = self._learn(self.state, batch, is_weight)
+        with self.timer.stage("replay_update"):
+            # Deliberate sync: re-prioritization targets the host
+            # sum-tree, so the priorities must materialize here. (The
+            # sharded service only enqueues — its router thread walks
+            # the trees off the learn thread.)
+            replay.update_batch(idxs, np.asarray(priorities))  # drlint: disable=host-sync
+        return metrics
 
     def close(self) -> None:
         self.flush_publish()

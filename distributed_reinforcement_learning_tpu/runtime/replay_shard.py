@@ -1,0 +1,231 @@
+"""Runtime host for the sharded replay service (data/replay_service.py).
+
+Thin wiring layer, mirroring how runtime/shm_ring.py hosts its ring:
+the GATE (`DRL_REPLAY_SHARDS`, unset defers to the committed
+`benchmarks/replay_verdict.json` adjudication), the ingest FACADE that
+slots into the existing `fifo.blob_ingest` seam in place of the
+learner's trajectory queue, and the run_role builder + telemetry
+registration.
+
+The facade is where "each drainer owns a replay shard" happens without
+touching the drainers: the TCP server's per-connection serve threads
+and the shm-ring drain threads each call `blob_ingest(queue)` and then
+push blobs from their own thread — `ReplayIngestFifo.ingest_blob` maps
+each calling thread to a shard (round-robin over live shards on first
+contact), so decode + initial-priority scoring + sum-tree insert run on
+the TRANSPORT thread that already holds the bytes, never on the learner
+thread. Backpressure disappears by construction: prioritized replay is
+a ring that overwrites its oldest items (the Ape-X semantic), so an
+ingest never blocks and the bounded-queue wait the monolithic path paid
+per PUT is gone.
+
+Failure containment: an ingest error marks the calling thread's shard
+dead and re-routes the thread to a surviving shard; when none survive,
+the facade demotes PERMANENTLY to the real trajectory queue — the
+learner's monolithic ingest loop (still running, normally idle) takes
+over, exactly like the ring's demote-to-TCP.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+
+_VERDICT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "benchmarks", "replay_verdict.json")
+
+_DEFAULT_SHARDS = 2  # auto-enabled count when the verdict carries none
+
+
+def shards_auto_enabled(verdict_path: str = _VERDICT_PATH) -> bool:
+    """The committed `replay_compare` verdict (bench.py): shards ship
+    enabled-by-default only if the two-process A/B showed >= 1.2x the
+    monolithic ingest+train throughput — the repo's Pallas-LSTM rule."""
+    try:
+        with open(verdict_path) as f:
+            return bool(json.load(f).get("auto_enable", False))
+    except (OSError, ValueError):
+        return False
+
+
+def shard_count(verdict_path: str = _VERDICT_PATH) -> int:
+    """Resolved shard count: 0 = sharding off.
+
+    `DRL_REPLAY_SHARDS=0` forces off, `=N` (N >= 1) forces N shards;
+    unset defers to the committed adjudication (which may carry its own
+    `shards` count, default 2)."""
+    env = os.environ.get("DRL_REPLAY_SHARDS", "").strip()
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError as e:
+            raise ValueError(
+                f"DRL_REPLAY_SHARDS must be an integer, got {env!r}") from e
+    if not shards_auto_enabled(verdict_path):
+        return 0
+    try:
+        with open(verdict_path) as f:
+            return max(1, int(json.load(f).get("shards", _DEFAULT_SHARDS)))
+    except (OSError, ValueError):
+        return _DEFAULT_SHARDS
+
+
+_ALGO_MODE = {"apex": "transition", "r2d2": "sequence", "xformer": "sequence"}
+
+
+def build_service(algo: str, rt, num_shards: int | None = None,
+                  seed: int = 0):
+    """-> a `ShardedReplayService` for a prioritized-replay learner
+    process, or None when sharding is off / the algo has no replay.
+
+    The caller wraps it in a `ReplayIngestFifo(service, queue)` — the
+    facade needs the REAL queue as its demotion fallback; run_role
+    passes the facade (not the queue) to the TransportServer and the
+    ring drainer, while the learner keeps draining the real queue."""
+    mode = _ALGO_MODE.get(algo)
+    if mode is None:
+        return None
+    n = shard_count() if num_shards is None else num_shards
+    if n <= 0:
+        return None
+    from distributed_reinforcement_learning_tpu.data.replay_service import (
+        ShardedReplayService)
+
+    scorer = os.environ.get("DRL_REPLAY_SCORER", "max").strip() or "max"
+    return ShardedReplayService(n, rt.replay_capacity, mode=mode,
+                                scorer=scorer, seed=seed)
+
+
+class ReplayIngestFifo:
+    """Queue facade over the service for the `fifo.blob_ingest` seam.
+
+    `blob_ingest` hands blob-bearing transports `(identity, ingest_blob)`
+    when this attribute is present, so the shard sees the RAW wire blob
+    (a dedup-packed blob decodes straight to the plain pytree — no
+    unpack->re-encode round trip like the blob-native queue path pays).
+
+    Concurrency map (tools/drlint lock-discipline): serve/drain threads
+    race on the thread->shard map and the round-robin cursor; `_demoted`
+    latches one-way under the same lock. Shard internals lock themselves
+    (data/replay_service.py).
+    """
+
+    _GUARDED_BY = {
+        "_by_thread": "_lock",
+        "_next": "_lock",
+        "_demoted": "_lock",
+    }
+
+    def __init__(self, service, fallback_queue):
+        from distributed_reinforcement_learning_tpu.data.fifo import blob_ingest
+
+        self.service = service
+        self.fallback = fallback_queue
+        self._fb_prepare, self._fb_put = blob_ingest(fallback_queue)
+        self._lock = threading.Lock()
+        self._by_thread: dict[int, Any] = {}
+        self._next = 0
+        self._demoted = False
+
+    def _shard_for_thread(self):
+        """This thread's shard (round-robin over LIVE shards on first
+        contact, re-mapped after its shard dies); None once demoted."""
+        ident = threading.get_ident()
+        with self._lock:
+            if self._demoted:
+                return None
+            shard = self._by_thread.get(ident)
+            if shard is not None and not shard.mass_count()[2]:
+                return shard
+            live = self.service.live_shards()
+            if not live:
+                self._demoted = True
+                return None
+            shard = live[self._next % len(live)]
+            self._next += 1
+            self._by_thread[ident] = shard
+            return shard
+
+    def ingest_blob(self, blob, timeout: float | None = None) -> bool:
+        """One wire blob into the calling thread's shard. Never blocks
+        (replay overwrites its oldest — the Ape-X ring semantic).
+
+        Failure containment is two-tier, and a bad BLOB never kills a
+        shard: a decode failure is a POISON BLOB — dropped and counted
+        (at-most-once, like every PUT on this plane; the monolithic
+        serve-thread decode would have thrown it away too), while a
+        failure INSIDE the shard (scoring/backend) marks that shard
+        dead and drops the blob — it is never retried on a survivor,
+        so one bad input cannot cascade through the fleet. Once every
+        shard is dead, blobs go to the monolithic fallback queue."""
+        shard = self._shard_for_thread()
+        if shard is None:  # demoted: the monolithic path owns ingest
+            return self._fb_put(self._fb_prepare(blob), timeout=timeout)
+        from distributed_reinforcement_learning_tpu.data import codec
+
+        try:
+            # decode(cache=True): shard ingest sees one stable schema
+            # per run, so the layout cache is forced like the weight
+            # plane's encode cache (data/codec.py decode docstring).
+            tree = codec.decode(blob, copy=True, cache=True)
+        except Exception:  # noqa: BLE001 — poison blob: drop + count
+            self._warn("undecodable blob dropped (poison PUT?)")
+            if _OBS.enabled:
+                _OBS.count("replay_shard/poison_blobs")
+            return True
+        try:
+            n = shard.ingest(tree)
+        except Exception:  # noqa: BLE001 — shard-internal failure:
+            import traceback  # fail LOUDLY, contain it to THIS shard
+
+            self._warn(
+                f"shard {shard.shard_id} ingest failed; marking dead\n"
+                f"{traceback.format_exc(limit=2)}")
+            self.service.note_shard_death(shard)
+            return True  # blob dropped (at-most-once), never re-routed
+        if _OBS.enabled:
+            _OBS.count("replay_shard/ingested_items", n)
+            _OBS.count("replay_shard/ingested_blobs")
+        return True
+
+    def _warn(self, msg: str) -> None:
+        import sys
+
+        print(f"[replay_shard] WARNING: {msg}", file=sys.stderr)
+
+    def size(self) -> int:
+        """Queue-depth poll (OP_QUEUE_SIZE): ingest is immediate, so the
+        only depth that can exist is the fallback's after demotion."""
+        with self._lock:
+            demoted = self._demoted
+        return self.fallback.size() if demoted else 0
+
+    @property
+    def demoted(self) -> bool:
+        with self._lock:
+            return self._demoted
+
+    def close(self) -> None:
+        self.service.close()
+
+
+def register_telemetry(service) -> None:
+    """Per-shard fill / priority-mass / counter providers (polled from
+    the telemetry flush thread; obs_report renders them as the 'Replay
+    shards' section)."""
+    for i, shard in enumerate(service.shards):
+        _OBS.sample(f"replay_shard/{i}/fill",
+                    lambda s=shard: s.stats()["fill"])
+        _OBS.sample(f"replay_shard/{i}/priority_mass",
+                    lambda s=shard: s.stats()["priority_mass"])
+        _OBS.sample(f"replay_shard/{i}/ingested_items",
+                    lambda s=shard: s.stats()["ingested_items"],
+                    kind="counter")
+        _OBS.sample(f"replay_shard/{i}/updates_applied",
+                    lambda s=shard: s.stats()["updates_applied"],
+                    kind="counter")

@@ -30,7 +30,32 @@ from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
 class ReplayTrainMixin:
     """Stride accounting for prioritized learners. Host-class contract:
     `agent` / `state` / `timer` / `replay` / `batch_size` / `_np_rng` /
-    `target_sync_interval` / PublishCadenceMixin."""
+    `target_sync_interval` / `replay_service` / `_train_once` /
+    PublishCadenceMixin."""
+
+    def _active_replay(self):
+        """The replay the train path samples/updates: the sharded
+        service (data/replay_service.py, wired by runtime/replay_shard)
+        while it is healthy, the monolithic backend otherwise — the
+        same permanent demote-on-failure shape as the ring and board
+        transports."""
+        svc = self.replay_service
+        return svc if svc is not None and svc.healthy else self.replay
+
+    def _train_guarded(self, replay):
+        """`_train_once(replay)` with the service-demotion escape hatch:
+        ONLY the sharded service's own all-shards-dead RuntimeError is
+        converted to None (next train() resolves to the monolithic
+        path); any RuntimeError while the service is still healthy —
+        e.g. jax's XlaRuntimeError from the learn step, which
+        subclasses RuntimeError — propagates."""
+        try:
+            return self._train_once(replay)
+        except RuntimeError:
+            svc = self.replay_service
+            if replay is self.replay or (svc is not None and svc.healthy):
+                raise
+            return None
 
     def _init_stride(self, updates_per_call: int, mesh) -> None:
         self.updates_per_call = max(1, int(updates_per_call))
@@ -69,14 +94,27 @@ class ReplayTrainMixin:
         self._last_publish_step = self.train_steps  # restore just republished
 
 
-def prioritized_train_call(learner, k: int) -> dict:
+def prioritized_train_call(learner, k: int, replay=None) -> dict:
     """Run `k` prioritized updates as one scan on `learner`; returns the
-    last step's metrics (device arrays; callers float them)."""
-    soa = getattr(learner.replay, "stacked_samples", False)
+    last step's metrics (device arrays; callers float them).
+
+    Samples and re-prioritizes against `replay` — the caller's already-
+    resolved ACTIVE replay (the `_train_guarded` demotion guard reasons
+    about the same object it passed down; re-resolving here could race
+    a mid-call demotion onto a different replay than the guard checks).
+    With the sharded service, the K-update writeback below only
+    ENQUEUES: the service's router thread applies each batch's
+    priorities to the owning shard asynchronously (latest-wins), so the
+    learn thread never walks a sum tree here. Batches 2..K were sampled
+    before any of the K updates landed either way — the same
+    K-1-step priority staleness the scan always had."""
+    if replay is None:
+        replay = learner._active_replay()
+    soa = getattr(replay, "stacked_samples", False)
     sampled = []
     with learner.timer.stage("replay_sample"):
         for _ in range(k):
-            sampled.append(learner.replay.sample(learner.batch_size, learner._np_rng))
+            sampled.append(replay.sample(learner.batch_size, learner._np_rng))
         # Host-side batch assembly belongs to the sample stage (the K=1
         # path stacks there too): keep the learn stage device-only.
         if soa:
@@ -95,5 +133,5 @@ def prioritized_train_call(learner, k: int) -> dict:
     with learner.timer.stage("replay_update"):
         prio_stack = np.asarray(prio_stack)
         for (_, idxs, _), prio in zip(sampled, prio_stack):
-            learner.replay.update_batch(idxs, prio)
+            replay.update_batch(idxs, prio)
     return metrics

@@ -410,7 +410,11 @@ class RingDrainer(_LockedStatsMixin):
     queue — the learner-side half of the zero-copy PUT path. Ingest
     semantics are shared with the TCP server via `fifo.blob_ingest`
     (raw bytes for blob-native queues, a decoded copy otherwise), so the
-    two transports cannot drift on what lands in the queue."""
+    two transports cannot drift on what lands in the queue. Under
+    DRL_REPLAY_SHARDS the "queue" is the replay-shard facade
+    (runtime/replay_shard.py): the same seam then makes each drain
+    thread the owner of a replay shard — decode + initial priority +
+    insert happen right here instead of on the learner thread."""
 
     # Concurrency map (tools/drlint lock-discipline): the per-ring drain
     # threads bump `stats` while telemetry providers and stop() read it

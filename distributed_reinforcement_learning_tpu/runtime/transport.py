@@ -939,12 +939,30 @@ def run_role(
             if board is not None:
                 weights.attach_board(board)
                 print("[learner] shm weight board serving co-hosted actors")
+        # Sharded replay with ingest-time prioritization (data/
+        # replay_service.py; gate + facade in runtime/replay_shard.py):
+        # when enabled, every transport ingest thread decodes, scores,
+        # and inserts into its OWN shard, and the learner's ingest
+        # stages shrink to a gather-from-shards sample. The facade
+        # replaces the queue for the TCP server and the ring drainer;
+        # the REAL queue stays built as the demotion fallback (the
+        # learner keeps draining it — normally idle).
+        from distributed_reinforcement_learning_tpu.runtime import replay_shard
+
+        replay_service = replay_shard.build_service(algo, rt, seed=seed)
+        ingest_queue: Any = queue
+        if replay_service is not None:
+            ingest_queue = replay_shard.ReplayIngestFifo(replay_service, queue)
+            print(f"[learner] sharded replay: "
+                  f"{len(replay_service.shards)} ingest shard(s), "
+                  f"scorer {replay_service.scorer_name}")
         learner = launch.make_learner(
             algo, agent_cfg, rt, queue, weights, logger=logger,
             rng=jax.random.PRNGKey(seed),
             # Free-running learner: overlap H2D of batch k+1 with step k.
             prefetch=(algo in ("impala", "ximpala")),
             mesh=mesh,
+            replay_service=replay_service,
         )
         ckpt = None
         if checkpoint_dir is not None:
@@ -967,8 +985,8 @@ def run_role(
         # a learner via DRL_LEARNER_INDEX) and collision-free when the
         # processes share one machine (tests; single-host multi-chip).
         serve_port = rt.server_port + (jax.process_index() if multihost else 0)
-        server = TransportServer(queue, weights, host="0.0.0.0", port=serve_port,
-                                 inference=inference).start()
+        server = TransportServer(ingest_queue, weights, host="0.0.0.0",
+                                 port=serve_port, inference=inference).start()
         # Co-hosted actors' zero-copy data plane (runtime/shm_ring.py):
         # the launcher names one ring per co-hosted actor; this side
         # creates the segments and drains them into the same bounded
@@ -979,7 +997,7 @@ def run_role(
         if ring_names:
             from distributed_reinforcement_learning_tpu.runtime import shm_ring
 
-            ring_drainer = shm_ring.serve_rings(ring_names, queue)
+            ring_drainer = shm_ring.serve_rings(ring_names, ingest_queue)
             if ring_drainer is not None:
                 print(f"[learner] shm rings serving {len(ring_names)} "
                       f"co-hosted actor(s)")
@@ -1013,6 +1031,10 @@ def run_role(
             for key in codec.cache_stats():
                 _OBS.sample(f"codec/{key}", lambda k=key: codec.cache_stat(k),
                             kind="counter")
+            if replay_service is not None:
+                # Per-shard fill / priority-mass / ingest counters — the
+                # obs_report "Replay shards" section.
+                replay_shard.register_telemetry(replay_service)
         print(f"[learner] serving on :{serve_port}; training {num_updates} updates")
         try:
             _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
@@ -1031,6 +1053,8 @@ def run_role(
                 board.unlink()
             if inference is not None:
                 inference.stop()
+            if replay_service is not None:
+                replay_service.close()  # stop the update-router thread
             _OBS.close()  # final shard flush + trace terminator
         print(f"[learner] done: {learner.train_steps} updates")
     elif mode == "actor":

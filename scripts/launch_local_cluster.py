@@ -66,6 +66,14 @@ def main() -> None:
                    help="force a jax platform for the LEARNER (actors are cpu)")
     p.add_argument("--serve_inference", action="store_true")
     p.add_argument("--remote_act", action="store_true")
+    p.add_argument("--replay_shards", type=int, default=None,
+                   help="prioritized-replay learners (apex/r2d2/xformer): "
+                        "N>=1 shards replay across the learner's ingest "
+                        "threads with ingest-time prioritization "
+                        "(DRL_REPLAY_SHARDS; 0 forces the monolithic "
+                        "path). Unset defers to the committed "
+                        "benchmarks/replay_verdict.json adjudication; "
+                        "see docs/performance.md 'Replay shards'")
     p.add_argument("--staleness_budget", type=int, default=None,
                    help="bound the weight staleness actors can be observed "
                         "at (in train steps, the unit of the "
@@ -118,6 +126,13 @@ def main() -> None:
         # a stale export must not silently divert this run's shards.
         env["DRL_TELEMETRY_DIR"] = os.path.join(
             os.path.abspath(args.run_dir), "telemetry")
+    if args.replay_shards is not None:
+        # Learner-side gate (runtime/replay_shard.shard_count is the
+        # canonical resolution; this just forces it for the topology).
+        env["DRL_REPLAY_SHARDS"] = str(max(0, args.replay_shards))
+        print(f"[cluster] replay shards: "
+              f"{'off (monolithic)' if args.replay_shards <= 0 else args.replay_shards}",
+              file=sys.stderr)
     if args.staleness_budget is not None:
         # Derivation from the learner/weight_staleness semantics (the
         # histogram measures learner version minus the version each

@@ -291,7 +291,8 @@ def build_report(tdir: str, merge: bool = True) -> str:
     any_counter = False
     for shard in shards:
         for name, stats in sorted(shard.counter_rates().items()):
-            if name.startswith(("staleness_bucket/", "codec/", "board/")):
+            if name.startswith(("staleness_bucket/", "codec/", "board/",
+                                "replay_shard/")):
                 continue  # rendered as their own sections below
             any_counter = True
             out(f"  {shard_label(shard):<14} {name:<28} "
@@ -383,6 +384,41 @@ def build_report(tdir: str, merge: bool = True) -> str:
         out("")
         out("-- Codec fast path (schema cache + frame-stack dedup) --")
         lines.extend(codec_lines)
+
+    # Sharded replay (data/replay_service.py): per-shard fill + priority
+    # mass, ingest/update throughput, gather-sample latency. Section only
+    # appears when a run actually ran with DRL_REPLAY_SHARDS ingest.
+    shard_lines: list[str] = []
+    for shard in shards:
+        per = sorted(
+            n.split("/")[1] for n in shard.gauges
+            if n.startswith("replay_shard/") and n.endswith("/fill"))
+        rates = shard.counter_rates()
+        for sid in per:
+            fill = shard.gauge_stats(f"replay_shard/{sid}/fill")
+            mass = shard.gauge_stats(f"replay_shard/{sid}/priority_mass")
+            if fill is None:
+                continue
+            ing = rates.get(f"replay_shard/{sid}/ingested_items", {})
+            upd = rates.get(f"replay_shard/{sid}/updates_applied", {})
+            mass_part = f"mass {mass['last']:.1f}  " if mass is not None else ""
+            shard_lines.append(
+                f"  {shard_label(shard)} shard {sid}: fill "
+                f"{100 * fill['last']:.1f}% (peak {100 * fill['max']:.1f}%)  "
+                f"{mass_part}"
+                f"ingested {ing.get('total', 0):.0f} items "
+                f"({ing.get('rate', 0):.0f}/s)  "
+                f"updates {upd.get('total', 0):.0f}")
+        stats = shard.gauge_stats("replay_shard/sample_ms")
+        if stats is not None:
+            shard_lines.append(
+                f"  {shard_label(shard)}: gather-sample mean "
+                f"{stats['mean']:.2f}ms  max {stats['max']:.2f}ms  "
+                f"({stats['n']} samples)")
+    if shard_lines:
+        out("")
+        out("-- Replay shards (ingest-time prioritization) --")
+        lines.extend(shard_lines)
 
     out("")
     out("-- Weight publication --")

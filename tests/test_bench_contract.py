@@ -42,6 +42,7 @@ _TRIMMED = {
     "BENCH_APEX_INGEST": "0", "BENCH_INGEST": "0",
     "BENCH_ANAKIN": "0", "BENCH_ANAKIN_R2D2": "0",
     "BENCH_TRANSPORT": "0", "BENCH_CODEC": "0", "BENCH_WEIGHTS": "0",
+    "BENCH_REPLAY": "0",
 }
 
 
@@ -239,6 +240,51 @@ class TestWeightsCompare:
             board_auto_enabled)
 
         assert board_auto_enabled() is verdict["auto_enable"]
+
+
+class TestReplayCompare:
+    """bench_replay_compare: the two-process monolithic-vs-sharded Ape-X
+    ingest A/B whose verdict gates data/replay_service's auto-enable.
+    Driven directly at a tiny config (CPU, host-only) — the committed
+    adjudication numbers live in benchmarks/replay_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        bench = _load_bench()
+        r = bench.bench_replay_compare(n_unrolls=24, unrolls_per_put=8,
+                                       steps=16, obs_dim=16, reps=1)
+        for side in ("mono", "sharded"):
+            assert r[side]["frames_per_s"] > 0, r
+            assert r[side]["sample_ms_p99"] >= r[side]["sample_ms_p50"]
+        assert r["sharded"]["shards"] >= 1
+        assert sum(r["sharded"]["shard_fill"]) > 0  # shards really filled
+        assert r["sharded_vs_mono"] > 0
+        assert r["auto_enable"] == (r["sharded_vs_mono"] >= 1.2)
+        assert r["verdict"].startswith("replay shards ") and (
+            "auto-on" in r["verdict"] or "opt-in" in r["verdict"])
+
+    def test_compact_line_carries_replay_verdict_key(self):
+        bench = _load_bench()
+        assert "replay_verdict" in bench._COMPACT_KEYS
+
+    def test_committed_verdict_file_consistent(self, monkeypatch):
+        """The committed adjudication parses, and shard_count() follows
+        it when DRL_REPLAY_SHARDS is unset (env force > committed
+        verdict > off)."""
+        monkeypatch.delenv("DRL_REPLAY_SHARDS", raising=False)
+        verdict = json.loads(
+            (REPO / "benchmarks" / "replay_verdict.json").read_text())
+        assert isinstance(verdict["auto_enable"], bool)
+        assert verdict["ratio_runs"] and verdict["bar"] == 1.2
+        from distributed_reinforcement_learning_tpu.runtime.replay_shard import (
+            shard_count, shards_auto_enabled)
+
+        assert shards_auto_enabled() is verdict["auto_enable"]
+        assert (shard_count() > 0) is verdict["auto_enable"]
+        monkeypatch.setenv("DRL_REPLAY_SHARDS", "3")
+        assert shard_count() == 3  # env force wins over the verdict
+        monkeypatch.setenv("DRL_REPLAY_SHARDS", "0")
+        assert shard_count() == 0
 
 
 class TestDeviceChunkGate:

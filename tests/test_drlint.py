@@ -536,6 +536,8 @@ class TestCliAndTreeGate:
             "runtime/inference.py": 1,
             "data/fifo.py": 1,
             "data/replay.py": 3,         # Native/Array backends + doc note
+            "data/replay_service.py": 2,  # ReplayShard + ShardedReplayService
+            "runtime/replay_shard.py": 1,  # ReplayIngestFifo
             "data/native.py": 1,
         }
         for rel, want in expected.items():

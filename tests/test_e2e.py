@@ -100,9 +100,22 @@ def test_apex_trains_cartpole():
     assert late > early
 
 
+# Priority tolerance for batched-vs-per-unroll TD ingest: the [K*32]
+# forward and K [32] forwards are mathematically identical per row, but
+# XLA CPU tiles its matmul reductions by batch size, so the per-row dot
+# products accumulate in different orders. Measured drift on this host:
+# 3.1e-6 relative on 2/128 elements (float32 epsilon-scale, not an
+# accumulation bug in the ingest path — forcing identical orders would
+# mean giving up the batched forward). Pinned one order above the
+# observed drift; a real semantic regression (wrong transition paired
+# with wrong TD) shows up orders of magnitude larger.
+_APEX_INGEST_RTOL = 1e-5
+
+
 def test_apex_ingest_many_matches_per_unroll():
     """The batched [K*32] TD forward must ingest exactly what K per-unroll
-    passes ingest: same count, same priorities, same stored transitions."""
+    passes ingest: same count, same priorities, same stored transitions
+    (priorities within `_APEX_INGEST_RTOL` — see its comment)."""
     cfg = ApexConfig(obs_shape=(4,), num_actions=2)
     agent = ApexAgent(cfg)
     weights = WeightStore()
@@ -139,7 +152,7 @@ def test_apex_ingest_many_matches_per_unroll():
 
     snap_a, snap_b = a.replay.snapshot(), b.replay.snapshot()
     np.testing.assert_allclose(snap_a["priorities"], snap_b["priorities"],
-                               rtol=1e-6)
+                               rtol=_APEX_INGEST_RTOL)
     for ia, ib in zip(_snapshot_items(snap_a), _snapshot_items(snap_b)):
         np.testing.assert_array_equal(ia.state, ib.state)
         np.testing.assert_array_equal(ia.action, ib.action)
@@ -158,7 +171,7 @@ def test_apex_ingest_many_matches_per_unroll():
     assert c._pending_ingest is None  # zero return implies fully flushed
     snap_c = c.replay.snapshot()
     np.testing.assert_allclose(snap_a["priorities"], snap_c["priorities"],
-                               rtol=1e-6)
+                               rtol=_APEX_INGEST_RTOL)
     for ia, ic in zip(_snapshot_items(snap_a), _snapshot_items(snap_c)):
         np.testing.assert_array_equal(ia.state, ic.state)
         np.testing.assert_array_equal(ia.action, ic.action)
