@@ -71,7 +71,8 @@ def _probe_backend(timeout: float) -> tuple[str | None, str | None]:
 # regression must survive into the compact line the driver reads).
 _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
                  "watchdog", "chunk_regressions", "transport_verdict",
-                 "codec_verdict", "weights_verdict", "replay_verdict")
+                 "codec_verdict", "weights_verdict", "replay_verdict",
+                 "inference_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -1954,6 +1955,270 @@ def bench_replay_compare(n_unrolls: int = 192, unrolls_per_put: int = 8,
     return out
 
 
+# Child processes for bench_inference_compare. The REPLICA child is one
+# act-serving process of the inference tier (runtime/serving.py): it
+# pulls weights from the parent's transport server, warms the bucketed
+# act shapes, and serves OP_ACT with continuous batching + admission
+# control. The CLIENT child is one member of the synthetic swarm: it
+# hammers acts through the SAME RemoteActService selection path the
+# deployed remote-act actor uses (jax-free import footprint), so both
+# variants measure the production client code.
+_INFER_REPLICA_CHILD = r"""
+import sys, time
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+from distributed_reinforcement_learning_tpu.runtime.serving import ContinuousInferenceServer
+from distributed_reinforcement_learning_tpu.runtime.transport import (
+    RemoteWeights, TransportClient, TransportServer)
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+(host, lport, port, obs_dim, num_actions, lstm, rows, max_batch, seed) = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]), int(sys.argv[7]), int(sys.argv[8]),
+    int(sys.argv[9]))
+agent = ImpalaAgent(ImpalaConfig(obs_shape=(obs_dim,), num_actions=num_actions,
+                                 trajectory=8, lstm_size=lstm))
+client = TransportClient(host, lport)
+src = RemoteWeights(client)
+local = WeightStore()
+version = -1
+while True:
+    got = src.get_if_newer(version)
+    if got is not None:
+        local.publish(got[0], got[1])
+        version = got[1]
+        break
+    time.sleep(0.05)
+infer = ContinuousInferenceServer.for_agent(
+    "impala", agent, local, max_batch=max_batch,
+    admission_rows=4 * max_batch, seed=seed)
+
+def req(n):
+    return {"obs": np.zeros((n, obs_dim), np.float32),
+            "prev_action": np.zeros(n, np.int32),
+            "h": np.zeros((n, lstm), np.float32),
+            "c": np.zeros((n, lstm), np.float32)}
+
+n = rows
+while n <= max_batch:  # warm every bucket the swarm can coalesce into
+    infer.submit(req(n))
+    n *= 2
+server = TransportServer(None, local, host="127.0.0.1", port=port,
+                         inference=infer).start()
+print("REPLICA_READY", flush=True)
+sys.stdin.readline()  # parent closes stdin to stop
+server.stop()
+infer.stop()
+client.close()
+"""
+
+_INFER_CLIENT_CHILD = r"""
+import json, sys, time
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.runtime.transport import (
+    RemoteActService, TransportClient)
+
+(endpoints, fb_addr, rows, n_req, obs_dim, lstm, warmup) = (
+    json.loads(sys.argv[1]), sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]), int(sys.argv[7]))
+fb_host, _, fb_port = fb_addr.rpartition(":")
+fallback = TransportClient(fb_host, int(fb_port))
+svc = RemoteActService.from_addrs(endpoints, fallback=fallback)
+rng = np.random.RandomState(0)
+req = {"obs": rng.rand(rows, obs_dim).astype(np.float32),
+       "prev_action": np.zeros(rows, np.int32),
+       "h": np.zeros((rows, lstm), np.float32),
+       "c": np.zeros((rows, lstm), np.float32)}
+for _ in range(warmup):  # connection setup + any residual compile, untimed
+    svc(req)
+lat = []
+t0 = time.perf_counter()
+for _ in range(n_req):
+    t = time.perf_counter()
+    out = svc(req)
+    lat.append((time.perf_counter() - t) * 1e3)
+wall = time.perf_counter() - t0
+assert out["action"].shape == (rows,)
+stats = svc.snapshot_stats()
+svc.close()
+fallback.close()
+print("INFER_CLIENT=" + json.dumps(
+    {"act_ms": lat, "actions_per_s": rows * n_req / wall, "stats": stats}))
+"""
+
+
+def bench_inference_compare(cfg, n_clients: int = 4, requests: int = 64,
+                            rows: int = 16, replicas: int = 2,
+                            max_batch: int = 64) -> dict:
+    """Client-swarm A/B of the ACT path under synthetic heavy traffic:
+    the learner-hosted inference service (one InferenceServer thread
+    inside the learner process — the pre-tier deployed path) vs N
+    dedicated act-serving REPLICA processes (runtime/serving.py:
+    continuous batching, admission control, own ports). `n_clients`
+    REAL child processes hammer `requests` act round trips of `rows`
+    rows each through the production RemoteActService selection path;
+    reported are act-latency p50/p99 and summed actions/s.
+
+    The verdict follows the repo's adjudication bar (Pallas-LSTM rule):
+    replicas ship as the --remote_act default ONLY if the A/B shows
+    >= 1.2x actions/s; the committed `benchmarks/inference_verdict.json`
+    carries the decision `runtime/serving.replica_count()` (and the
+    local-cluster launcher's inlined gate) consults. Host-only,
+    link-independent.
+    """
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent
+    from distributed_reinforcement_learning_tpu.runtime.inference import InferenceServer
+    from distributed_reinforcement_learning_tpu.runtime.transport import TransportServer
+    from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+    import jax
+
+    if len(cfg.obs_shape) != 1:
+        # Serving-path A/B, not a model benchmark: a vector policy keeps
+        # the act itself cheap so the measurement weighs batching, wire,
+        # and scheduling — the things the tier changes (main passes a
+        # dedicated vector config, not the Atari conv section).
+        raise ValueError(f"inference_compare wants a vector obs_shape, "
+                         f"got {cfg.obs_shape}")
+    obs_dim = int(cfg.obs_shape[0])
+    agent = ImpalaAgent(cfg)
+    weights = WeightStore()
+    weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+    # The learner-hosted service: classic batcher, deployed semantics
+    # (no admission budget — submits queue unboundedly, which is exactly
+    # the behavior the tier's admission control exists to replace).
+    inference = InferenceServer.for_agent("impala", agent, weights,
+                                          max_batch=max_batch, seed=7)
+
+    def req(n):
+        return {"obs": np.zeros((n, obs_dim), np.float32),
+                "prev_action": np.zeros(n, np.int32),
+                "h": np.zeros((n, cfg.lstm_size), np.float32),
+                "c": np.zeros((n, cfg.lstm_size), np.float32)}
+
+    n = rows
+    while n <= max_batch:  # warm the buckets the swarm can coalesce into
+        inference.submit(req(n))
+        n *= 2
+    lport = _free_port()
+    server = TransportServer(None, weights, host="127.0.0.1", port=lport,
+                             inference=inference).start()
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def pctl(sorted_ms, q):
+        return round(sorted_ms[min(int(q * (len(sorted_ms) - 1) + 0.5),
+                                   len(sorted_ms) - 1)], 3)
+
+    def run_swarm(endpoints: list[str]) -> dict:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _INFER_CLIENT_CHILD,
+             json.dumps(endpoints), f"127.0.0.1:{lport}", str(rows),
+             str(requests), str(obs_dim), str(cfg.lstm_size), "4"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True) for _ in range(n_clients)]
+        results = []
+        for proc in procs:
+            out_s, err_s = proc.communicate(timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"inference_compare client rc={proc.returncode}: "
+                    f"{err_s.strip()[-500:]}")
+            line = next(ln for ln in out_s.splitlines()
+                        if ln.startswith("INFER_CLIENT="))
+            results.append(json.loads(line.split("=", 1)[1]))
+        act_ms = sorted(ms for r in results for ms in r["act_ms"])
+        agg: dict = {}
+        for r in results:
+            for k, v in r["stats"].items():
+                agg[k] = agg.get(k, 0) + v
+        return {
+            "actions_per_s": round(sum(r["actions_per_s"] for r in results), 1),
+            "act_ms_p50": pctl(act_ms, 0.50),
+            "act_ms_p99": pctl(act_ms, 0.99),
+            "client_stats": agg,
+        }
+
+    out: dict = {
+        "n_clients": n_clients, "requests_per_client": requests,
+        "rows_per_request": rows, "replicas": replicas,
+        "max_batch": max_batch,
+        "note": ("real multi-process client swarm through the deployed "
+                 "RemoteActService path both sides; learner-hosted = the "
+                 "in-process InferenceServer behind the learner's "
+                 "transport port, replicas = N serving.py processes "
+                 "(continuous batching + admission) pulling weights from "
+                 "the same store")}
+    rep_procs: list = []
+    try:
+        out["learner_hosted"] = run_swarm([])
+
+        ports = [_free_port() for _ in range(replicas)]
+        rep_procs = [subprocess.Popen(
+            [sys.executable, "-c", _INFER_REPLICA_CHILD, "127.0.0.1",
+             str(lport), str(port), str(obs_dim), str(cfg.num_actions),
+             str(cfg.lstm_size), str(rows), str(max_batch), str(1000 + i)],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+            for i, port in enumerate(ports)]
+        for proc in rep_procs:
+            line = proc.stdout.readline()
+            if "REPLICA_READY" not in line:
+                err = proc.stderr.read() if proc.poll() is not None else ""
+                raise RuntimeError(
+                    f"inference replica failed to start: {err.strip()[-500:]}")
+        out["replica_tier"] = run_swarm([f"127.0.0.1:{p}" for p in ports])
+        stats = out["replica_tier"]["client_stats"]
+        # Refuse to record a "replica" number that silently measured the
+        # learner: a demoted replica or fallback acts would poison the
+        # adjudication artifact with a mislabeled ratio.
+        if stats.get("replica_demotes", 0) or stats.get("fallback_acts", 0):
+            raise RuntimeError(
+                f"replica variant leaked acts off the tier "
+                f"(demotes={stats.get('replica_demotes', 0)}, "
+                f"fallback_acts={stats.get('fallback_acts', 0)}): the "
+                f"measurement is not a replica number; rerun on a quiet host")
+    finally:
+        for proc in rep_procs:
+            try:
+                proc.stdin.close()  # READY loop exits
+            except OSError:
+                pass
+        for proc in rep_procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        server.stop()
+        inference.stop()
+        weights.close()
+    ratio = (out["replica_tier"]["actions_per_s"]
+             / max(out["learner_hosted"]["actions_per_s"], 1e-9))
+    p50_ratio = (out["learner_hosted"]["act_ms_p50"]
+                 / max(out["replica_tier"]["act_ms_p50"], 1e-9))
+    out["replicas_vs_learner"] = round(ratio, 2)
+    out["act_p50_speedup"] = round(p50_ratio, 2)
+    out["auto_enable"] = ratio >= 1.2  # the repo's adjudication bar
+    out["verdict"] = (f"inference replicas {ratio:.2f}x learner-hosted "
+                      f"actions/s (act p50 {p50_ratio:.2f}x): "
+                      + ("auto-on" if out["auto_enable"] else "opt-in"))
+    print(f"[bench] inference_compare: learner "
+          f"{out['learner_hosted']['actions_per_s']:,.0f} act/s vs "
+          f"{replicas} replicas "
+          f"{out['replica_tier']['actions_per_s']:,.0f} act/s "
+          f"-> {out['verdict']}", file=sys.stderr)
+    return out
+
+
 def bench_r2d2_learn(B: int, iters: int) -> dict:
     """R2D2 learn-step throughput (env-frames/s) at the reference replay
     shape — the training hot path that runs the fused Pallas LSTM
@@ -2816,6 +3081,20 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["replay_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] replay_compare failed: {e}", file=sys.stderr)
+
+    # Multi-process act-path client-swarm A/B (the auto-enable
+    # adjudication for the inference serving tier, runtime/serving.py).
+    if os.environ.get("BENCH_INFER", "1") == "1" and _ok("inference_compare", 150):
+        try:
+            r = bench_inference_compare(
+                ImpalaConfig(obs_shape=(128,), num_actions=8, trajectory=8,
+                             lstm_size=128))
+            extra["inference_compare"] = r
+            if "verdict" in r:
+                extra["inference_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["inference_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] inference_compare failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_KERNELS", "1") == "1" and _ok("kernel_compare", 240):
         try:

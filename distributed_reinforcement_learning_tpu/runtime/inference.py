@@ -37,11 +37,25 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable
 
 import numpy as np
 
 import jax
+
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+
+
+class InferenceBusy(RuntimeError):
+    """Admission-control reject: the service is alive but its bounded
+    pending-rows budget is full. `retryable = True` is duck-typed by the
+    transport server (a jax-free module that must not import this one)
+    to map the reject to an ST_BUSY reply, which the client retries with
+    jitter / fails over to another replica (runtime/serving.py) instead
+    of queueing unboundedly on a saturated service."""
+
+    retryable = True
 
 
 def _bucket(n: int) -> int:
@@ -118,6 +132,7 @@ class InferenceServer:
         "_pending": ("_lock", "_batch_ready"),
         "_pending_rows": ("_lock", "_batch_ready"),
         "_stop": ("_lock", "_batch_ready"),
+        "_admission_rejects": ("_lock", "_batch_ready"),
     }
 
     def __init__(
@@ -127,11 +142,21 @@ class InferenceServer:
         max_batch: int = 256,
         max_wait_ms: float = 2.0,
         seed: int = 0,
+        admission_rows: int | None = None,
     ):
         self.act_fn = act_fn
         self.weights = weights
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1e3
+        # Admission control (the serving tier's backpressure): None keeps
+        # the learner-hosted blocking semantics — submits queue without
+        # bound, exactly the pre-replica behavior existing topologies
+        # rely on. An integer bounds the pending-row budget: a submit
+        # that would exceed it raises InferenceBusy, which the transport
+        # maps to ST_BUSY (retryable) instead of letting thousands of
+        # env connections pile unbounded latency onto a saturated
+        # service.
+        self.admission_rows = admission_rows
         self._rng = jax.random.PRNGKey(seed)
         # Device-resident params cache keyed by the published version: the
         # store holds host numpy (its actors pull over the wire), and
@@ -142,13 +167,16 @@ class InferenceServer:
         self._cached_version: int | None = None
         self._lock = threading.Lock()
         self._batch_ready = threading.Condition(self._lock)
-        self._pending: list[dict] = []
+        # deque: popped once per request per batch on the hot serving
+        # path — list.pop(0) was O(n) per pop, O(n^2) per drained burst.
+        self._pending: deque[dict] = deque()
         self._pending_rows = 0
         self._stop = False
-        self._thread = threading.Thread(target=self._loop, daemon=True, name="inference")
-        self._thread.start()
+        self._admission_rejects = 0
         self.batches_run = 0
         self.rows_served = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="inference")
+        self._thread.start()
 
     @classmethod
     def for_agent(cls, algo: str, agent, weights, **kwargs) -> "InferenceServer":
@@ -161,6 +189,13 @@ class InferenceServer:
         actor fails alone (its connection gets ST_ERROR) instead of
         poisoning the whole batch it would have joined — and so row-count
         mismatches can never misalign the scatter back to other actors.
+
+        A request wider than `max_batch` is split into max_batch-row
+        chunks (the module docstring's oversubscription contract): each
+        chunk joins a normal bounded batch, so XLA only ever compiles
+        the bucketed shapes — one giant actor can no longer force a
+        fresh compile past the bucket range. The chunks' outputs are
+        re-concatenated before returning.
         """
         request = {k: np.asarray(v) for k, v in request.items()}
         if not request:
@@ -174,18 +209,44 @@ class InferenceServer:
         if len(set(ns.values())) != 1:
             raise RuntimeError(f"inference request row counts disagree: {ns}")
         n = next(iter(request.values())).shape[0]
-        req = {"rows": request, "n": n, "event": threading.Event(),
-               "out": None, "error": None}
+        reqs = []
+        for lo in range(0, max(n, 1), self.max_batch):
+            hi = min(lo + self.max_batch, n)
+            rows = request if n <= self.max_batch else {
+                k: v[lo:hi] for k, v in request.items()}
+            reqs.append({"rows": rows, "n": hi - lo, "event": threading.Event(),
+                         "out": None, "error": None, "t": time.monotonic()})
         with self._batch_ready:
             if self._stop:
                 raise RuntimeError("inference server stopped")
-            self._pending.append(req)
+            # Admission is judged on the WHOLE request (all chunks land
+            # atomically or not at all — a half-admitted request would
+            # serve half its rows and busy-reject the rest).
+            if (self.admission_rows is not None
+                    and self._pending_rows + n > self.admission_rows
+                    and self._pending_rows > 0):
+                self._admission_rejects += 1
+                raise InferenceBusy(
+                    f"admission budget full: {self._pending_rows} pending "
+                    f"+ {n} requested > {self.admission_rows} rows")
+            self._pending.extend(reqs)
             self._pending_rows += n
             self._batch_ready.notify()
-        req["event"].wait()
-        if req["error"] is not None:
-            raise RuntimeError("inference batch failed") from req["error"]
-        return req["out"]
+        for req in reqs:
+            req["event"].wait()
+        for req in reqs:
+            if req["error"] is not None:
+                raise RuntimeError("inference batch failed") from req["error"]
+        if len(reqs) == 1:
+            return reqs[0]["out"]
+        return {k: np.concatenate([r["out"][k] for r in reqs])
+                for k in reqs[0]["out"]}
+
+    def admission_reject_count(self) -> int:
+        """Cumulative admission rejects, read under the lock (polled by
+        the telemetry providers the replica host registers)."""
+        with self._batch_ready:
+            return self._admission_rejects
 
     def _take_batch(self) -> list[dict]:
         """Wait for work: return pending requests when max_batch rows are
@@ -206,7 +267,7 @@ class InferenceServer:
                         if batch and rows + k > self.max_batch:
                             break
                         rows += k
-                        batch.append(self._pending.pop(0))
+                        batch.append(self._pending.popleft())
                     self._pending_rows -= rows
                     return batch
                 # Idle (nothing pending): sleep until a submit notifies —
@@ -229,11 +290,21 @@ class InferenceServer:
                     r["error"] = e
                     r["event"].set()
 
-    def _run(self, reqs: list[dict]) -> None:
+    def _dispatch(self, reqs: list[dict]) -> tuple[dict, int]:
+        """Merge, pad, and dispatch one batch -> (device outputs, n).
+
+        Split from the scatter so the continuous batcher
+        (runtime/serving.py) can assemble+dispatch batch k+1 while batch
+        k's jitted act is still in flight; this classic server calls
+        both back-to-back. Only the single batcher thread runs this
+        (`_rng` / device-cache discipline in the class comment)."""
         params, version = self.weights.get()
         if params is None:
             raise RuntimeError("no weights published yet")
         if version != self._cached_version:
+            # Versions are snapshot IDENTITIES, not an ordering (compare
+            # !=): a rollback republish at a restored checkpoint step
+            # must land here even though its version went backward.
             self._device_params = jax.device_put(params)
             self._cached_version = version
         keys = reqs[0]["rows"].keys()
@@ -247,7 +318,24 @@ class InferenceServer:
             rows = {k: np.concatenate([v, np.repeat(v[:1], pad, axis=0)])
                     for k, v in rows.items()}
         self._rng, sub = jax.random.split(self._rng)
-        out = {k: np.asarray(v)[:n] for k, v in self.act_fn(self._device_params, rows, sub).items()}
+        out = self.act_fn(self._device_params, rows, sub)
+        if _OBS.enabled:
+            # Batch occupancy (real rows / compiled bucket) and per-
+            # request queue wait — the obs_report "Inference serving"
+            # signals admission tuning reads.
+            now = time.monotonic()
+            _OBS.gauge("inference/batch_occupancy", n / b)
+            _OBS.gauge("inference/batch_rows", n)
+            for r in reqs:
+                _OBS.gauge("inference/queue_wait_ms",
+                           (now - r.get("t", now)) * 1e3)
+        return out, n
+
+    def _scatter(self, reqs: list[dict], out: dict, n: int) -> None:
+        """Deliver host-materialized `[:n]` outputs back to the waiting
+        submitters. In this classic server the batcher thread runs it;
+        the continuous batcher runs it on its completion thread (still a
+        single writer for the cumulative counters)."""
         row = 0
         for r in reqs:
             sl = slice(row, row + r["n"])
@@ -256,6 +344,10 @@ class InferenceServer:
             r["event"].set()
         self.batches_run += 1
         self.rows_served += n
+
+    def _run(self, reqs: list[dict]) -> None:
+        out, n = self._dispatch(reqs)
+        self._scatter(reqs, {k: np.asarray(v)[:n] for k, v in out.items()}, n)
 
     def stop(self) -> None:
         with self._batch_ready:
@@ -266,7 +358,7 @@ class InferenceServer:
         # the lock: a submitter that saw _stop unset could still be
         # appending while this runs.
         with self._batch_ready:
-            pending, self._pending = self._pending, []
+            pending, self._pending = self._pending, deque()
         for r in pending:
             r["error"] = RuntimeError("inference server stopped")
             r["event"].set()

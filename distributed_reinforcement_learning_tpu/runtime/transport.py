@@ -23,6 +23,7 @@ peer hung the cluster, SURVEY §5.3).
 from __future__ import annotations
 
 import os
+import random
 import socket
 import struct
 import threading
@@ -94,6 +95,46 @@ class InferenceUnavailableError(RuntimeError):
     loop swallows those as transient outages, but a misconfigured
     learner never recovers — this must fail fast with the real cause.
     """
+
+
+class InferenceBusyError(RuntimeError):
+    """OP_ACT answered ST_BUSY: the service's admission budget is full
+    (runtime/inference.InferenceBusy on the server side). Retryable —
+    the service is alive, just saturated. NOT a TransportError: a busy
+    replica must not be demoted as dead; RemoteActService fails the
+    request over to another replica (or retries with jitter), and
+    `remote_act(busy_retry=True)` absorbs it for single-endpoint
+    callers."""
+
+
+class RemoteActFailed(TransportError):
+    """OP_ACT answered ST_ERROR: the endpoint is ALIVE but this request
+    (or the batch it joined) failed application-side — a poisoned
+    co-batched request, an algorithm-mismatched row dict, weights not
+    published yet. Subclasses TransportError so single-endpoint callers
+    keep the old behavior (the actor's elastic-grace loop retries), but
+    stays distinguishable so RemoteActService does NOT demote the
+    healthy replica that reported it — one bad request must not latch
+    the whole tier dead."""
+
+
+class _BusyBackoff:
+    """The act paths' shared ST_BUSY wait: full jitter around an
+    exponential base (capped at 50 ms — rejected actors must spread
+    out, not re-arrive together), bounded by a deadline from the first
+    busy reply."""
+
+    def __init__(self, timeout: float, rng: random.Random):
+        self.timeout = timeout
+        self.deadline = time.monotonic() + timeout
+        self._delay = 2e-3
+        self._rng = rng
+
+    def sleep_or_raise(self, what: str) -> None:
+        if time.monotonic() >= self.deadline:
+            raise TransportError(f"{what} busy for >{self.timeout:.0f}s")
+        time.sleep(self._rng.uniform(0.5, 1.5) * self._delay)
+        self._delay = min(2 * self._delay, 0.05)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
@@ -219,6 +260,10 @@ class TransportServer(_LockedStatsMixin):
 
     def __init__(self, queue, weights, host: str = "0.0.0.0", port: int = 8000,
                  inference=None):
+        # queue=None: an act-serving endpoint with no trajectory ingest
+        # (an inference replica, runtime/serving.py) — PUT/QUEUE_SIZE
+        # ops answer ST_UNAVAILABLE so a misrouted actor fails fast
+        # instead of silently dropping unrolls.
         self.queue = queue
         self.weights = weights
         self.inference = inference  # optional InferenceServer for OP_ACT
@@ -237,7 +282,8 @@ class TransportServer(_LockedStatsMixin):
         # Lock-guarded: dict-item += is a load/add/store and the
         # per-connection serve threads would otherwise lose increments.
         self.stats = {"unrolls_accepted": 0, "busy_replies": 0,
-                      "partial_accepts": 0, "weight_sends": 0}
+                      "partial_accepts": 0, "weight_sends": 0,
+                      "acts_served": 0, "act_busy_replies": 0}
         self._stats_lock = threading.Lock()
 
     def start(self) -> "TransportServer":
@@ -275,7 +321,7 @@ class TransportServer(_LockedStatsMixin):
             # against a resize or report a half-applied +=.
             s = self.snapshot_stats()
             try:
-                depth = self.queue.size()
+                depth = self.queue.size() if self.queue is not None else 0
             except Exception:  # noqa: BLE001 — closed queue at shutdown
                 return
             print(f"[transport] depth={depth} "
@@ -474,7 +520,13 @@ class TransportServer(_LockedStatsMixin):
             except (TransportError, OSError):
                 return
             try:
-                if op == OP_PUT_TRAJ:
+                if self.queue is None and op in (OP_PUT_TRAJ, OP_PUT_TRAJ_N,
+                                                 OP_QUEUE_SIZE):
+                    # Queue-less endpoint (inference replica): trajectory
+                    # ops are permanently unserved here, same contract as
+                    # OP_ACT on a learner without --serve_inference.
+                    _send_msg(conn, ST_UNAVAILABLE)
+                elif op == OP_PUT_TRAJ:
                     # Replying only after acceptance is the actors'
                     # backpressure (reference: blocking enqueue op,
                     # buffer_queue.py:398-414).
@@ -514,15 +566,25 @@ class TransportServer(_LockedStatsMixin):
                     # Own RuntimeError handling: an inference failure (e.g.
                     # weights not published yet) must reply ST_ERROR, not
                     # fall into the queue-closed ST_CLOSED arm below and
-                    # kill the actor's connection.
+                    # kill the actor's connection. An admission reject
+                    # (InferenceBusy, duck-typed `retryable` so this
+                    # jax-free module needs no inference import) maps to
+                    # ST_BUSY: the client retries with jitter or fails
+                    # over to another replica instead of queueing
+                    # unboundedly on a saturated service.
                     if self.inference is None:
                         _send_msg(conn, ST_UNAVAILABLE)
                     else:
                         try:
                             out = self.inference.submit(codec.decode(payload, copy=True))
-                        except RuntimeError:
-                            _send_msg(conn, ST_ERROR)
+                        except RuntimeError as e:
+                            if getattr(e, "retryable", False):
+                                self._bump("act_busy_replies")
+                                _send_msg(conn, ST_BUSY)
+                            else:
+                                _send_msg(conn, ST_ERROR)
                         else:
+                            self._bump("acts_served")
                             _send_msg(conn, ST_OK, codec.encode(out))
                 elif op == OP_QUEUE_SIZE:
                     _send_msg(conn, ST_OK, _I64.pack(self.queue.size()))
@@ -560,6 +622,7 @@ class TransportClient(_LockedStatsMixin):
         connect_retries: int = 60,
         retry_interval: float = 1.0,
         busy_timeout: float = 90.0,
+        connect: bool = True,
     ):
         self.host, self.port = host, port
         self.connect_retries = connect_retries
@@ -570,9 +633,18 @@ class TransportClient(_LockedStatsMixin):
         # Per-actor observability (read by the actor loop's periodic stat
         # line; fairness evidence for the 20-actor topology demo).
         self.stats = {"unrolls_sent": 0, "busy_waits": 0,
-                      "partial_accepts": 0, "weight_pulls": 0}
+                      "partial_accepts": 0, "weight_pulls": 0,
+                      "acts": 0, "act_busy_waits": 0}
         self._stats_lock = threading.Lock()
-        self._connect_locked()  # __init__ happens-before any sharing
+        # Jittered act-busy backoff: deterministic seeds would march a
+        # fleet of rejected actors back in lockstep (the thundering herd
+        # ST_BUSY exists to break up).
+        self._jitter = random.Random()
+        if connect:  # __init__ happens-before any sharing
+            self._connect_locked()
+        # connect=False: lazy — _exchange connects on first use (the
+        # RemoteActService builds its endpoint set without serializing
+        # N blocking connects at actor startup).
 
     def _connect_locked(self) -> None:
         last: Exception | None = None
@@ -722,22 +794,43 @@ class TransportClient(_LockedStatsMixin):
         self._bump("weight_pulls")
         return codec.decode(resp[_I64.size :], copy=True), version
 
-    def remote_act(self, request: dict) -> dict:
+    def remote_act(self, request: dict, busy_retry: bool = True) -> dict:
         """SEED-style inference: ship observation rows, get action rows.
 
         Request/reply are the algorithm-specific row dicts of
-        `runtime/inference.py` — always computed with the learner's
+        `runtime/inference.py` — always computed with the service's
         newest published weights, so the actor never pulls params.
+
+        ST_BUSY (the service's admission budget is full) is retried
+        with exponential jittered backoff, bounded by `busy_timeout` —
+        the act-path analogue of put_trajectory's ST_BUSY loop. Pass
+        `busy_retry=False` to get InferenceBusyError instead, so a
+        multi-endpoint caller (RemoteActService) can fail the request
+        over to another replica rather than camping on this one.
         """
-        status, resp = self._exchange(OP_ACT, codec.encode(request), retry=True, resend=True)
-        if status == ST_UNAVAILABLE:
-            raise InferenceUnavailableError(
-                "learner does not serve inference (start it with --serve_inference)")
-        if status == ST_CLOSED:
-            raise TransportError("learner closed the data plane")
-        if status != ST_OK:
-            raise TransportError("remote act failed on the learner side")
-        return codec.decode(resp, copy=True)
+        blob = codec.encode(request)
+        backoff: _BusyBackoff | None = None
+        while True:
+            status, resp = self._exchange(OP_ACT, blob, retry=True, resend=True)
+            if status == ST_BUSY:
+                self._bump("act_busy_waits")
+                if not busy_retry:
+                    raise InferenceBusyError(
+                        "inference service admission budget full")
+                backoff = backoff or _BusyBackoff(self.busy_timeout,
+                                                  self._jitter)
+                backoff.sleep_or_raise("inference service")
+                continue
+            if status == ST_UNAVAILABLE:
+                raise InferenceUnavailableError(
+                    "endpoint does not serve inference "
+                    "(start the learner with --serve_inference)")
+            if status == ST_CLOSED:
+                raise TransportError("learner closed the data plane")
+            if status != ST_OK:
+                raise RemoteActFailed("remote act failed on the serving side")
+            self._bump("acts")
+            return codec.decode(resp, copy=True)
 
     def queue_size(self) -> int:
         return _I64.unpack(self._call(OP_QUEUE_SIZE))[0]
@@ -801,6 +894,214 @@ class RemoteInference:
         return self._client.remote_act(request)
 
 
+class RemoteActService(_LockedStatsMixin):
+    """Actor-side act surface over a REPLICATED inference tier
+    (runtime/serving.py): N replica endpoints plus the learner's
+    in-process service as the fallback of last resort.
+
+    Selection per request: round-robin with a least-pending bias (the
+    live endpoint with the fewest in-flight requests wins; the rotating
+    cursor breaks ties so equal-pending replicas share load). Failure
+    handling per the tier's contract:
+
+    - ST_BUSY (admission reject): fail over IMMEDIATELY to a live
+      replica that has not rejected this round; only when every live
+      replica has rejected does the request back off with jitter
+      (bounded by `busy_timeout`) before starting a fresh round.
+    - A dead replica (TransportError/OSError after the client's own
+      bounded reconnect) is demoted PERMANENTLY — same one-way latch as
+      the ring/board demotions; replicas are cattle, a flapping one
+      must not absorb retries forever.
+    - With every replica demoted, requests fall back to the learner
+      client, so pre-replica topologies (and a fully-dead tier) keep
+      working exactly as before; learner failures propagate as
+      TransportError for the actor's elastic-grace loop to own.
+
+    Concurrency map (tools/drlint lock-discipline): `_sel_lock` covers
+    the selection state (pending counts, demote latches, cursor) that
+    concurrent actor threads race on; `stats` follows the shared
+    _LockedStatsMixin contract (bumped on call paths, polled by the
+    telemetry flush thread). The endpoint list itself is immutable
+    after construction.
+    """
+
+    _GUARDED_BY = {
+        "stats": "_stats_lock",
+        "_pending": "_sel_lock",
+        "_dead": "_sel_lock",
+        "_rr": "_sel_lock",
+    }
+
+    def __init__(self, endpoints: list[TransportClient],
+                 fallback: TransportClient | None = None,
+                 busy_timeout: float = 90.0):
+        self._endpoints = list(endpoints)
+        self._fallback = fallback
+        self.busy_timeout = busy_timeout
+        self._sel_lock = threading.Lock()
+        self._pending = [0] * len(self._endpoints)
+        self._dead = [False] * len(self._endpoints)
+        self._rr = 0
+        self.stats = {"acts": 0, "busy_failovers": 0, "replica_demotes": 0,
+                      "fallback_acts": 0}
+        self._stats_lock = threading.Lock()
+        self._jitter = random.Random()
+
+    @classmethod
+    def from_addrs(cls, addrs: list[str],
+                   fallback: TransportClient | None = None,
+                   connect_retries: int = 60, **kwargs) -> "RemoteActService":
+        """Build from "host:port" strings. Endpoints connect LAZILY (on
+        their first selected act), so actor startup never serializes N
+        blocking connects; a replica that stays unreachable past the
+        bounded retries demotes permanently through the normal failure
+        path and the service works on through the survivors/fallback.
+
+        The default retry budget is deliberately the client's generous
+        60 x 1 s: a replica binds its port only after the LEARNER's
+        first weight publish, so at topology start the first act may
+        legitimately race a learner still initializing — a short budget
+        would permanently demote a healthy tier. The cost is a one-time
+        bounded stall on a replica that really is dead, after which the
+        demote latch makes every later act skip it."""
+        clients = []
+        for addr in addrs:
+            host, _, p = addr.rpartition(":")
+            clients.append(TransportClient(host, int(p), connect=False,
+                                           connect_retries=connect_retries))
+        return cls(clients, fallback=fallback, **kwargs)
+
+    def _pick(self, skip: set | frozenset = frozenset()) -> int | None:
+        """Acquire a slot on the live endpoint with the fewest in-flight
+        requests (rotating cursor breaks ties); None = every live
+        endpoint is demoted or in `skip` (the caller's set of endpoints
+        that already busy-rejected this round)."""
+        with self._sel_lock:
+            n = len(self._endpoints)
+            best: int | None = None
+            for off in range(n):
+                i = (self._rr + off) % n
+                if self._dead[i] or i in skip:
+                    continue
+                if best is None or self._pending[i] < self._pending[best]:
+                    best = i
+            if best is None:
+                return None
+            self._rr += 1
+            self._pending[best] += 1
+            return best
+
+    def _release(self, i: int) -> None:
+        with self._sel_lock:
+            self._pending[i] -= 1
+
+    def _demote(self, i: int) -> None:
+        import sys
+
+        with self._sel_lock:
+            was_dead, self._dead[i] = self._dead[i], True
+        if not was_dead:
+            self._bump("replica_demotes")
+            print(f"[remote_act] WARNING: inference replica "
+                  f"{self._endpoints[i].host}:{self._endpoints[i].port} "
+                  f"demoted (dead)", file=sys.stderr)
+            try:
+                self._endpoints[i].close()
+            except OSError:
+                pass
+
+    def __call__(self, request: dict) -> dict:
+        backoff: _BusyBackoff | None = None
+        busy_round: set[int] = set()
+        while True:
+            i = self._pick(skip=busy_round)
+            if i is None:
+                if busy_round and self.live_endpoints() > 0:
+                    # EVERY live replica busy-rejected this round: only
+                    # now back off with jitter, then start a fresh round
+                    # — a request rejected by one saturated replica must
+                    # fail over to an idle sibling immediately, not
+                    # sleep first.
+                    backoff = backoff or _BusyBackoff(self.busy_timeout,
+                                                      self._jitter)
+                    backoff.sleep_or_raise("inference tier")
+                    busy_round.clear()
+                    continue
+                # Tier fully demoted (or built with no replicas): the
+                # learner's in-process service keeps the topology alive.
+                if self._fallback is None:
+                    raise TransportError("no live inference replicas "
+                                         "and no learner fallback")
+                self._bump("fallback_acts")
+                out = self._fallback.remote_act(request)
+                self._bump("acts")
+                return out
+            try:
+                out = self._endpoints[i].remote_act(request, busy_retry=False)
+            except InferenceBusyError:
+                # Saturated, not dead: mark it for this round and
+                # re-select — the skip set sends the retry straight to
+                # a sibling that has not rejected yet.
+                self._bump("busy_failovers")
+                busy_round.add(i)
+            except RemoteActFailed:
+                # The replica is ALIVE but this request (or the batch
+                # it joined) failed application-side. Propagate like
+                # the single-endpoint path always has — the actor's
+                # elastic loop owns the retry — and do NOT demote: one
+                # poisoned co-batched request latching healthy
+                # replicas dead would let a single bad actor take the
+                # whole tier down.
+                raise
+            except (InferenceUnavailableError, TransportError, OSError):
+                # Dead or misrouted replica: permanent demote, then
+                # retry on a survivor. remote_act is resend-safe
+                # (acting twice on the same rows is just a fresh
+                # sample), so failing the request over cannot corrupt
+                # anything — no request is lost with a survivor up.
+                self._demote(i)
+            else:
+                self._bump("acts")
+                return out
+            finally:
+                self._release(i)
+
+    def live_endpoints(self) -> int:
+        with self._sel_lock:
+            return sum(not d for d in self._dead)
+
+    def close(self) -> None:
+        """Close the replica clients this service owns (the fallback
+        client belongs to the caller)."""
+        with self._sel_lock:
+            dead = list(self._dead)
+        for i, client in enumerate(self._endpoints):
+            if not dead[i]:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+
+
+def resolve_learner_addr(rt) -> tuple[str, int]:
+    """The non-learner roles' learner addressing contract, single
+    source (actors in run_role, inference replicas in
+    runtime/serving.py):
+
+      DRL_LEARNER_ADDR=host:port — full address (learners on different
+        machines, the normal TPU-pod layout);
+      DRL_LEARNER_INDEX=k — port offset against the config's
+        server_ip/server_port (learner processes co-hosted: tests,
+        single-host multi-chip).
+    """
+    addr = os.environ.get("DRL_LEARNER_ADDR")
+    if addr:
+        host, _, p = addr.rpartition(":")
+        return host, int(p)
+    return rt.server_ip, rt.server_port + int(
+        os.environ.get("DRL_LEARNER_INDEX", "0"))
+
+
 def _make_queue(capacity: int):
     from distributed_reinforcement_learning_tpu.data.native import native_available
 
@@ -828,8 +1129,16 @@ def run_role(
     serve_inference: bool = False,
     remote_act: bool = False,
 ) -> None:
-    """One process of the reference topology: `--mode learner` or
-    `--mode actor --task k` (reference role flags, `train_impala.py:16-20`)."""
+    """One process of the reference topology: `--mode learner`,
+    `--mode actor --task k` (reference role flags, `train_impala.py:16-20`),
+    or `--mode inference --task k` (an act-serving replica of the
+    inference tier, runtime/serving.py)."""
+    if mode == "inference":
+        from distributed_reinforcement_learning_tpu.runtime import serving
+
+        serving.run_replica(algo, config_path, section, task=task, seed=seed,
+                            run_dir=run_dir, grace=actor_grace)
+        return
     import jax
 
     from distributed_reinforcement_learning_tpu.runtime import launch
@@ -1035,6 +1344,16 @@ def run_role(
                 # Per-shard fill / priority-mass / ingest counters — the
                 # obs_report "Replay shards" section.
                 replay_shard.register_telemetry(replay_service)
+            if inference is not None:
+                # Learner-hosted act service counters (the obs_report
+                # "Inference serving" section reads the same names a
+                # replica process registers).
+                _OBS.sample("inference/rows_served",
+                            lambda: inference.rows_served, kind="counter")
+                _OBS.sample("inference/batches_run",
+                            lambda: inference.batches_run, kind="counter")
+                _OBS.sample("inference/admission_rejects",
+                            inference.admission_reject_count, kind="counter")
         print(f"[learner] serving on :{serve_port}; training {num_updates} updates")
         try:
             _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
@@ -1062,19 +1381,8 @@ def run_role(
             raise ValueError("actor mode needs --task k")
         # Multi-learner topology: each learner process needs its local
         # batch share fed, so launch scripts partition actors across the
-        # learners. Addressing:
-        #   DRL_LEARNER_ADDR=host:port  — full address (learners on
-        #     different machines, the normal TPU-pod layout);
-        #   DRL_LEARNER_INDEX=k        — port offset against the config's
-        #     server_ip/server_port (learner processes co-hosted: tests,
-        #     single-host multi-chip).
-        addr = os.environ.get("DRL_LEARNER_ADDR")
-        if addr:
-            host, _, p = addr.rpartition(":")
-            server_ip, port = host, int(p)
-        else:
-            server_ip = rt.server_ip
-            port = rt.server_port + int(os.environ.get("DRL_LEARNER_INDEX", "0"))
+        # learners (addressing contract: resolve_learner_addr).
+        server_ip, port = resolve_learner_addr(rt)
         client = TransportClient(server_ip, port)
         # Zero-copy data plane for co-hosted actors: when the launcher
         # named a ring for this task, trajectory PUTs become one memcpy
@@ -1102,10 +1410,25 @@ def run_role(
             if bw is not None:
                 actor_weights = bw
                 print(f"[actor {task}] shm weight board attached: {board_name}")
+        # Remote acting: with DRL_INFER_ADDRS (the launcher's replica
+        # tier) acts go through RemoteActService — round-robin/least-
+        # pending over the replicas, permanent demote of dead ones, the
+        # learner's in-process service as fallback. Without it, the
+        # single-endpoint learner service (pre-replica topologies).
+        remote: Any = None
+        if remote_act:
+            infer_addrs = [a for a in
+                           os.environ.get("DRL_INFER_ADDRS", "").split(",") if a]
+            if infer_addrs:
+                remote = RemoteActService.from_addrs(infer_addrs, fallback=client)
+                print(f"[actor {task}] remote act via "
+                      f"{len(infer_addrs)} inference replica(s)")
+            else:
+                remote = RemoteInference(client)
         actor = launch.make_actor(
             algo, agent_cfg, rt, task, actor_queue, actor_weights,
             seed=seed + 1 + task,
-            remote_act=RemoteInference(client) if remote_act else None,
+            remote_act=remote,
         )
         # Per-actor telemetry shard (observability/): this is the half of
         # the topology the old MetricsLogger never covered (actors log
@@ -1124,6 +1447,11 @@ def run_role(
                 for key in actor_weights.snapshot_stats():
                     _OBS.sample(f"board/{key}",
                                 lambda k=key: actor_weights.stat(k),
+                                kind="counter")
+            if hasattr(remote, "snapshot_stats"):  # RemoteActService only
+                for key in remote.snapshot_stats():
+                    _OBS.sample(f"remote_act/{key}",
+                                lambda k=key: remote.stat(k),
                                 kind="counter")
             # Actor-side codec counters: schema-cache hit rate on the
             # encode path and dedup bytes saved (the wire-byte cut the
@@ -1180,6 +1508,8 @@ def run_role(
                 actor_queue.close()
             if hasattr(actor_weights, "close"):  # BoardWeights: ditto
                 actor_weights.close()
+            if hasattr(remote, "close"):  # RemoteActService: replica clients
+                remote.close()
             client.close()
             _OBS.close()  # final shard flush + trace terminator
     else:

@@ -66,6 +66,17 @@ def main() -> None:
                    help="force a jax platform for the LEARNER (actors are cpu)")
     p.add_argument("--serve_inference", action="store_true")
     p.add_argument("--remote_act", action="store_true")
+    p.add_argument("--inference_replicas", type=int, default=None,
+                   help="remote-act topologies: N dedicated act-serving "
+                        "replica processes (runtime/serving.py) between "
+                        "the actors and the learner — each attaches to "
+                        "the learner's weight plane (shm board / TCP "
+                        "fallback) and serves OP_ACT on its own port "
+                        "with continuous batching + admission control "
+                        "(DRL_INFER_REPLICAS; 0 forces learner-hosted "
+                        "acts). Unset defers to the committed "
+                        "benchmarks/inference_verdict.json adjudication; "
+                        "see docs/performance.md 'Inference serving'")
     p.add_argument("--replay_shards", type=int, default=None,
                    help="prioritized-replay learners (apex/r2d2/xformer): "
                         "N>=1 shards replay across the learner's ingest "
@@ -192,6 +203,40 @@ def main() -> None:
                        for pid in range(args.learners)}
         print(f"[cluster] shm weight board(s) enabled for {args.actors} "
               f"co-hosted actor(s)", file=sys.stderr)
+
+    # Inference tier sizing: --inference_replicas forces, else the env /
+    # committed inference_compare adjudication decides (INLINED like
+    # shm_gate — the canonical resolution is runtime/serving.py's
+    # replica_count, but importing the package pulls jax into the
+    # launcher parent). Replicas only make sense for remote-act actors.
+    def infer_replicas() -> int:
+        if args.inference_replicas is not None:
+            return max(0, args.inference_replicas)
+        if not args.remote_act:
+            return 0
+        env_n = os.environ.get("DRL_INFER_REPLICAS", "").strip()
+        if env_n:
+            try:
+                return max(0, int(env_n))
+            except ValueError:
+                p.error(f"DRL_INFER_REPLICAS must be an integer, "
+                        f"got {env_n!r}")
+        import json
+
+        try:
+            with open(os.path.join(REPO, "benchmarks",
+                                   "inference_verdict.json")) as f:
+                verdict = json.load(f)
+            if not verdict.get("auto_enable", False):
+                return 0
+            return max(1, int(verdict.get("replicas", 2)))
+        except (OSError, ValueError):
+            return 0
+
+    n_infer = infer_replicas()
+    if n_infer and not args.remote_act:
+        p.error("--inference_replicas needs remote-act actors; "
+                "pass --remote_act too")
     learners = []
     if args.learners > 1:
         env["DRL_COORDINATOR"] = f"localhost:{_free_port()}"
@@ -210,6 +255,28 @@ def main() -> None:
             f"learner{pid}" if args.learners > 1 else "learner",
             learner_cmd, lenv))
 
+    # Inference replicas sit between the learners and the actors: each
+    # serves OP_ACT on its own port, pulling weights from learner
+    # (k % learners) — over that learner's shm board when boards are on
+    # (read-only attach; the board is multi-reader by construction).
+    infer_addrs: list[str] = []
+    for k in range(n_infer):
+        iport = _free_port()
+        infer_cmd = base + ["--mode", "inference", "--task", str(k)]
+        if args.run_dir:
+            infer_cmd += ["--run_dir", args.run_dir]
+        ienv = {**env, "DRL_INFER_PORT": str(iport),
+                "DRL_LEARNER_INDEX": str(k % args.learners)}
+        if k % args.learners in board_names:
+            ienv["DRL_SHM_WEIGHTS_NAME"] = board_names[k % args.learners]
+        spawn(f"infer{k}", infer_cmd, ienv)
+        infer_addrs.append(f"127.0.0.1:{iport}")
+    if infer_addrs:
+        env["DRL_INFER_ADDRS"] = ",".join(infer_addrs)
+        print(f"[cluster] inference tier: {n_infer} act-serving "
+              f"replica(s)", file=sys.stderr)
+
+    actor_procs = []
     for task in range(args.actors):
         actor_cmd = base + ["--mode", "actor", "--task", str(task)]
         if args.remote_act:
@@ -219,7 +286,7 @@ def main() -> None:
             aenv["DRL_SHM_RING_NAME"] = ring_names[task]
         if task % args.learners in board_names:
             aenv["DRL_SHM_WEIGHTS_NAME"] = board_names[task % args.learners]
-        spawn(f"actor{task}", actor_cmd, aenv)
+        actor_procs.append(spawn(f"actor{task}", actor_cmd, aenv))
 
     def shutdown(*_):
         for proc in procs:
@@ -228,7 +295,10 @@ def main() -> None:
 
     signal.signal(signal.SIGINT, shutdown)
     signal.signal(signal.SIGTERM, shutdown)
-    actors = [proc for proc in procs if proc not in learners]
+    # The liveness check below watches the ACTORS, not the inference
+    # replicas: replicas are a serving tier, and a topology whose actors
+    # all died must come down even while replicas idle healthily.
+    actors = actor_procs
     rc = 0
     # Wait on the whole topology: learners finishing is the normal end,
     # but every actor dying while the learner idles (e.g. misconfigured

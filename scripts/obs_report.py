@@ -292,7 +292,8 @@ def build_report(tdir: str, merge: bool = True) -> str:
     for shard in shards:
         for name, stats in sorted(shard.counter_rates().items()):
             if name.startswith(("staleness_bucket/", "codec/", "board/",
-                                "replay_shard/")):
+                                "replay_shard/", "inference/",
+                                "remote_act/")):
                 continue  # rendered as their own sections below
             any_counter = True
             out(f"  {shard_label(shard):<14} {name:<28} "
@@ -419,6 +420,52 @@ def build_report(tdir: str, merge: bool = True) -> str:
         out("")
         out("-- Replay shards (ingest-time prioritization) --")
         lines.extend(shard_lines)
+
+    # Inference serving (runtime/inference.py + runtime/serving.py):
+    # per-service act throughput, batch occupancy, admission rejects and
+    # queue wait; per-actor replica-selection counters. Section only
+    # appears when a run served acts (learner-hosted or replica tier).
+    infer_lines: list[str] = []
+    for shard in shards:
+        rates = shard.counter_rates()
+        served = rates.get("inference/rows_served")
+        if served is not None:
+            batches = rates.get("inference/batches_run", {})
+            rejects = rates.get("inference/admission_rejects", {})
+            per_batch = served["total"] / max(batches.get("total", 0), 1)
+            infer_lines.append(
+                f"  {shard_label(shard)}: {served['total']:.0f} rows acted "
+                f"({served['rate']:.0f}/s) in {batches.get('total', 0):.0f} "
+                f"batches ({per_batch:.1f} rows/batch), "
+                f"{rejects.get('total', 0):.0f} admission rejects")
+            occ = shard.gauge_stats("inference/batch_occupancy")
+            wait = shard.gauge_stats("inference/queue_wait_ms")
+            if occ is not None or wait is not None:
+                parts = []
+                if occ is not None:
+                    parts.append(f"bucket occupancy mean "
+                                 f"{100 * occ['mean']:.0f}%")
+                if wait is not None:
+                    parts.append(f"queue wait mean {wait['mean']:.2f}ms "
+                                 f"max {wait['max']:.2f}ms")
+                infer_lines.append("    " + "  ".join(parts))
+    for shard in shards:
+        rates = shard.counter_rates()
+        acts = rates.get("remote_act/acts")
+        if acts is None:
+            continue
+        infer_lines.append(
+            f"  {shard_label(shard)}: {acts['total']:.0f} remote acts, "
+            f"{rates.get('remote_act/busy_failovers', {}).get('total', 0):.0f}"
+            f" busy failovers, "
+            f"{rates.get('remote_act/replica_demotes', {}).get('total', 0):.0f}"
+            f" replica demotes, "
+            f"{rates.get('remote_act/fallback_acts', {}).get('total', 0):.0f}"
+            f" fallback acts")
+    if infer_lines:
+        out("")
+        out("-- Inference serving (act path) --")
+        lines.extend(infer_lines)
 
     out("")
     out("-- Weight publication --")

@@ -1,0 +1,49 @@
+"""Subprocess worker for tests/test_serving.py: one act-serving replica.
+
+Publishes the params decoded from `params_file` as version 0 into a
+local WeightStore and serves OP_ACT on `port` through the continuous
+batcher behind a queue-less TransportServer — the two-process shape of
+`runtime/serving.run_replica`, minus the config-file/weight-refresh
+wiring the unit tests don't exercise. Deliberately does NOT warm the
+jit cache with a submit: the equivalence test pins that the FIRST
+served batch consumes the first PRNG split, exactly like the
+learner-hosted service it is compared against.
+
+argv: port params_file seed obs_dim num_actions lstm_size
+
+Prints READY when serving; exits when stdin closes (the parent's
+handle on a clean shutdown — a chaos test just kills the process).
+"""
+
+import sys
+
+from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent, ImpalaConfig
+from distributed_reinforcement_learning_tpu.data import codec
+from distributed_reinforcement_learning_tpu.runtime.serving import ContinuousInferenceServer
+from distributed_reinforcement_learning_tpu.runtime.transport import TransportServer
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+
+def main() -> None:
+    port, params_file, seed, obs_dim, num_actions, lstm = (
+        int(sys.argv[1]), sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+        int(sys.argv[5]), int(sys.argv[6]))
+    agent = ImpalaAgent(ImpalaConfig(obs_shape=(obs_dim,),
+                                     num_actions=num_actions,
+                                     trajectory=8, lstm_size=lstm))
+    with open(params_file, "rb") as f:
+        params = codec.decode(f.read(), copy=True)
+    weights = WeightStore()
+    weights.publish(params, 0)
+    inference = ContinuousInferenceServer.for_agent(
+        "impala", agent, weights, max_batch=64, seed=seed)
+    server = TransportServer(None, weights, host="127.0.0.1", port=port,
+                             inference=inference).start()
+    print("READY", flush=True)
+    sys.stdin.readline()
+    server.stop()
+    inference.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,7 @@ _TRIMMED = {
     "BENCH_APEX_INGEST": "0", "BENCH_INGEST": "0",
     "BENCH_ANAKIN": "0", "BENCH_ANAKIN_R2D2": "0",
     "BENCH_TRANSPORT": "0", "BENCH_CODEC": "0", "BENCH_WEIGHTS": "0",
-    "BENCH_REPLAY": "0",
+    "BENCH_REPLAY": "0", "BENCH_INFER": "0",
 }
 
 
@@ -285,6 +285,61 @@ class TestReplayCompare:
         assert shard_count() == 3  # env force wins over the verdict
         monkeypatch.setenv("DRL_REPLAY_SHARDS", "0")
         assert shard_count() == 0
+
+
+class TestInferenceCompare:
+    """bench_inference_compare: the learner-hosted vs replica-tier act
+    client-swarm A/B whose verdict gates runtime/serving's replica
+    default. Driven directly at a tiny config (CPU, host-only) — the
+    committed adjudication lives in benchmarks/inference_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        bench = _load_bench()
+        from distributed_reinforcement_learning_tpu.agents.impala import ImpalaConfig
+
+        cfg = ImpalaConfig(obs_shape=(8,), num_actions=2, trajectory=8,
+                           lstm_size=16)
+        r = bench.bench_inference_compare(cfg, n_clients=1, requests=10,
+                                          rows=4, replicas=1, max_batch=8)
+        for side in ("learner_hosted", "replica_tier"):
+            assert r[side]["actions_per_s"] > 0, r
+            assert r[side]["act_ms_p99"] >= r[side]["act_ms_p50"]
+        # Variant labeling honesty: the learner-hosted swarm acts only
+        # through the fallback, the replica swarm never leaks off-tier.
+        assert r["learner_hosted"]["client_stats"]["fallback_acts"] > 0
+        assert r["replica_tier"]["client_stats"]["fallback_acts"] == 0
+        assert r["replica_tier"]["client_stats"]["replica_demotes"] == 0
+        assert r["replicas_vs_learner"] > 0 and r["act_p50_speedup"] > 0
+        assert r["auto_enable"] == (r["replicas_vs_learner"] >= 1.2)
+        assert r["verdict"].startswith("inference replicas ") and (
+            "auto-on" in r["verdict"] or "opt-in" in r["verdict"])
+
+    def test_compact_line_carries_inference_verdict_key(self):
+        bench = _load_bench()
+        assert "inference_verdict" in bench._COMPACT_KEYS
+        # The trimmed env the failure-mode subprocess tests run under
+        # must gate this (multi-process) section off.
+        assert _TRIMMED["BENCH_INFER"] == "0"
+
+    def test_committed_verdict_file_consistent(self, monkeypatch):
+        """The committed adjudication parses, and replica_count()
+        follows it when DRL_INFER_REPLICAS is unset (env force >
+        committed verdict > off)."""
+        monkeypatch.delenv("DRL_INFER_REPLICAS", raising=False)
+        verdict = json.loads(
+            (REPO / "benchmarks" / "inference_verdict.json").read_text())
+        assert isinstance(verdict["auto_enable"], bool)
+        assert verdict["ratio_runs"] and verdict["bar"] == 1.2
+        from distributed_reinforcement_learning_tpu.runtime.serving import (
+            replica_count, replicas_auto_enabled)
+
+        assert replicas_auto_enabled() is verdict["auto_enable"]
+        assert (replica_count() > 0) is verdict["auto_enable"]
+        monkeypatch.setenv("DRL_INFER_REPLICAS", "3")
+        assert replica_count() == 3  # env force wins over the verdict
+        monkeypatch.setenv("DRL_INFER_REPLICAS", "0")
+        assert replica_count() == 0
 
 
 class TestDeviceChunkGate:

@@ -528,12 +528,13 @@ class TestCliAndTreeGate:
         annotations double as documentation (ISSUE 2 satellite) and
         deleting one silently disables the race check for that class."""
         expected = {
-            "runtime/transport.py": 2,   # TransportServer + TransportClient
+            "runtime/transport.py": 3,   # server + client + RemoteActService
             "runtime/shm_ring.py": 3,    # ShmRing (doc form) + drainer + queue
             "runtime/weights.py": 1,
             "runtime/weight_board.py": 2,  # WeightBoard (doc form) + BoardWeights
             "runtime/publishing.py": 1,  # empty-map documentation form
             "runtime/inference.py": 1,
+            "runtime/serving.py": 1,     # ContinuousInferenceServer
             "data/fifo.py": 1,
             "data/replay.py": 3,         # Native/Array backends + doc note
             "data/replay_service.py": 2,  # ReplayShard + ShardedReplayService

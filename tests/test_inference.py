@@ -349,6 +349,150 @@ def test_mismatched_request_fails_alone():
         server.stop()
 
 
+def test_oversized_request_is_chunked():
+    """Satellite regression: the docstring's oversubscription contract.
+    One request WIDER than max_batch must be served in max_batch-row
+    chunks — the old _take_batch took oversized requests whole,
+    compiling fresh XLA shapes past the bucket range."""
+    agent, cfg = _tiny_agent()
+    weights = WeightStore()
+    params = agent.init_state(jax.random.PRNGKey(0)).params
+    weights.publish(params, 0)
+    server = InferenceServer.for_agent("impala", agent, weights,
+                                       max_batch=16, max_wait_ms=1.0)
+    sizes = []
+    inner = server.act_fn
+
+    def recording(p, rows, rng):
+        sizes.append(rows["obs"].shape[0])
+        return inner(p, rows, rng)
+
+    recording.expected_keys = inner.expected_keys
+    server.act_fn = recording
+    try:
+        req = _impala_request(cfg, 70)
+        req["obs"] = np.random.default_rng(5).random((70, 4), np.float32)
+        out = server.submit(req)
+        assert out["action"].shape == (70,)
+        assert sizes and max(sizes) <= 16, sizes  # never past the buckets
+        assert server.rows_served == 70
+        # Policy is rng-independent, so the chunked outputs must agree
+        # with one direct 70-row forward — pinning the re-concatenation
+        # order as well as the math.
+        local = agent.act(params, req["obs"], req["prev_action"], req["h"],
+                          req["c"], jax.random.PRNGKey(1))
+        np.testing.assert_allclose(out["policy"], np.asarray(local.policy),
+                                   rtol=1e-5)
+    finally:
+        server.stop()
+
+
+def test_submit_racing_stop_never_hangs():
+    """Shutdown edge: submits concurrent with stop() either serve or
+    raise 'inference server stopped' — no waiter is left stranded."""
+    agent, cfg = _tiny_agent()
+    weights = WeightStore()
+    weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+    server = InferenceServer.for_agent("impala", agent, weights,
+                                       max_batch=8, max_wait_ms=1.0)
+    server.submit(_impala_request(cfg, 2))  # warm the jit cache
+    outcomes = []
+
+    def spam():
+        for _ in range(50):
+            try:
+                server.submit(_impala_request(cfg, 2))
+            except RuntimeError:
+                outcomes.append("raised")
+                return
+        outcomes.append("done")
+
+    threads = [threading.Thread(target=spam) for _ in range(4)]
+    for t in threads:
+        t.start()
+    server.stop()
+    for t in threads:
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "submit hung across stop()"
+    assert len(outcomes) == 4
+    with pytest.raises(RuntimeError, match="stopped"):
+        server.submit(_impala_request(cfg, 1))
+
+
+def test_batch_failure_delivers_errors_to_every_waiter():
+    """Liveness edge: one failing batch must error EVERY request that
+    joined it — a stranded waiter would hang its actor's connection
+    thread forever — and the server keeps serving afterwards."""
+    agent, cfg = _tiny_agent()
+    weights = WeightStore()
+    weights.publish(agent.init_state(jax.random.PRNGKey(0)).params, 0)
+    server = InferenceServer.for_agent("impala", agent, weights,
+                                       max_batch=64, max_wait_ms=30.0)
+    inner = server.act_fn
+    boom = threading.Event()
+
+    def failing(p, rows, rng):
+        if boom.is_set():
+            raise ValueError("injected batch failure")
+        return inner(p, rows, rng)
+
+    failing.expected_keys = inner.expected_keys
+    server.act_fn = failing
+    errors = []
+
+    def one():
+        try:
+            server.submit(_impala_request(cfg, 4))
+        except RuntimeError as e:
+            errors.append(e)
+
+    try:
+        server.submit(_impala_request(cfg, 2))  # warm
+        boom.set()
+        # Three submits inside one 30ms batching window: they coalesce
+        # into the single batch the injected failure poisons.
+        threads = [threading.Thread(target=one) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+            assert not t.is_alive(), "waiter stranded by a failed batch"
+        assert len(errors) == 3
+        assert all("inference batch failed" in str(e) for e in errors)
+        # The batcher survived the failure: healthy traffic still serves.
+        boom.clear()
+        out = server.submit(_impala_request(cfg, 2))
+        assert out["action"].shape == (2,)
+    finally:
+        server.stop()
+
+
+def test_rollback_republish_reaches_device_cache():
+    """Weight-version IDENTITY edge: versions are snapshot identities,
+    not an ordering. A restarted learner republishing version 3 after
+    this service cached version 5 must still re-upload — a `<=` compare
+    in _dispatch's device cache would serve stale params forever."""
+    weights = WeightStore()
+
+    def act_fn(params, rows, rng):
+        import jax.numpy as jnp
+
+        n = rows["x"].shape[0]
+        return {"marker": jnp.full((n,), params["w"])}
+
+    weights.publish({"w": np.float32(5.0)}, 5)
+    server = InferenceServer(act_fn, weights, max_batch=8, max_wait_ms=1.0)
+    try:
+        out = server.submit({"x": np.zeros(2, np.float32)})
+        np.testing.assert_array_equal(out["marker"], [5.0, 5.0])
+        # Checkpoint-rollback republish: DIFFERENT params, LOWER version.
+        weights.publish({"w": np.float32(3.0)}, 3)
+        out = server.submit({"x": np.zeros(2, np.float32)})
+        np.testing.assert_array_equal(out["marker"], [3.0, 3.0])
+    finally:
+        server.stop()
+
+
 def test_ximpala_adapter():
     """Fifth family: window-shaped rows, softmax-sampled actions plus the
     behavior policy the actor must record for V-trace."""

@@ -14,7 +14,7 @@ def main() -> None:
     p.add_argument("--config", default="config.json")
     p.add_argument("--section", default="apex")
     p.add_argument("--mode", default="local",
-                   choices=["local", "learner", "actor", "anakin"])
+                   choices=["local", "learner", "actor", "anakin", "inference"])
     p.add_argument("--anakin_envs", type=int, default=None,
                    help="anakin mode: parallel on-device envs")
     p.add_argument("--anakin_capacity", type=int, default=None,
@@ -38,7 +38,11 @@ def main() -> None:
                    help="actor mode: offload act() to the learner's inference service")
     args = p.parse_args()
 
-    platform = args.platform or ("cpu" if args.mode == "actor" else None)
+    # Actors AND inference replicas default to cpu: neither may grab
+    # the TPU chip the learner process holds (single-owner libtpu) —
+    # pass --platform explicitly when a replica has its own accelerator.
+    platform = args.platform or (
+        "cpu" if args.mode in ("actor", "inference") else None)
     if platform:
         import jax
         jax.config.update("jax_platforms", platform)

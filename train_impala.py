@@ -25,7 +25,7 @@ def main() -> None:
     p.add_argument("--config", default="config.json")
     p.add_argument("--section", default="impala")
     p.add_argument("--mode", default="local",
-                   choices=["local", "learner", "actor", "anakin"])
+                   choices=["local", "learner", "actor", "anakin", "inference"])
     p.add_argument("--task", type=int, default=-1, help="actor index (actor mode)")
     p.add_argument("--updates", type=int, default=1000)
     p.add_argument("--run_dir", default=None)
@@ -49,7 +49,11 @@ def main() -> None:
                         "service instead of pulling weights")
     args = p.parse_args()
 
-    platform = args.platform or ("cpu" if args.mode == "actor" else None)
+    # Actors AND inference replicas default to cpu: neither may grab
+    # the TPU chip the learner process holds (single-owner libtpu) —
+    # pass --platform explicitly when a replica has its own accelerator.
+    platform = args.platform or (
+        "cpu" if args.mode in ("actor", "inference") else None)
     if platform:
         import jax
         jax.config.update("jax_platforms", platform)

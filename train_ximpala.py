@@ -17,7 +17,7 @@ def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", default="config.json")
     p.add_argument("--section", default="ximpala")
-    p.add_argument("--mode", default="local", choices=["local", "learner", "actor"])
+    p.add_argument("--mode", default="local", choices=["local", "learner", "actor", "inference"])
     p.add_argument("--task", type=int, default=-1)
     p.add_argument("--updates", type=int, default=1000)
     p.add_argument("--run_dir", default=None)
@@ -36,7 +36,11 @@ def main() -> None:
                    help="actor mode: offload act() to the learner's inference service")
     args = p.parse_args()
 
-    platform = args.platform or ("cpu" if args.mode == "actor" else None)
+    # Actors AND inference replicas default to cpu: neither may grab
+    # the TPU chip the learner process holds (single-owner libtpu) —
+    # pass --platform explicitly when a replica has its own accelerator.
+    platform = args.platform or (
+        "cpu" if args.mode in ("actor", "inference") else None)
     if platform:
         import jax
         jax.config.update("jax_platforms", platform)
