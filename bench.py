@@ -33,6 +33,7 @@ backend produces a diagnostic JSON line instead of a traceback.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import socket
@@ -71,8 +72,8 @@ def _probe_backend(timeout: float) -> tuple[str | None, str | None]:
 # regression must survive into the compact line the driver reads).
 _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
                  "watchdog", "chunk_regressions", "transport_verdict",
-                 "codec_verdict", "weights_verdict", "replay_verdict",
-                 "inference_verdict")
+                 "codec_verdict", "weights_verdict", "weights_shard_verdict",
+                 "replay_verdict", "inference_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -110,6 +111,40 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _pctl(sorted_vals, q):
+    """Percentile of an already-sorted list (nearest-rank, the repo's
+    bench convention — shared by the weight-plane sections)."""
+    return round(sorted_vals[min(int(q * (len(sorted_vals) - 1) + 0.5),
+                                 len(sorted_vals) - 1)], 3)
+
+
+def _stage_p(samples: dict, name: str) -> dict:
+    """p50/p99/n summary of one `_RecTimer` stage."""
+    vals = sorted(samples.get(name, []))
+    if not vals:
+        return {"p50_ms": 0.0, "p99_ms": 0.0, "n": 0}
+    return {"p50_ms": _pctl(vals, 0.50), "p99_ms": _pctl(vals, 0.99),
+            "n": len(vals)}
+
+
+class _RecTimer:
+    """StageTimer.stage duck-type keeping per-invocation samples —
+    maybe_publish's publish/publish_handoff/publish_stall split
+    (shared by the weight-plane A/B sections)."""
+
+    def __init__(self):
+        self.samples: dict[str, list[float]] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.samples.setdefault(name, []).append(
+                (time.perf_counter() - t0) * 1e3)
 
 
 def _marginal_step_s(window, iters: int, samples: int | None = None) -> tuple[float, dict]:
@@ -1530,8 +1565,6 @@ def bench_weights_compare(cfg, n_actors: int = 2, rounds: int = 96,
     carries the decision `runtime/weight_board.board_enabled()` consults.
     Host-only, link-independent.
     """
-    import contextlib
-
     import numpy as np
 
     from distributed_reinforcement_learning_tpu.runtime import weight_board
@@ -1552,22 +1585,6 @@ def bench_weights_compare(cfg, n_actors: int = 2, rounds: int = 96,
     }
     params["step"] = np.zeros((), np.int64)
 
-    class _RecTimer:
-        """StageTimer.stage duck-type keeping per-invocation samples —
-        maybe_publish's publish/publish_handoff/publish_stall split."""
-
-        def __init__(self):
-            self.samples: dict[str, list[float]] = {}
-
-        @contextlib.contextmanager
-        def stage(self, name):
-            t0 = time.perf_counter()
-            try:
-                yield
-            finally:
-                self.samples.setdefault(name, []).append(
-                    (time.perf_counter() - t0) * 1e3)
-
     class _Publisher(PublishCadenceMixin):
         publish_interval = 1
 
@@ -1582,16 +1599,7 @@ def bench_weights_compare(cfg, n_actors: int = 2, rounds: int = 96,
             self.state = _State()
             self.state.params = params
 
-    def pctl(sorted_ms, q):
-        return round(sorted_ms[min(int(q * (len(sorted_ms) - 1) + 0.5),
-                                   len(sorted_ms) - 1)], 3)
-
-    def stage_p(samples: dict, name: str) -> dict:
-        vals = sorted(samples.get(name, []))
-        if not vals:
-            return {"p50_ms": 0.0, "p99_ms": 0.0, "n": 0}
-        return {"p50_ms": pctl(vals, 0.50), "p99_ms": pctl(vals, 0.99),
-                "n": len(vals)}
+    pctl, stage_p = _pctl, _stage_p  # shared weight-plane helpers
 
     repo_root = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
@@ -1710,6 +1718,12 @@ def bench_weights_compare(cfg, n_actors: int = 2, rounds: int = 96,
                  "async PublishCadenceMixin path")}
     out["tcp"] = run_variant("")
     out["board"] = run_variant(f"drlwb-bench-{os.getpid()}")
+    # Broadcast bytes per landed version (ISSUE 8 satellite): the
+    # whole-blob plane moves the full params blob per version on both
+    # variants — per-pull on TCP, one memcpy on the board. The sharded
+    # section (weights_shard_compare) is where this number moves.
+    for side in ("tcp", "board"):
+        out[side]["broadcast_bytes_per_version"] = blob_bytes
     ratio = out["board"]["frames_per_s"] / max(out["tcp"]["frames_per_s"], 1e-9)
     pull_ratio = out["tcp"]["weight_pull_ms_p50"] / max(
         out["board"]["weight_pull_ms_p50"], 1e-9)
@@ -1722,6 +1736,303 @@ def bench_weights_compare(cfg, n_actors: int = 2, rounds: int = 96,
     print(f"[bench] weights_compare: tcp {out['tcp']['frames_per_s']:,.0f} "
           f"f/s vs board {out['board']['frames_per_s']:,.0f} f/s "
           f"-> {out['verdict']}", file=sys.stderr)
+    return out
+
+
+def _shard_bench_params(shape: str, seed: int = 0) -> dict:
+    """Synthetic params pytrees for the sharded-weight-plane A/B.
+
+    "cnn": the weights_compare ~4.2 MB conv-policy-sized pytree (every
+    leaf-name below the model-sharding rules — one replicated shard plus
+    the big-kernel shard, the degenerate case sharding must not regress).
+    "xformer": an xformer-sized (~19 MB) stacked-transformer pytree whose
+    names hit the pipe/model partition rules — the policy scale the
+    sharded plane exists for (ROADMAP item 1)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    if shape == "cnn":
+        params = {
+            f"layer{i}": {"w": rng.standard_normal((256, 512)).astype(np.float32),
+                          "b": rng.standard_normal(512).astype(np.float32)}
+            for i in range(8)
+        }
+        params["step"] = np.zeros((), np.int64)
+        return params
+    layers, d = 6, 256
+    blocks = {
+        "qkv_kernel": rng.standard_normal((layers, d, 3 * d)).astype(np.float32),
+        "proj_kernel": rng.standard_normal((layers, d, d)).astype(np.float32),
+        "mlp_in_kernel": rng.standard_normal((layers, d, 4 * d)).astype(np.float32),
+        "mlp_out_kernel": rng.standard_normal((layers, 4 * d, d)).astype(np.float32),
+        "ln1_scale": np.ones((layers, d), np.float32),
+        "ln1_bias": np.zeros((layers, d), np.float32),
+        "ln2_scale": np.ones((layers, d), np.float32),
+        "ln2_bias": np.zeros((layers, d), np.float32),
+    }
+    return {
+        "blocks_stacked": blocks,
+        "embed": rng.standard_normal((128, d)).astype(np.float32),
+        "head": {"w": rng.standard_normal((d, 512)).astype(np.float32),
+                 "b": np.zeros(512, np.float32)},
+        "step": np.zeros((), np.int64),
+    }
+
+
+def _bf16_policy_equivalence(envs: int = 16, steps: int = 16) -> dict:
+    """The quantized-broadcast acceptance pin: actions sampled from a
+    REAL ImpalaAgent acting on bf16-cast-then-dequantized params vs the
+    f32 originals, over a fixed rollout (same obs stream, same rng keys,
+    each side advancing its own LSTM chain so any divergence compounds
+    the way it would on a live actor)."""
+    import jax
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.agents.impala import (
+        ImpalaAgent, ImpalaConfig)
+    from distributed_reinforcement_learning_tpu.runtime import weight_shards
+
+    cfg = ImpalaConfig(obs_shape=(64,), num_actions=8, trajectory=8,
+                       lstm_size=64)
+    agent = ImpalaAgent(cfg)
+    params = jax.device_get(agent.init_state(jax.random.PRNGKey(0)).params)
+    bundle = weight_shards.build_bundle(params, quant="bf16")
+    qparams = weight_shards.materialize(dict(bundle.manifest, version=0),
+                                        bundle.blobs)
+    rng = np.random.RandomState(7)
+    key0 = jax.random.PRNGKey(123)
+    pa_f = pa_q = np.zeros(envs, np.int32)
+    h_f, c_f = agent.initial_lstm_state(envs)
+    h_q, c_q = h_f, c_f
+    matches = total = 0
+    max_policy_diff = 0.0
+    for t in range(steps):
+        obs = rng.standard_normal((envs, *cfg.obs_shape)).astype(np.float32)
+        key = jax.random.fold_in(key0, t)
+        out_f = agent.act(params, obs, pa_f, h_f, c_f, key)
+        out_q = agent.act(qparams, obs, pa_q, h_q, c_q, key)
+        a_f, a_q = np.asarray(out_f.action), np.asarray(out_q.action)
+        matches += int((a_f == a_q).sum())
+        total += envs
+        max_policy_diff = max(max_policy_diff, float(np.max(np.abs(
+            np.asarray(out_f.policy) - np.asarray(out_q.policy)))))
+        pa_f, pa_q = a_f.astype(np.int32), a_q.astype(np.int32)
+        h_f, c_f = out_f.h, out_f.c
+        h_q, c_q = out_q.h, out_q.c
+    return {"action_match": round(matches / total, 4),
+            "max_policy_diff": round(max_policy_diff, 6),
+            "rollout": [envs, steps]}
+
+
+def bench_weights_shard_compare(cfg, n_actors: int = 2, rounds: int = 40,
+                                unrolls_per_put: int = 8,
+                                publish_period_s: float = 0.05,
+                                shapes: tuple = ("cnn", "xformer")) -> dict:
+    """Sharded-weight-plane A/B (ISSUE 8): whole-blob vs sharded vs
+    sharded+bf16, at the CNN shape AND an xformer-sized pytree, each
+    variant a full two-child-process topology over the deployed
+    broadcast path (shm board + real TCP PUT load, exactly the
+    weights_compare harness). The publisher MUTATES every float leaf
+    in place each cadence tick (the learner's train step rewrites every
+    parameter every update), so changed-shard elision cannot fake a win
+    — sharding has to pay for its per-shard encodes with real pull/
+    publish savings, and bf16 with its halved broadcast bytes.
+
+    Verdict (the repo's 1.2x adjudication bar, per shape, min across
+    shapes): `auto_enable` for DRL_WEIGHTS_SHARDED, `quant_auto_enable`
+    for the bf16 broadcast (additionally requiring the policy-
+    equivalence pin), committed to
+    benchmarks/weights_shard_verdict.json. Delta publication is NOT
+    adjudicated here — loopback bytes are free, so a local A/B cannot
+    say anything honest about it; it stays opt-in with its own note.
+    """
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.data import codec as codec_mod
+    from distributed_reinforcement_learning_tpu.runtime import weight_board
+    from distributed_reinforcement_learning_tpu.runtime.publishing import (
+        PublishCadenceMixin)
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        TransportServer, _make_queue)
+    from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+    T = cfg.trajectory
+    pctl, stage_p = _pctl, _stage_p  # shared weight-plane helpers
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"  # the children never touch a device
+    for key in ("DRL_WEIGHTS_SHARDED", "DRL_WEIGHTS_QUANT",
+                "DRL_WEIGHTS_DELTA", "DRL_WEIGHTS_KEYS"):
+        env.pop(key, None)  # children follow the board/server, not env
+
+    def run_variant(shape: str, sharded: bool, quant: str) -> dict:
+        params = _shard_bench_params(shape)
+        float_leaves = []
+        import jax
+
+        jax.tree.map(lambda a: float_leaves.append(a)
+                     if getattr(a, "dtype", None) == np.float32 else None,
+                     params)
+        blob_bytes = len(codec_mod.encode(params, cache=True))
+        queue = _make_queue(128)
+        weights = WeightStore(sharded=sharded, quant=quant)
+        cap = max(int(blob_bytes * 1.5), 8 << 20)
+        name = f"drlwsb-{os.getpid()}-{shape}"
+        if sharded:
+            board = weight_board.ShardedWeightBoard.create(name, 2 * cap)
+        else:
+            board = weight_board.WeightBoard.create(name, cap)
+        weights.attach_board(board)
+        server = TransportServer(queue, weights, host="127.0.0.1",
+                                 port=_free_port()).start()
+        stop = threading.Event()
+
+        def drain_loop():
+            raw = hasattr(queue, "put_bytes")
+            dcap = 1 << 16
+            while not stop.is_set():
+                try:
+                    if raw:
+                        got = queue._q.get_batch_raw(16, dcap, timeout=0.2)
+                        if got is not None:
+                            dcap = got[1]
+                    else:
+                        queue.get(timeout=0.2)
+                except RuntimeError:
+                    return
+
+        class _Publisher(PublishCadenceMixin):
+            publish_interval = 1
+
+            def __init__(self):
+                self.weights = weights
+                self.train_steps = 0
+                self.timer = _RecTimer()
+
+                class _State:
+                    pass
+
+                self.state = _State()
+                self.state.params = params
+
+        pub = _Publisher()
+        pub.train_steps = 1
+        pub.maybe_publish()  # version 1 lands before any child attaches
+        assert weights.flush_async(timeout=60.0)
+
+        def pub_loop():
+            while not stop.wait(publish_period_s):
+                # Every float leaf drifts IN PLACE — the honest model of
+                # a train step (every parameter moves every update), so
+                # every shard is genuinely changed every version.
+                for leaf in float_leaves:
+                    leaf += np.float32(1e-6)
+                params["step"] = np.asarray(pub.train_steps + 1, np.int64)
+                pub.train_steps += 1
+                pub.maybe_publish()
+
+        threads = [threading.Thread(target=drain_loop, daemon=True),
+                   threading.Thread(target=pub_loop, daemon=True)]
+        for t in threads:
+            t.start()
+        try:
+            procs = [subprocess.Popen(
+                [sys.executable, "-c", _WEIGHTS_CHILD, "127.0.0.1",
+                 str(server.port), name, str(T), str(rounds),
+                 str(unrolls_per_put), json.dumps(list(cfg.obs_shape)),
+                 str(cfg.num_actions), str(cfg.lstm_size)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True) for _ in range(n_actors)]
+            results = []
+            for proc in procs:
+                out_s, err_s = proc.communicate(timeout=600)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"weights_shard_compare child rc={proc.returncode}: "
+                        f"{err_s.strip()[-500:]}")
+                line = next(ln for ln in out_s.splitlines()
+                            if ln.startswith("WEIGHTS_CHILD="))
+                results.append(json.loads(line.split("=", 1)[1]))
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+            weights.close()
+            server.stop()
+            queue.close()
+            board.close_writer()
+            board.close()
+            board.unlink()
+        pull_ms = sorted(ms for r in results for ms in r["pull_ms"])
+        agg: dict = {}
+        for r in results:
+            for k, v in r.get("board_stats", {}).items():
+                agg[k] = agg.get(k, 0) + v
+        if agg.get("tcp_fallbacks", 0) or agg.get("board_shard_fallbacks", 0):
+            raise RuntimeError(
+                f"board variant fell back mid-run ({agg}): the measurement "
+                f"is not a board number; rerun on a quiet host")
+        sst = weights.shard_stats()
+        if sharded and sst["shard_publishes"]:
+            bcast = round(sst["broadcast_bytes"] / sst["shard_publishes"])
+        else:
+            bcast = blob_bytes
+        return {
+            "frames_per_s": round(sum(r["frames_per_s"] for r in results), 1),
+            "weight_pulls": sum(r["weight_pulls"] for r in results),
+            "weight_pull_ms_p50": pctl(pull_ms, 0.50),
+            "weight_pull_ms_p99": pctl(pull_ms, 0.99),
+            "publish": stage_p(pub.timer.samples, "publish"),
+            "publish_handoff": stage_p(pub.timer.samples, "publish_handoff"),
+            "publish_stall": stage_p(pub.timer.samples, "publish_stall"),
+            "versions_published": pub.train_steps,
+            "params_bytes": blob_bytes,
+            "broadcast_bytes_per_version": bcast,
+            "board_stats": agg,
+        }
+
+    out: dict = {
+        "n_actors": n_actors, "rounds_per_actor": rounds,
+        "unrolls_per_put": unrolls_per_put,
+        "publish_period_s": publish_period_s,
+        "note": ("same pytree + publish cadence + PUT load across "
+                 "variants; every float leaf mutates in place per "
+                 "publish (train-step model) so changed-shard elision "
+                 "cannot fake the ratio; children are real processes on "
+                 "the deployed board/BoardWeights path")}
+    ratios, qratios = [], []
+    for shape in shapes:
+        sec = {"whole": run_variant(shape, False, ""),
+               "sharded": run_variant(shape, True, ""),
+               "sharded_bf16": run_variant(shape, True, "bf16")}
+        base = max(sec["whole"]["frames_per_s"], 1e-9)
+        sec["sharded_vs_whole"] = round(sec["sharded"]["frames_per_s"] / base, 2)
+        sec["bf16_vs_whole"] = round(
+            sec["sharded_bf16"]["frames_per_s"] / base, 2)
+        ratios.append(sec["sharded_vs_whole"])
+        qratios.append(sec["bf16_vs_whole"])
+        out[shape] = sec
+        print(f"[bench] weights_shard[{shape}]: whole "
+              f"{sec['whole']['frames_per_s']:,.0f} f/s, sharded "
+              f"{sec['sharded']['frames_per_s']:,.0f} "
+              f"({sec['sharded_vs_whole']}x), +bf16 "
+              f"{sec['sharded_bf16']['frames_per_s']:,.0f} "
+              f"({sec['bf16_vs_whole']}x); bcast B/ver "
+              f"{sec['whole']['broadcast_bytes_per_version']} -> "
+              f"{sec['sharded_bf16']['broadcast_bytes_per_version']}",
+              file=sys.stderr)
+    out["policy_equiv"] = _bf16_policy_equivalence()
+    out["sharded_ratio"] = min(ratios)
+    out["bf16_ratio"] = min(qratios)
+    out["auto_enable"] = min(ratios) >= 1.2  # the repo's adjudication bar
+    out["quant_auto_enable"] = (min(qratios) >= 1.2
+                                and out["policy_equiv"]["action_match"] >= 0.99)
+    out["delta_auto_enable"] = False  # loopback cannot adjudicate bytes
+    out["verdict"] = (
+        f"sharded {min(ratios):.2f}x whole, +bf16 {min(qratios):.2f}x "
+        f"(equiv {out['policy_equiv']['action_match']:.2%}): "
+        + ("auto-on" if out["auto_enable"] else "opt-in"))
     return out
 
 
@@ -3069,6 +3380,20 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["weights_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] weights_compare failed: {e}", file=sys.stderr)
+
+    # Whole-blob vs sharded vs sharded+bf16 weight-plane A/B at two
+    # policy shapes (the auto-enable adjudication for per-shard
+    # publication + the quantized broadcast, runtime/weight_shards.py).
+    if os.environ.get("BENCH_WEIGHTS_SHARD", "1") == "1" and \
+            _ok("weights_shard_compare", 240):
+        try:
+            r = bench_weights_shard_compare(cfg)
+            extra["weights_shard_compare"] = r
+            if "verdict" in r:
+                extra["weights_shard_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["weights_shard_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] weights_shard_compare failed: {e}", file=sys.stderr)
 
     # Two-process Ape-X ingest-plane A/B (the auto-enable adjudication
     # for the sharded replay service, data/replay_service.py).

@@ -620,6 +620,19 @@ def assemble(skel: Any, arrays: list[np.ndarray]) -> Any:
     return _unflatten(skel, arrays)
 
 
+def flatten_with_paths(tree: Any) -> tuple[Any, list[tuple[str, np.ndarray]]]:
+    """Canonical flatten: (skeleton, [(path, array), ...]) in the EXACT
+    leaf order every codec blob uses (sorted dict keys, namedtuple
+    fields in declaration order). The sharded weight plane
+    (parallel/partition.py, runtime/weight_shards.py) keys its shard
+    plans off these paths so "leaf i" means the same array to the
+    partition pass, the per-shard blobs, and a whole-blob encode —
+    the agreement its bit-identity contract rests on."""
+    pairs: list[tuple[str, np.ndarray]] = []
+    skel = _flatten(tree, "$", pairs)
+    return skel, pairs
+
+
 def is_packed(blob: bytes | memoryview) -> bool:
     """True when any leaf of this blob is dedup-packed."""
     return _layout_plan(memoryview(blob)).packed

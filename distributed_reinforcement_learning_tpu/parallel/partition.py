@@ -1,0 +1,165 @@
+"""Partition-rule pytree sharding: name-keyed PartitionSpecs + shard plans.
+
+`parallel/learner.py` already shards the TrainState structurally (big
+kernels over `model`, `moe_*` over `expert`, `blocks_stacked` over
+`pipe`) — but that rule lives inside the pjit wiring and only exists
+when a mesh does. The weight PLANE needs the same partition knowledge on
+the host side, mesh or no mesh: publication splits the params pytree
+into named shards keyed by partition spec, so per-shard encode/broadcast
+(runtime/weight_shards.py, runtime/weights.py) follows the same axes the
+learner compiles over. This module is the repo-native
+`match_partition_rules` pass (the SNIPPETS.md exemplars' idiom: regex
+rules over `/`-joined leaf names -> PartitionSpec, scalars always
+replicated), plus the shard-plan grouping the weight plane consumes.
+
+Leaf NAMING AND ORDER come from the codec's canonical flatten
+(`data/codec.flatten_with_paths` — sorted dict keys, namedtuple fields
+in declaration order), so shard plans, encoded shard blobs, and the
+whole-blob codec layout all agree on leaf index `i` meaning the same
+array. That shared ordering is what makes per-shard decode bit-identical
+to whole-blob decode (pinned by tests/test_weight_sharding.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Sequence
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributed_reinforcement_learning_tpu.parallel.mesh import (
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+)
+
+# Mirrors parallel/learner._MIN_SHARD_SIZE: leaves below this many
+# elements stay replicated no matter what rule their name matches —
+# splitting a 256-float bias costs more than it saves, and the weight
+# plane wants small leaves pooled into the replicated shard, not one
+# micro-shard per LayerNorm scale.
+MIN_PARTITION_SIZE = 4096
+
+REPLICATED_KEY = "rep"
+
+
+def leaf_name(codec_path: str) -> str:
+    """codec `_flatten` path -> rule-matching name: `$.a.b[2].c` ->
+    `a/b[2]/c` (the `/`-separated convention of the exemplar passes)."""
+    name = codec_path[2:] if codec_path.startswith("$.") else codec_path.lstrip("$")
+    return name.replace(".", "/")
+
+
+def named_tree_map(fn: Callable[[str, Any], Any], tree: Any, sep: str = "/") -> Any:
+    """Map `fn(name, leaf)` over a pytree with `/`-joined path names —
+    the exemplars' `named_tree_map`, over the codec's canonical order."""
+    from distributed_reinforcement_learning_tpu.data import codec
+
+    skel, pairs = codec.flatten_with_paths(tree)
+    out = [fn(leaf_name(path).replace("/", sep), arr) for path, arr in pairs]
+    return codec.assemble(skel, out)
+
+
+def default_partition_rules() -> tuple[tuple[str, P], ...]:
+    """(regex, PartitionSpec) rules keyed off `parallel/mesh.py` axis
+    names, first match wins — the host-side mirror of
+    `parallel/learner.train_state_sharding`:
+
+    - the pipelined transformer body (`blocks_stacked/*`) stacks layers
+      on its leading dim -> shard over `pipe`;
+    - expert-stacked MoE tensors (`moe_w*`/`moe_b*`, router gate
+      excluded) shard their leading expert dim over `expert`;
+    - kernels/matmul weights shard their output-feature (last) dim over
+      `model` (Megatron column style);
+    - everything else — biases, LayerNorm scales, embeddings small
+      enough to broadcast, counters — replicates (the catch-all, so
+      this rule set never raises).
+    """
+    return (
+        (r"blocks_stacked/", P(PIPE_AXIS)),
+        (r"(^|/)moe_(w|b)\d*$", P(EXPERT_AXIS)),
+        (r"(^|/)(w|kernel|qkv(_kernel)?|proj(_kernel)?|moe_gate|embed\w*)$",
+         P(None, MODEL_AXIS)),
+        (r".*", P()),
+    )
+
+
+def leaf_spec(rules: Sequence[tuple[str, P]], name: str, leaf) -> P:
+    """THE per-leaf partition decision (single source — shard keys and
+    manifests derive from it): scalar / size-1 / sub-
+    `MIN_PARTITION_SIZE` leaves are never partitioned; otherwise the
+    first rule whose regex `search`es the `/`-joined leaf name wins.
+    Raises ValueError when no rule matches (supply a catch-all
+    `(".*", P())` to opt out, as `default_partition_rules` does)."""
+    arr = np.asarray(leaf)
+    if arr.ndim == 0 or arr.size <= 1 or arr.size < MIN_PARTITION_SIZE:
+        return P()  # don't partition scalars / tiny leaves
+    for rule, spec in rules:
+        if re.search(rule, name) is not None:
+            return spec
+    raise ValueError(f"partition rule not found for param: {name}")
+
+
+def match_partition_rules(rules: Sequence[tuple[str, P]], params: Any) -> Any:
+    """Pytree of PartitionSpec per leaf (the exemplar pass), via
+    `leaf_spec`."""
+    return named_tree_map(
+        lambda name, leaf: leaf_spec(rules, name, leaf), params)
+
+
+def spec_key(spec: P) -> str:
+    """Stable, wire-safe shard key for a PartitionSpec: `P()` -> "rep",
+    `P(None, "model")` -> "-,model", `P("expert")` -> "expert". Keys
+    are manifest/protocol identifiers — renaming one invalidates every
+    reader's shard cache, so keep them derived, never hand-written."""
+    dims = tuple(spec)
+    if not dims or all(d is None for d in dims):
+        return REPLICATED_KEY
+    return ",".join("-" if d is None else str(d) for d in dims)
+
+
+class ShardPlan:
+    """How one params schema splits into named shards.
+
+    `skel` is the codec skeleton (global leaf indices), `paths`/`specs`
+    are per-leaf in that same order, and `shards` maps each stable shard
+    key to its ascending global leaf indices. Every leaf lands in
+    exactly ONE shard, so gathering the shards' leaf lists back into
+    global order and unflattening `skel` reproduces the pytree
+    bit-identically. Plans are immutable once built (the weight store
+    caches one per schema)."""
+
+    __slots__ = ("skel", "paths", "specs", "shards")
+
+    def __init__(self, skel: Any, paths: list[str], specs: list[P],
+                 shards: dict[str, list[int]]):
+        self.skel = skel
+        self.paths = paths
+        self.specs = specs
+        self.shards = shards
+
+    @property
+    def keys(self) -> list[str]:
+        return list(self.shards)
+
+
+def shard_plan(params: Any,
+               rules: Sequence[tuple[str, P]] | None = None) -> ShardPlan:
+    """Split `params` into partition-keyed shards (sorted keys, so two
+    processes planning the same schema agree byte-for-byte on shard
+    identity and leaf order)."""
+    from distributed_reinforcement_learning_tpu.data import codec
+
+    if rules is None:
+        rules = default_partition_rules()
+    skel, pairs = codec.flatten_with_paths(params)
+    paths = [leaf_name(p) for p, _ in pairs]
+    specs: list[P] = []
+    groups: dict[str, list[int]] = {}
+    for i, (name, (_, arr)) in enumerate(zip(paths, pairs)):
+        spec = leaf_spec(rules, name, arr)
+        specs.append(spec)
+        groups.setdefault(spec_key(spec), []).append(i)
+    return ShardPlan(skel, paths, specs,
+                     {k: groups[k] for k in sorted(groups)})

@@ -295,9 +295,9 @@ def run_replica(
     `num_updates` is accepted for launcher symmetry and ignored — a
     replica serves for the life of the run.
     """
-    from distributed_reinforcement_learning_tpu.runtime import launch
+    from distributed_reinforcement_learning_tpu.runtime import launch, weight_shards
     from distributed_reinforcement_learning_tpu.runtime.transport import (
-        RemoteWeights,
+        ShardedRemoteWeights,
         TransportClient,
         TransportError,
         TransportServer,
@@ -319,9 +319,12 @@ def run_replica(
     client.connect_retries = 3
     # Weight source: the shm board when the launcher named one (reads
     # are a version peek + one memcpy, cost independent of replica
-    # count), else TCP pulls from the learner. BoardWeights demotes
-    # ITSELF to the TCP client permanently on any board failure.
-    weights_src = RemoteWeights(client)
+    # count), else TCP pulls from the learner — shard-scoped when the
+    # learner publishes per shard (ShardedRemoteWeights demotes itself
+    # to the whole-blob op otherwise; DRL_WEIGHTS_KEYS scopes this
+    # replica's refreshes). BoardWeights demotes ITSELF to the TCP
+    # client permanently on any board failure.
+    weights_src = ShardedRemoteWeights(client, keys=weight_shards.role_keys())
     board_name = os.environ.get("DRL_SHM_WEIGHTS_NAME")
     if board_name:
         from distributed_reinforcement_learning_tpu.runtime import weight_board
@@ -378,9 +381,11 @@ def run_replica(
         for key in server.snapshot_stats():
             _OBS.sample(f"transport/{key}", lambda k=key: server.stat(k),
                         kind="counter")
-        if hasattr(weights_src, "snapshot_stats"):  # BoardWeights only
+        if hasattr(weights_src, "snapshot_stats"):
+            # "board/" for BoardWeights, "wshard/" for shard-scoped TCP.
+            wprefix = getattr(weights_src, "telemetry_prefix", "board")
             for key in weights_src.snapshot_stats():
-                _OBS.sample(f"board/{key}",
+                _OBS.sample(f"{wprefix}/{key}",
                             lambda k=key: weights_src.stat(k),
                             kind="counter")
     pull_s = float(os.environ.get("DRL_INFER_PULL_S", "0.2"))

@@ -44,6 +44,7 @@ OP_QUEUE_SIZE = 3
 OP_PING = 4
 OP_ACT = 5  # SEED-style remote inference (runtime/inference.py)
 OP_PUT_TRAJ_N = 6  # K unrolls per round trip (kills the per-unroll RTT)
+OP_GET_WEIGHTS_SHARDED = 7  # manifest + per-shard blobs (weight_shards)
 
 ST_OK = 0
 ST_ERROR = 1
@@ -54,6 +55,7 @@ ST_UNAVAILABLE = 4  # op permanently not served here (e.g. no --serve_inference)
 _HDR = struct.Struct("<BI")  # (op|status, payload_len)
 _I64 = struct.Struct("<q")
 _U32 = struct.Struct("<I")
+_U16 = struct.Struct("<H")
 
 
 def pack_batch(blobs: list[bytes | bytearray]) -> list[bytes | bytearray]:
@@ -84,6 +86,95 @@ def unpack_batch(payload: bytes) -> list[memoryview]:
     return out
 
 
+def _pack_shard_req(have_version: int, keys, base_version: int,
+                    accept_delta: bool) -> bytearray:
+    """OP_GET_WEIGHTS_SHARDED request:
+    [i64 have][i64 base][u8 flags][u32 nkeys]{[u16 klen][key]}*nkeys.
+    nkeys=0 means every manifest shard."""
+    keys = keys or ()
+    req = bytearray(_I64.size * 2 + 1 + _U32.size)
+    _I64.pack_into(req, 0, have_version)
+    _I64.pack_into(req, 8, base_version)
+    req[16] = 1 if accept_delta else 0
+    _U32.pack_into(req, 17, len(keys))
+    for key in keys:
+        kb = key.encode()
+        req += _U16.pack(len(kb)) + kb
+    return req
+
+
+def _parse_shard_req(payload) -> tuple[int, list[str] | None, int, int]:
+    have = _I64.unpack_from(payload, 0)[0]
+    base = _I64.unpack_from(payload, 8)[0]
+    flags = payload[16]
+    (nkeys,) = _U32.unpack_from(payload, 17)
+    keys: list[str] | None = None
+    off = 21
+    if nkeys:
+        keys = []
+        for _ in range(nkeys):
+            (klen,) = _U16.unpack_from(payload, off)
+            off += _U16.size
+            keys.append(bytes(payload[off:off + klen]).decode())
+            off += klen
+    return have, keys, base, flags
+
+
+def _pack_shard_reply(version: int, mbytes: bytes, shards
+                      ) -> tuple[list, int, int, int]:
+    """OP_GET_WEIGHTS_SHARDED reply payload as `_send_msg` parts (the
+    multi-MB shard blobs are never concatenated host-side):
+    [i64 version][u32 mlen][manifest][u32 n]
+    then per shard [u16 klen][key][u8 enc][i64 base][u32 blen][bytes].
+    Returns (parts, payload_bytes, n_full, n_delta, n_skip)."""
+    from distributed_reinforcement_learning_tpu.runtime import weight_shards
+
+    parts: list = [_I64.pack(version), _U32.pack(len(mbytes)), mbytes,
+                   _U32.pack(len(shards))]
+    nbytes = nfull = ndelta = nskip = 0
+    for key, enc, base, blob in shards:
+        kb = key.encode()
+        parts.append(_U16.pack(len(kb)) + kb + bytes([enc]) + _I64.pack(base)
+                     + _U32.pack(len(blob)))
+        if len(blob):
+            parts.append(blob)
+        nbytes += len(blob)
+        nfull += enc == weight_shards.ENC_FULL
+        ndelta += enc == weight_shards.ENC_DELTA
+        nskip += enc == weight_shards.ENC_SKIP
+    return parts, nbytes, nfull, ndelta, nskip
+
+
+def _parse_shard_reply(resp) -> tuple[int, bytes, list]:
+    """Inverse of `_pack_shard_reply`; shard payloads are zero-copy
+    views into `resp` (a fresh buffer per `_recv_msg`)."""
+    view = memoryview(resp)
+    version = _I64.unpack_from(view, 0)[0]
+    (mlen,) = _U32.unpack_from(view, 8)
+    off = 12
+    mbytes = bytes(view[off:off + mlen])
+    off += mlen
+    (n,) = _U32.unpack_from(view, off)
+    off += _U32.size
+    shards = []
+    for _ in range(n):
+        (klen,) = _U16.unpack_from(view, off)
+        off += _U16.size
+        key = bytes(view[off:off + klen]).decode()
+        off += klen
+        enc = view[off]
+        off += 1
+        base = _I64.unpack_from(view, off)[0]
+        off += _I64.size
+        (blen,) = _U32.unpack_from(view, off)
+        off += _U32.size
+        shards.append((key, enc, base, view[off:off + blen]))
+        off += blen
+    if off != len(view):
+        raise ValueError(f"shard reply length mismatch: {off} != {len(view)}")
+    return version, mbytes, shards
+
+
 class TransportError(ConnectionError):
     pass
 
@@ -95,6 +186,14 @@ class InferenceUnavailableError(RuntimeError):
     loop swallows those as transient outages, but a misconfigured
     learner never recovers — this must fail fast with the real cause.
     """
+
+
+class ShardedWeightsUnavailableError(RuntimeError):
+    """OP_GET_WEIGHTS_SHARDED permanently unserved here: the learner's
+    store publishes whole blobs (gate off, or an old server replying
+    ST_ERROR to the unknown op). Deliberately NOT a TransportError —
+    the caller must demote to the whole-blob op, not treat the learner
+    as a transient outage."""
 
 
 class InferenceBusyError(RuntimeError):
@@ -283,6 +382,9 @@ class TransportServer(_LockedStatsMixin):
         # per-connection serve threads would otherwise lose increments.
         self.stats = {"unrolls_accepted": 0, "busy_replies": 0,
                       "partial_accepts": 0, "weight_sends": 0,
+                      "weight_bytes_sent": 0, "shard_sends": 0,
+                      "shard_bytes_sent": 0, "shard_full_sends": 0,
+                      "shard_delta_sends": 0, "shard_skip_sends": 0,
                       "acts_served": 0, "act_busy_replies": 0}
         self._stats_lock = threading.Lock()
 
@@ -560,8 +662,40 @@ class TransportServer(_LockedStatsMixin):
                         _send_msg(conn, ST_OK, _I64.pack(have))
                     else:
                         self._bump("weight_sends")
+                        self._bump("weight_bytes_sent", len(blob))
                         conn_version = version
                         _send_msg(conn, ST_OK, _I64.pack(version), blob)
+                elif op == OP_GET_WEIGHTS_SHARDED:
+                    # Shard-scoped pull (runtime/weight_shards.py):
+                    # manifest + the requested shards, each FULL, a
+                    # byte-range DELTA against the client's base
+                    # version, or elided entirely when unchanged since
+                    # that base. Version-identity semantics match
+                    # OP_GET_WEIGHTS exactly. ST_UNAVAILABLE when this
+                    # store publishes whole blobs — the client demotes
+                    # to the old op permanently.
+                    if not getattr(self.weights, "sharded", False):
+                        _send_msg(conn, ST_UNAVAILABLE)
+                    else:
+                        have, keys, base, flags = _parse_shard_req(payload)
+                        got = self.weights.get_sharded(
+                            have, keys=keys, base_version=base,
+                            accept_delta=bool(flags & 1))
+                        if got is None:
+                            conn_version = have
+                            _send_msg(conn, ST_OK, _I64.pack(have))
+                        else:
+                            version, mbytes, shards = got
+                            parts, nbytes, nfull, ndelta, nskip = \
+                                _pack_shard_reply(version, mbytes, shards)
+                            with self._stats_lock:
+                                self.stats["shard_sends"] += 1
+                                self.stats["shard_bytes_sent"] += nbytes
+                                self.stats["shard_full_sends"] += nfull
+                                self.stats["shard_delta_sends"] += ndelta
+                                self.stats["shard_skip_sends"] += nskip
+                            conn_version = version
+                            _send_msg(conn, ST_OK, *parts)
                 elif op == OP_ACT:
                     # Own RuntimeError handling: an inference failure (e.g.
                     # weights not published yet) must reply ST_ERROR, not
@@ -794,6 +928,29 @@ class TransportClient(_LockedStatsMixin):
         self._bump("weight_pulls")
         return codec.decode(resp[_I64.size :], copy=True), version
 
+    def get_weights_sharded(self, have_version: int, keys=None,
+                            base_version: int = -2,
+                            accept_delta: bool = False
+                            ) -> tuple[int, bytes, list] | None:
+        """Raw shard-scoped pull (OP_GET_WEIGHTS_SHARDED): None on
+        version identity, else (version, manifest_bytes, shards) with
+        shards = [(key, enc, base, payload-view), ...]. Raises
+        ShardedWeightsUnavailableError when the learner's store is not
+        sharded — callers latch over to the whole-blob op permanently
+        (ShardedRemoteWeights does; a misrouted ST_ERROR from an old
+        server means the same thing)."""
+        req = _pack_shard_req(have_version, keys, base_version, accept_delta)
+        status, resp = self._exchange(OP_GET_WEIGHTS_SHARDED, req,
+                                      retry=True, resend=True)
+        if status == ST_CLOSED:
+            raise TransportError("learner closed the data plane")
+        if status != ST_OK:
+            raise ShardedWeightsUnavailableError(
+                "endpoint does not serve sharded weight pulls")
+        if len(resp) == _I64.size:  # identity: nothing newer to carry
+            return None
+        return _parse_shard_reply(resp)
+
     def remote_act(self, request: dict, busy_retry: bool = True) -> dict:
         """SEED-style inference: ship observation rows, get action rows.
 
@@ -880,6 +1037,176 @@ class RemoteWeights:
 
     def get_if_newer(self, have_version: int) -> tuple[Any, int] | None:
         return self._client.get_weights_if_newer(have_version)
+
+
+class ShardedRemoteWeights(_LockedStatsMixin):
+    """`get_if_newer` over the shard-scoped op (runtime/weight_shards):
+    pulls the manifest + per-shard blobs, keeps a per-shard cache so
+    the next pull can receive byte-range DELTAS and skip untouched
+    shards entirely, dequantizes a bf16/int8 broadcast back to f32,
+    and assembles the pytree via `weight_shards.materialize`.
+
+    Demotes PERMANENTLY to the whole-blob op on the first
+    ST_UNAVAILABLE/ST_ERROR (the learner's store is not sharded, or an
+    old server), so pre-shard topologies pay one round trip at startup
+    and nothing after. Any cache/protocol inconsistency (a delta whose
+    base this client no longer holds) is repaired with ONE full sharded
+    pull, never an actor kill.
+
+    `keys` scopes REFRESHES to the listed shard keys after the first
+    full pull (`DRL_WEIGHTS_KEYS`): unlisted shards stay pinned at
+    their last-pulled bytes — for roles that deliberately freeze part
+    of the tree. A pinned shard materializes with the manifest entry
+    CACHED from the version its bytes came from (crc, quant scales):
+    decoding old int8 codes with the current version's scales would
+    silently drift the "frozen" leaves every pull.
+
+    Concurrency map (tools/drlint lock-discipline): `stats` is bumped
+    on the actor loop thread and polled by the telemetry flush thread
+    (accessors from _LockedStatsMixin). `_blobs`/`_cache_version`/
+    `_plain` are only ever touched by the actor loop thread — same
+    single-thread contract as BoardWeights._board."""
+
+    _GUARDED_BY = {"stats": "_stats_lock"}
+
+    telemetry_prefix = "wshard"
+
+    def __init__(self, client: TransportClient, keys=None):
+        self._client = client
+        self._keys = list(keys) if keys else None
+        self._plain = False  # permanent whole-blob demote latch
+        self._blobs: dict[str, np.ndarray] = {}
+        self._metas: dict[str, dict] = {}  # manifest entry per cached blob
+        self._cache_version = -2
+        self.stats = {"shard_pulls": 0, "shards_full": 0, "shards_delta": 0,
+                      "shards_skipped": 0, "bytes_received": 0,
+                      "repair_pulls": 0, "whole_fallbacks": 0}
+        self._stats_lock = threading.Lock()
+
+    def _resolve(self, shards):
+        """Wire shards -> (owned blob dict, cache_derived) against the
+        cache; None when the cache cannot honor a delta/skip (repair
+        with a full pull). `cache_derived` drives checksum
+        verification: blobs rebuilt from cached bases (delta/skip) are
+        the case the manifest crc exists for — a reused version number
+        against a stale cache; an all-FULL pull is plain TCP bytes."""
+        from distributed_reinforcement_learning_tpu.runtime import weight_shards
+
+        out = dict(self._blobs) if self._keys is not None else {}
+        nfull = ndelta = nskip = nbytes = 0
+        for key, enc, base, payload in shards:
+            if enc == weight_shards.ENC_FULL:
+                out[key] = np.frombuffer(bytes(payload), np.uint8)
+                nfull += 1
+                nbytes += len(payload)
+            elif enc == weight_shards.ENC_DELTA:
+                if base != self._cache_version or key not in self._blobs:
+                    return None
+                out[key] = weight_shards.delta_apply(self._blobs[key], payload)
+                ndelta += 1
+                nbytes += len(payload)
+            elif enc == weight_shards.ENC_SKIP:
+                if base != self._cache_version or key not in self._blobs:
+                    return None
+                out[key] = self._blobs[key]
+                nskip += 1
+            else:
+                return None
+        with self._stats_lock:
+            self.stats["shards_full"] += nfull
+            self.stats["shards_delta"] += ndelta
+            self.stats["shards_skipped"] += nskip
+            self.stats["bytes_received"] += nbytes
+        return out, (ndelta + nskip) > 0
+
+    def _merged_manifest(self, mbytes, shards) -> dict:
+        """Parse the pulled manifest; with role-scoped `keys`, PINNED
+        shards (absent from this reply) swap in the manifest entry
+        cached from the version their bytes came from — crc and quant
+        scales must describe the cached blob, not the current one."""
+        from distributed_reinforcement_learning_tpu.runtime import weight_shards
+
+        manifest = weight_shards.parse_manifest(mbytes)
+        if self._keys is None:
+            return manifest
+        refreshed = {k for k, _, _, _ in shards}
+        manifest["shards"] = [
+            sh if sh["key"] in refreshed or sh["key"] not in self._metas
+            else self._metas[sh["key"]]
+            for sh in manifest["shards"]]
+        return manifest
+
+    def get_if_newer(self, have_version: int) -> tuple[Any, int] | None:
+        from distributed_reinforcement_learning_tpu.runtime import weight_shards
+
+        if self._plain:
+            return self._client.get_weights_if_newer(have_version)
+        t0 = time.perf_counter()
+        keys = self._keys if self._cache_version >= 0 else None
+        try:
+            got = self._client.get_weights_sharded(
+                have_version, keys=keys,
+                base_version=self._cache_version, accept_delta=True)
+        except ShardedWeightsUnavailableError:
+            self._plain = True
+            self._bump("whole_fallbacks")
+            return self._client.get_weights_if_newer(have_version)
+        if got is None:
+            if _OBS.enabled:
+                _OBS.gauge("actor/weight_pull_ms",
+                           (time.perf_counter() - t0) * 1e3)
+            return None
+        version, mbytes, shards = got
+        params = blobs = manifest = None
+        resolved = self._resolve(shards)
+        if resolved is not None:
+            blobs, derived = resolved
+            try:
+                manifest = self._merged_manifest(mbytes, shards)
+                # Checksums run only for cache-DERIVED pulls (delta/
+                # skip): that is where a reused version number against
+                # a stale cache can silently mispair bytes. An all-FULL
+                # pull is plain framed TCP, and a crc pass would re-read
+                # every transferred byte for nothing.
+                params = weight_shards.materialize(manifest, blobs,
+                                                   verify=derived)
+            except (KeyError, ValueError):
+                # Checksum/coverage failure: the cache paired a stale
+                # blob with a reused version number (restarted learner
+                # republishing from 0 — version IDENTITY has no global
+                # uniqueness). Repair below.
+                params = None
+        if params is None:
+            # ONE full sharded pull (no deltas, no elision) repairs any
+            # cache inconsistency; a second failure is a real server
+            # fault and surfaces as a ConnectionError for the actor's
+            # elastic-grace loop.
+            self._bump("repair_pulls")
+            self._blobs, self._metas, self._cache_version = {}, {}, -2
+            got = self._client.get_weights_sharded(have_version)
+            if got is None:
+                return None
+            version, mbytes, shards = got
+            resolved = self._resolve(shards)
+            if resolved is None:
+                raise TransportError("sharded weight pull unresolvable "
+                                     "after a full repair pull")
+            blobs, _ = resolved
+            try:
+                manifest = weight_shards.parse_manifest(mbytes)
+                params = weight_shards.materialize(manifest, blobs,
+                                                   verify=False)
+            except (KeyError, ValueError) as e:
+                raise TransportError(
+                    f"sharded weight pull corrupt after repair: {e}") from e
+        self._blobs = blobs
+        self._metas = {sh["key"]: sh for sh in manifest["shards"]}
+        self._cache_version = version
+        self._bump("shard_pulls")
+        if _OBS.enabled:
+            _OBS.gauge("actor/weight_pull_ms", (time.perf_counter() - t0) * 1e3)
+            _OBS.gauge("actor/weight_version", version)
+        return params, version
 
 
 class RemoteInference:
@@ -1318,6 +1645,14 @@ def run_role(
                            jax.process_index() if multihost else 0, run_dir):
             _OBS.sample("transport/queue_depth", queue.size)
             _OBS.sample("learner/weight_version", lambda: weights.version)
+            if weights.sharded:
+                # Sharded-publication counters (obs_report's "Weight
+                # sharding" subsection): per-publish changed-shard
+                # bytes, quant savings, delta encodes.
+                for key in weights.shard_stats():
+                    _OBS.sample(f"weights/{key}",
+                                lambda k=key: weights.shard_stat(k),
+                                kind="counter")
             # The server's cumulative stats (unrolls_accepted,
             # busy_replies, weight_sends, ...) become report throughput
             # via counter providers — no second hot-path counter. The
@@ -1400,8 +1735,15 @@ def run_role(
         # Publish-once weight plane: when the launcher named a board, a
         # weight pull becomes a shared-memory version peek (no syscall)
         # plus one memcpy only when the version actually changed. Attach
-        # failure or a dead board falls back to TCP pulls.
-        actor_weights: Any = RemoteWeights(client)
+        # failure or a dead board falls back to TCP pulls. The TCP pull
+        # itself is shard-scoped when the learner publishes per shard
+        # (manifest + changed shards only; ShardedRemoteWeights demotes
+        # itself to the whole-blob op against an un-sharded store), and
+        # DRL_WEIGHTS_KEYS scopes this role's refreshes to named shards.
+        from distributed_reinforcement_learning_tpu.runtime import weight_shards
+
+        actor_weights: Any = ShardedRemoteWeights(
+            client, keys=weight_shards.role_keys())
         board_name = os.environ.get("DRL_SHM_WEIGHTS_NAME")
         if board_name:
             from distributed_reinforcement_learning_tpu.runtime import weight_board
@@ -1443,9 +1785,12 @@ def run_role(
                     _OBS.sample(f"ring/{key}",
                                 lambda k=key: actor_queue.stat(k),
                                 kind="counter")
-            if hasattr(actor_weights, "snapshot_stats"):  # BoardWeights only
+            if hasattr(actor_weights, "snapshot_stats"):
+                # "board/" for BoardWeights, "wshard/" for the TCP
+                # shard-scoped pull surface (telemetry_prefix attr).
+                wprefix = getattr(actor_weights, "telemetry_prefix", "board")
                 for key in actor_weights.snapshot_stats():
-                    _OBS.sample(f"board/{key}",
+                    _OBS.sample(f"{wprefix}/{key}",
                                 lambda k=key: actor_weights.stat(k),
                                 kind="counter")
             if hasattr(remote, "snapshot_stats"):  # RemoteActService only

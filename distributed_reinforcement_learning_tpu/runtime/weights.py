@@ -29,6 +29,16 @@ that hold them across unrolls, so rewriting a reused buffer two
 publishes later would corrupt weights mid-use. The allocation is one
 np.empty (lazily paged) per publish; the layout walk and header build
 are cached per schema.
+
+SHARDED publication (`DRL_WEIGHTS_SHARDED`, runtime/weight_shards.py):
+the store splits the pytree along its partition-rule shards
+(parallel/partition.py — the axes the learner's mesh shards over) into
+per-shard encode-once blobs plus one json manifest, optionally casting
+the actor-bound bytes to bf16/int8 at encode time (the f32 master copy
+and in-process views never quantize) and delta-encoding changed shards
+between consecutive versions. The board then memcpys only shards whose
+bytes changed; the TCP server serves the shard-scoped op; `get_blob()`
+keeps old whole-blob clients working by re-encoding lazily per version.
 """
 
 from __future__ import annotations
@@ -41,6 +51,7 @@ import numpy as np
 
 from distributed_reinforcement_learning_tpu.data import codec
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+from distributed_reinforcement_learning_tpu.runtime import weight_shards
 
 
 def _freeze(arr: np.ndarray) -> np.ndarray:
@@ -92,6 +103,14 @@ class WeightStore:
         "_version": "_lock",
         "_applied_seq": "_lock",
         "_board": "_lock",
+        "_manifest": "_lock",
+        "_manifest_bytes": "_lock",
+        "_bcast": "_lock",
+        "_prev_bcast": "_lock",
+        "_prev_version": "_lock",
+        "_changed": "_lock",
+        "_deltas": "_lock",
+        "_shard_stats": "_lock",
         "_seq": ("_async_lock", "_cond"),
         "_pending": ("_async_lock", "_cond"),
         "_busy": ("_async_lock", "_cond"),
@@ -99,12 +118,41 @@ class WeightStore:
         "_worker": ("_async_lock", "_cond"),
     }
 
-    def __init__(self):
+    def __init__(self, sharded: bool | None = None,
+                 quant: str | None = None):
         self._lock = threading.Lock()
         self._params: Any = None
         self._blob: np.ndarray | None = None
         self._version: int = -1
         self._board = None  # optional shm WeightBoard (attach_board)
+        # Sharded publication (runtime/weight_shards.py): per-shard
+        # encode-once broadcast blobs + a json manifest instead of one
+        # whole blob. `sharded` is PUBLIC — the transport server
+        # consults it to answer ST_UNAVAILABLE for the shard-scoped op
+        # before the first publish (manifest presence alone can't
+        # distinguish "not sharded" from "not published yet").
+        self.sharded = (weight_shards.sharded_enabled()
+                        if sharded is None else bool(sharded))
+        # quant: None defers to the gate, "" forces off, "bf16"/"int8"
+        # force a mode (bench variants pin both knobs explicitly).
+        if not self.sharded:
+            self._quant = None
+        elif quant is None:
+            self._quant = weight_shards.quant_mode()
+        else:
+            self._quant = quant or None
+        self._delta_on = weight_shards.delta_enabled() if self.sharded else False
+        self._manifest: dict | None = None
+        self._manifest_bytes: bytes | None = None
+        self._bcast: dict[str, np.ndarray] = {}        # current broadcast blobs
+        self._prev_bcast: dict[str, np.ndarray] = {}   # previous version's
+        self._prev_version: int = -2
+        self._changed: set[str] = set()  # keys whose bytes moved last publish
+        self._deltas: dict[str, bytes] = {}  # key -> delta vs _prev_version
+        self._shard_stats = {"shard_publishes": 0, "shards_changed": 0,
+                             "broadcast_bytes": 0, "quant_bytes_saved": 0,
+                             "deltas_encoded": 0, "delta_bytes": 0,
+                             "manifest_bytes": 0}
         # Async publication: one worker drains a latest-wins pending slot.
         # Races between publishes are arbitrated by SUBMISSION order
         # (`_seq`), not by version number: versions may legitimately go
@@ -134,20 +182,38 @@ class WeightStore:
         late attach never leaves the board empty behind live actors."""
         with self._lock:
             self._board = board
-            blob, version = self._blob, self._version
-            if blob is not None:
-                self._board_publish_locked(blob, version)
+            if self._manifest is not None:
+                # Full replay: every shard must land for the late
+                # attacher, so the changed-set is conservatively "all"
+                # (which also disables unchanged-elision until the next
+                # publish — correct, since this set feeds get_sharded).
+                self._changed = set(self._bcast)
+                self._board_publish_locked(self._version)
+            elif self._blob is not None:
+                self._board_publish_locked(self._version)
 
-    def _board_publish_locked(self, blob, version: int) -> None:
+    def _board_publish_locked(self, version: int) -> None:
         # Failure latches the board off permanently (oversize blob,
-        # unmapped segment at shutdown, ...): the store must keep
-        # publishing in-process/TCP, and closing the writer side lets
-        # attached actors demote themselves to TCP pulls.
+        # unmapped segment at shutdown, a whole-blob/sharded layout
+        # mismatch, ...): the store must keep publishing in-process/TCP,
+        # and closing the writer side lets attached actors demote
+        # themselves to TCP pulls. A single oversize SHARD is NOT a
+        # board failure — the sharded board latches just that shard and
+        # readers fetch it over TCP (runtime/weight_board.py).
         board = self._board
-        if board is None or blob is None:  # None: un-encodable snapshot
+        if board is None:
             return
         try:
-            board.publish_blob(blob, version)
+            if self._manifest is not None:
+                if not hasattr(board, "publish_shards"):
+                    raise ValueError(
+                        "whole-blob board cannot carry a sharded publication")
+                board.publish_shards(version, self._manifest, self._bcast,
+                                     self._changed)
+            elif self._blob is not None:
+                board.publish_blob(self._blob, version)
+            else:
+                return  # un-encodable snapshot: nothing to mirror
         except Exception as e:  # noqa: BLE001 — board is an optimization
             self._board = None
             import sys
@@ -159,24 +225,101 @@ class WeightStore:
             print(f"[weights] WARNING: shm weight board disabled "
                   f"({e}); actors fall back to TCP pulls", file=sys.stderr)
 
-    def _apply(self, blob, host_params: Any, version: int, seq: int) -> None:
+    def _apply(self, blob, host_params: Any, version: int, seq: int,
+               bundle=None) -> None:
         with self._lock:
             applied = seq >= self._applied_seq
             if applied:
+                prev_bcast, prev_version = self._bcast, self._version
                 self._params = host_params
-                self._blob = blob
                 self._version = version
                 self._applied_seq = seq
-                self._board_publish_locked(blob, version)
+                if bundle is None:
+                    self._blob = blob
+                    self._manifest = None
+                    self._manifest_bytes = None
+                    self._bcast, self._prev_bcast = {}, {}
+                    self._deltas = {}
+                    self._changed = set()
+                else:
+                    # Sharded publication: the whole blob is rebuilt
+                    # LAZILY in get_blob() for old clients; the manifest
+                    # + per-shard broadcast blobs are the plane now.
+                    self._blob = None
+                    manifest = bundle.manifest
+                    manifest["version"] = version
+                    # Changed-shard detection, EXACT but cheap: the
+                    # manifest checksums (already paid in build_bundle)
+                    # filter first; a byte-compare runs only when
+                    # (len, crc) match — i.e. only for shards that are
+                    # genuinely unchanged, which is exactly when the
+                    # compare buys a skipped board memcpy + elided send.
+                    prev_sums = {
+                        sh["key"]: (sh["nbytes"], sh["crc"])
+                        for sh in (self._manifest or {}).get("shards", [])}
+                    changed = set()
+                    for sh in manifest["shards"]:
+                        k = sh["key"]
+                        if (prev_sums.get(k) != (sh["nbytes"], sh["crc"])
+                                or k not in prev_bcast
+                                or not np.array_equal(bundle.blobs[k],
+                                                      prev_bcast[k])):
+                            changed.add(k)
+                    deltas: dict[str, bytes] = {}
+                    if self._delta_on and prev_version >= 0:
+                        for k in changed:
+                            if k in prev_bcast:
+                                d = weight_shards.delta_encode(
+                                    bundle.blobs[k], prev_bcast[k])
+                                if d is not None:
+                                    deltas[k] = d
+                    self._prev_bcast = prev_bcast
+                    self._prev_version = prev_version
+                    self._bcast = bundle.blobs
+                    self._changed = changed
+                    self._deltas = deltas
+                    self._manifest = manifest
+                    self._manifest_bytes = weight_shards.manifest_bytes(manifest)
+                    st = self._shard_stats
+                    st["shard_publishes"] += 1
+                    st["shards_changed"] += len(changed)
+                    st["broadcast_bytes"] += sum(
+                        len(bundle.blobs[k]) for k in changed)
+                    st["quant_bytes_saved"] += max(
+                        bundle.nbytes_f32
+                        - sum(len(b) for b in bundle.blobs.values()), 0)
+                    st["deltas_encoded"] += len(deltas)
+                    st["delta_bytes"] += sum(len(d) for d in deltas.values())
+                    st["manifest_bytes"] = len(self._manifest_bytes)
+                self._board_publish_locked(version)
         # Version-landed timeline (telemetry off = one attribute read).
         if applied and _OBS.enabled:
             _OBS.gauge("weights/version", version)
 
-    def publish(self, params: Any, version: int) -> None:
-        """Store a host-side snapshot of `params` (one encode-once blob +
-        read-only views; device arrays land via the blob write)."""
+    def _snapshot(self, params: Any):
+        """-> (blob, host_params, bundle): the sharded bundle when this
+        store publishes per-shard, else the whole-blob pair. A pytree
+        the sharded path cannot carry (un-encodable leaf) falls through
+        to the whole-blob snapshot, which has its own per-leaf
+        fallback — demotion is per-publish and loss-free."""
+        if self.sharded:
+            try:
+                bundle = weight_shards.build_bundle(params, quant=self._quant)
+            except (TypeError, ValueError):
+                pass
+            else:
+                host = jax.tree.map(
+                    _freeze, codec.assemble(bundle.manifest["skel"],
+                                            list(bundle.host_leaves)))
+                return None, host, bundle
         blob, host = _host_snapshot(params)
-        self._apply(blob, host, version, self._next_seq())
+        return blob, host, None
+
+    def publish(self, params: Any, version: int) -> None:
+        """Store a host-side snapshot of `params` (encode-once blobs +
+        read-only views; device arrays land via the blob write)."""
+        blob, host, bundle = self._snapshot(params)
+        self._apply(blob, host, version, self._next_seq(), bundle)
 
     def publish_async(self, params: Any, version: int) -> None:
         """Versioned publish off the caller's critical path.
@@ -233,8 +376,8 @@ class WeightStore:
             try:
                 snap, version, seq = item
                 # The blob write here = the D2H wait, off the learn thread.
-                blob, host = _host_snapshot(snap)
-                self._apply(blob, host, version, seq)
+                blob, host, bundle = self._snapshot(snap)
+                self._apply(blob, host, version, seq, bundle)
             except Exception as e:  # drop the item, keep the worker alive —
                 # a dead worker would freeze actor weights forever while
                 # training silently continues. (stderr: stdout may carry a
@@ -277,9 +420,69 @@ class WeightStore:
         server sends these as-is (encode-once: N actors, any number of
         pulls, one encode per version); None before the first publish.
         Callers must treat the buffer as read-only — it backs the
-        published in-process views."""
+        published in-process views.
+
+        SHARDED publication keeps no whole blob around; the first
+        old-client GET_WEIGHTS of a version rebuilds one here from the
+        in-process f32 views (bit-identical to a direct encode — the
+        views are the same bytes) and caches it for the version's
+        remaining pulls. The encode runs under `_lock`: it is the
+        legacy-compat path, not the plane — new clients pull shards."""
         with self._lock:
+            if (self._blob is None and self._manifest is not None
+                    and self._params is not None):
+                try:
+                    self._blob = codec.encode(self._params, cache=True)
+                except (TypeError, ValueError):
+                    pass
             return self._blob, self._version
+
+    def get_sharded(self, have_version: int, keys=None,
+                    base_version: int = -2, accept_delta: bool = False):
+        """Shard-scoped pull: None when the caller already holds the
+        committed version (identity, like get_if_newer) or nothing
+        sharded is published; else (version, manifest_bytes, shards)
+        where shards is [(key, enc, base, payload), ...] for every
+        manifest shard in `keys` (None = all).
+
+        enc per shard (constants in runtime/weight_shards.py):
+        ENC_FULL carries the broadcast blob; with `accept_delta` and
+        `base_version` equal to the PREVIOUS published version (the
+        normal per-publish polling cadence), an untouched shard is
+        elided entirely (ENC_SKIP — the client reuses its cached blob)
+        and a changed shard may carry a byte-range delta (ENC_DELTA)
+        when one was worth encoding at publish time. Base matching is
+        by version IDENTITY, so rollback republishes stay correct."""
+        with self._lock:
+            version = self._version
+            if self._manifest is None or version < 0 or version == have_version:
+                return None
+            use_base = (accept_delta and base_version >= 0
+                        and base_version == self._prev_version)
+            shards = []
+            for sh in self._manifest["shards"]:
+                k = sh["key"]
+                if keys is not None and k not in keys:
+                    continue
+                if use_base and k not in self._changed:
+                    shards.append((k, weight_shards.ENC_SKIP, base_version, b""))
+                elif use_base and k in self._deltas:
+                    shards.append((k, weight_shards.ENC_DELTA, base_version,
+                                   self._deltas[k]))
+                else:
+                    shards.append((k, weight_shards.ENC_FULL, -1,
+                                   self._bcast[k]))
+            return version, self._manifest_bytes, shards
+
+    def shard_stats(self) -> dict:
+        """Copy of the sharded-publication counters (telemetry
+        providers, obs_report's "Weight sharding" subsection)."""
+        with self._lock:
+            return dict(self._shard_stats)
+
+    def shard_stat(self, key: str) -> int:
+        with self._lock:
+            return self._shard_stats[key]
 
     def get_if_newer(self, have_version: int) -> tuple[Any, int] | None:
         """None if the caller already holds the newest version."""

@@ -85,6 +85,17 @@ def main() -> None:
                         "path). Unset defers to the committed "
                         "benchmarks/replay_verdict.json adjudication; "
                         "see docs/performance.md 'Replay shards'")
+    p.add_argument("--weights_sharded", type=int, default=None,
+                   choices=(0, 1),
+                   help="force per-shard weight publication on (1) or "
+                        "off (0) for every role (DRL_WEIGHTS_SHARDED — "
+                        "partition-keyed shard blobs + manifest on the "
+                        "board and the shard-scoped TCP pull; pair with "
+                        "DRL_WEIGHTS_QUANT=bf16|int8 / DRL_WEIGHTS_DELTA "
+                        "for the quantized/delta broadcast). Unset "
+                        "defers to the committed "
+                        "benchmarks/weights_shard_verdict.json; see "
+                        "docs/performance.md 'Sharded weight plane'")
     p.add_argument("--staleness_budget", type=int, default=None,
                    help="bound the weight staleness actors can be observed "
                         "at (in train steps, the unit of the "
@@ -143,6 +154,14 @@ def main() -> None:
         env["DRL_REPLAY_SHARDS"] = str(max(0, args.replay_shards))
         print(f"[cluster] replay shards: "
               f"{'off (monolithic)' if args.replay_shards <= 0 else args.replay_shards}",
+              file=sys.stderr)
+    if args.weights_sharded is not None:
+        # Every role reads the same gate (learner decides what it
+        # publishes/creates, actors follow the board magic / demote on
+        # the TCP op) — exporting it cluster-wide keeps them agreeing.
+        env["DRL_WEIGHTS_SHARDED"] = str(args.weights_sharded)
+        print(f"[cluster] sharded weight publication "
+              f"{'on' if args.weights_sharded else 'off (whole-blob)'}",
               file=sys.stderr)
     if args.staleness_budget is not None:
         # Derivation from the learner/weight_staleness semantics (the

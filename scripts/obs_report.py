@@ -293,7 +293,7 @@ def build_report(tdir: str, merge: bool = True) -> str:
         for name, stats in sorted(shard.counter_rates().items()):
             if name.startswith(("staleness_bucket/", "codec/", "board/",
                                 "replay_shard/", "inference/",
-                                "remote_act/")):
+                                "remote_act/", "wshard/", "weights/")):
                 continue  # rendered as their own sections below
             any_counter = True
             out(f"  {shard_label(shard):<14} {name:<28} "
@@ -527,6 +527,64 @@ def build_report(tdir: str, merge: bool = True) -> str:
             nbytes = rates.get("board/published_bytes", {}).get("total", 0)
             out(f"  {shard_label(shard)}: board published {pubs:.0f} "
                 f"versions ({nbytes / 1e6:.1f} MB total)")
+    # Sharded weight plane (runtime/weight_shards.py): learner-side
+    # per-shard publish/quant/delta counters plus per-role shard-pull
+    # counters (TCP shard op "wshard/", board pulls fold into the board
+    # lines above). Lines appear only when a run published per shard.
+    wshard_lines: list[str] = []
+    for shard in shards:
+        rates = shard.counter_rates()
+
+        def total(key, rates=rates):
+            return rates.get(key, {}).get("total", 0)
+
+        pubs = total("weights/shard_publishes")
+        if pubs:
+            per_ver = total("weights/broadcast_bytes") / pubs
+            line = (f"  {shard_label(shard)}: {pubs:.0f} sharded publishes, "
+                    f"{total('weights/shards_changed') / pubs:.1f} shards/"
+                    f"publish, {per_ver / 1e6:.2f} MB broadcast/version")
+            if total("weights/quant_bytes_saved"):
+                line += (f", quant saved "
+                         f"{total('weights/quant_bytes_saved') / 1e6:.1f} MB")
+            if total("weights/deltas_encoded"):
+                line += (f", {total('weights/deltas_encoded'):.0f} deltas "
+                         f"({total('weights/delta_bytes') / 1e6:.2f} MB)")
+            wshard_lines.append(line)
+        sends = total("transport/shard_sends")
+        if sends:
+            # Hit rate over SHARDS served (full+delta+skip), not over
+            # replies — a 3-shard manifest sends 3 shard units per pull.
+            served = (total("transport/shard_full_sends")
+                      + total("transport/shard_delta_sends")
+                      + total("transport/shard_skip_sends"))
+            wshard_lines.append(
+                f"  {shard_label(shard)}: served {sends:.0f} shard pulls "
+                f"({total('transport/shard_bytes_sent') / 1e6:.1f} MB, "
+                f"{total('transport/shard_delta_sends'):.0f} deltas, "
+                f"{total('transport/shard_skip_sends'):.0f} unchanged "
+                f"elisions — delta hit rate "
+                f"{(total('transport/shard_delta_sends') + total('transport/shard_skip_sends')) / max(served, 1):.0%})")
+        pulls = total("wshard/shard_pulls")
+        if pulls:
+            wshard_lines.append(
+                f"  {shard_label(shard)}: {pulls:.0f} shard pulls "
+                f"({total('wshard/bytes_received') / 1e6:.1f} MB: "
+                f"{total('wshard/shards_full'):.0f} full, "
+                f"{total('wshard/shards_delta'):.0f} delta, "
+                f"{total('wshard/shards_skipped'):.0f} skipped; "
+                f"{total('wshard/repair_pulls'):.0f} repairs, "
+                f"{total('wshard/whole_fallbacks'):.0f} whole fallbacks)")
+        bpulls = total("board/shard_pulls")
+        if bpulls:
+            wshard_lines.append(
+                f"  {shard_label(shard)}: {bpulls:.0f} board shard pulls, "
+                f"{total('board/board_shard_fallbacks'):.0f} latched-shard "
+                f"tcp fills")
+    if wshard_lines:
+        any_pub = True
+        out("  -- Weight sharding --")
+        lines.extend(wshard_lines)
     if not any_pub:
         out("  (no publish/pull gauges)")
 

@@ -528,10 +528,12 @@ class TestCliAndTreeGate:
         annotations double as documentation (ISSUE 2 satellite) and
         deleting one silently disables the race check for that class."""
         expected = {
-            "runtime/transport.py": 3,   # server + client + RemoteActService
+            "runtime/transport.py": 4,   # server + client + RemoteActService
+            #                              + ShardedRemoteWeights
             "runtime/shm_ring.py": 3,    # ShmRing (doc form) + drainer + queue
             "runtime/weights.py": 1,
-            "runtime/weight_board.py": 2,  # WeightBoard (doc form) + BoardWeights
+            "runtime/weight_board.py": 3,  # WeightBoard + ShardedWeightBoard
+            #                                (doc forms) + BoardWeights
             "runtime/publishing.py": 1,  # empty-map documentation form
             "runtime/inference.py": 1,
             "runtime/serving.py": 1,     # ContinuousInferenceServer

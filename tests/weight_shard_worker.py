@@ -1,0 +1,65 @@
+"""Actor-side child process for the SHARDED weight-board two-process
+e2e test.
+
+Attaches the named segmented board through the real actor pull surface
+(`BoardWeights` over `attach_any`, TCP fallback stubbed out so the e2e
+must stay on shared memory), polls `get_if_newer` until it has seen the
+target version, and prints one JSON line with the sha1 of every pulled
+tree's canonical re-encode plus the version sequence — the parent
+asserts these match its TCP shard-scoped pulls bit-for-bit, mid-pull
+version flips included.
+Usage: python tests/weight_shard_worker.py <board_name> <target_version>
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _NoTCP:
+    """Fallback stub: the e2e must stay on the board the whole way."""
+
+    def get_weights_if_newer(self, have):
+        raise AssertionError("two-process sharded e2e fell back to TCP")
+
+    def get_weights_sharded(self, have, keys=None, base_version=-2,
+                            accept_delta=False):
+        raise AssertionError("two-process sharded e2e fell back to TCP")
+
+
+def main() -> None:
+    from distributed_reinforcement_learning_tpu.data import codec
+    from distributed_reinforcement_learning_tpu.runtime.weight_board import (
+        BoardWeights, attach_any)
+
+    name, target = sys.argv[1], int(sys.argv[2])
+    board = attach_any(name)
+    assert hasattr(board, "read_shards"), "expected a SHARDED board"
+    bw = BoardWeights(board, _NoTCP())
+    versions, digests = [], []
+    have = -1
+    deadline = time.monotonic() + 60.0
+    while have != target:
+        assert time.monotonic() < deadline, f"never saw version {target}"
+        got = bw.get_if_newer(have)
+        if got is None:
+            time.sleep(0.002)
+            continue
+        tree, have = got
+        versions.append(have)
+        # Re-encode the decoded pytree: byte-identical to the learner's
+        # canonical whole-blob encode iff the pull was bit-identical.
+        digests.append(hashlib.sha1(
+            bytes(codec.encode(tree, cache=True))).hexdigest())
+    bw.close()
+    print("SHARD_WORKER=" + json.dumps(
+        {"versions": versions, "digests": digests,
+         "stats": bw.snapshot_stats()}))
+
+
+if __name__ == "__main__":
+    main()
