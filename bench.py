@@ -73,7 +73,7 @@ def _probe_backend(timeout: float) -> tuple[str | None, str | None]:
 _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
                  "watchdog", "chunk_regressions", "transport_verdict",
                  "codec_verdict", "weights_verdict", "weights_shard_verdict",
-                 "replay_verdict", "inference_verdict")
+                 "replay_verdict", "inference_verdict", "chaos_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -2530,6 +2530,437 @@ def bench_inference_compare(cfg, n_clients: int = 4, requests: int = 64,
     return out
 
 
+# Children for bench_chaos_compare. The LEARNER child is one incarnation
+# of a fleet-supervised learner endpoint: bounded queue + WeightStore +
+# shm weight board + one shm ring per actor + FleetSupervisor, all under
+# the SAME segment names across respawns (create_or_reclaim reclaims the
+# SIGKILLed incarnation's leftovers by creator-pid), "checkpoint" = a
+# version file republished at startup. It VERIFIES every trajectory that
+# lands in the queue (crc32 over the payload leaf — the bit-identity
+# assertion) and appends verified/corrupt tallies to a stats file so the
+# counts survive its own SIGKILL. The ACTOR child is one surviving
+# member: ring PUTs + board pulls + the fleet heartbeat loop driving the
+# reattach ladders — the deployed re-promotion path, not a simulation.
+_CHAOS_LEARNER_CHILD = r"""
+import json, os, signal, sys, threading, time, zlib
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.data import fifo
+from distributed_reinforcement_learning_tpu.runtime import fleet, shm_ring, weight_board
+from distributed_reinforcement_learning_tpu.runtime.transport import TransportServer
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+(host, port, ring_names, board_name, state_path, stats_path, period) = (
+    sys.argv[1], int(sys.argv[2]), json.loads(sys.argv[3]), sys.argv[4],
+    sys.argv[5], sys.argv[6], float(sys.argv[7]))
+
+queue = fifo.TrajectoryQueue(256)
+store = WeightStore(sharded=False)
+board = weight_board.WeightBoard.create(board_name, 1 << 20)
+store.attach_board(board)
+version = 0
+if os.path.exists(state_path):  # checkpoint restore: republish, same name
+    with open(state_path) as f:
+        version = int(json.load(f)["version"])
+store.publish({"w": np.full(4096, version % 251, np.uint8),
+               "v": np.int64(version)}, version)
+drainer = shm_ring.RingDrainer(
+    [shm_ring.ShmRing.create(n, 1 << 22) for n in ring_names], queue).start()
+sup = fleet.FleetSupervisor().start()
+server = TransportServer(queue, store, host=host, port=port,
+                         fleet=sup).start()
+
+stop = threading.Event()
+signal.signal(signal.SIGTERM, lambda *a: stop.set())
+verified = corrupt = 0
+vlock = threading.Lock()
+
+def verify_loop():
+    global verified, corrupt
+    while not stop.is_set():
+        item = queue.get(timeout=0.2)
+        if item is None:
+            continue
+        try:
+            ok = int(item["crc"]) == (zlib.crc32(
+                np.ascontiguousarray(item["payload"]).tobytes()) & 0xFFFFFFFF)
+        except Exception:
+            ok = False
+        with vlock:
+            if ok:
+                verified += 1
+            else:
+                corrupt += 1
+
+vt = threading.Thread(target=verify_loop, daemon=True)
+vt.start()
+print("LEARNER_READY", os.getpid(), flush=True)
+next_pub = time.monotonic() + period
+while not stop.wait(0.05):
+    if time.monotonic() >= next_pub:
+        next_pub = time.monotonic() + period
+        version += 1
+        store.publish({"w": np.full(4096, version % 251, np.uint8),
+                       "v": np.int64(version)}, version)
+        tmp = state_path + ".tmp"  # torn-write-safe "checkpoint"
+        with open(tmp, "w") as f:
+            json.dump({"version": version}, f)
+        os.replace(tmp, state_path)
+    with vlock:
+        line = {"pid": os.getpid(), "verified": verified,
+                "corrupt": corrupt, "version": version}
+    with open(stats_path, "a") as f:
+        f.write(json.dumps(line) + "\n")
+vt.join(timeout=2.0)
+server.stop()
+sup.stop()
+drainer.stop()
+store.close()
+board.close_writer()
+board.close()
+board.unlink()
+"""
+
+_CHAOS_ACTOR_CHILD = r"""
+import json, os, sys, time, zlib
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.runtime import fleet, shm_ring, weight_board
+from distributed_reinforcement_learning_tpu.runtime.transport import (
+    RemoteQueue, RemoteWeights, TransportClient)
+
+(host, port, rank, ring_name, board_name, steps, obs_dim, secs) = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5],
+    int(sys.argv[6]), int(sys.argv[7]), float(sys.argv[8]))
+client = TransportClient(host, port)
+rq = shm_ring.attach_ring_queue(ring_name, client)
+queue = rq if rq is not None else RemoteQueue(client)
+bw = weight_board.attach_board_weights(board_name, client)
+weights = bw if bw is not None else RemoteWeights(client)
+client.connect_retries = 3  # the loop below owns outage grace from here
+hb = fleet.HeartbeatLoop(host, port, "actor", rank)
+hb.watch(rq)
+hb.watch(bw)
+hb.start()
+base = np.random.RandomState(rank).randint(
+    0, 256, (steps, obs_dim)).astype(np.uint8)
+sent = i = 0
+version = -1
+deadline = time.monotonic() + secs
+t0 = time.perf_counter()
+while time.monotonic() < deadline:
+    payload = np.roll(base, i).astype(np.uint8)
+    tree = {"payload": payload,
+            "crc": np.uint32(zlib.crc32(payload.tobytes()) & 0xFFFFFFFF)}
+    try:
+        sent += bool(queue.put(tree))
+    except (ConnectionError, OSError):
+        time.sleep(0.2)  # learner outage: ride it out (elastic grace)
+    i += 1
+    if i % 16 == 0:
+        try:
+            got = weights.get_if_newer(version)
+            if got is not None:
+                version = got[1]
+        except (ConnectionError, OSError):
+            pass
+    time.sleep(0.001)
+elapsed = time.perf_counter() - t0
+hb.stop()
+out = {"sent": sent, "elapsed": elapsed, "weight_version": version,
+       "ring_stats": queue.snapshot_stats() if rq is not None else None,
+       "board_stats": weights.snapshot_stats() if bw is not None else None,
+       "hb_stats": hb.snapshot_stats()}
+if rq is not None:
+    queue.close()
+if bw is not None:
+    weights.close()
+client.close()
+print("CHAOS_ACTOR=" + json.dumps(out), flush=True)
+"""
+
+
+def _chaos_read_stats(stats_path: str) -> dict:
+    """Per-pid last stats line of each learner incarnation (the file is
+    append-only so a SIGKILL can lose at most a torn final line)."""
+    per_pid: dict = {}
+    try:
+        with open(stats_path) as f:
+            for raw in f:
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    continue  # torn final line of a SIGKILLed incarnation
+                per_pid[rec["pid"]] = rec
+    except FileNotFoundError:
+        pass
+    return per_pid
+
+
+def bench_chaos_compare(n_actors: int = 2, secs: float = 18.0,
+                        kill_at: float = 6.0, steps: int = 16,
+                        obs_dim: int = 64, publish_period_s: float = 0.1,
+                        repromote_deadline_s: float = 15.0,
+                        dip_bound: float = 0.5, reps: int = 1) -> dict:
+    """Chaos adjudication of the elastic fleet (runtime/fleet.py): the
+    SAME real topology (learner child with shm rings + weight board +
+    fleet supervisor; actor children with ring PUTs, board pulls and the
+    heartbeat-driven reattach ladders) run twice — a quiet baseline vs a
+    chaos run that SIGKILLs the learner mid-window and immediately
+    respawns it (same segment names, creator-pid reclaim, checkpoint
+    file republished). Three assertions, all measured not assumed:
+
+    - ZERO corrupted trajectories: the learner crc32-verifies every
+      unroll that lands in its queue, across BOTH incarnations (tallies
+      persist in a stats file the SIGKILL cannot lose) — bit-identity
+      through ring and TCP paths under kill/respawn.
+    - BOUNDED throughput dip: delivered-and-verified frames/s of the
+      chaos window vs the baseline window, `dip_bound` the floor.
+    - FULL re-promotion within `repromote_deadline_s` of the respawned
+      learner serving: every actor's ring AND board reattach (counted
+      in its exit stats; latency from the parent timestamping the
+      actors' re-attach stderr lines).
+
+    The committed `benchmarks/chaos_verdict.json` records the verdict —
+    honest-negative allowed but measured, like every adjudication in
+    this repo. Probe pacing is scaled to the bench window
+    (DRL_FLEET_HB_S / DRL_REATTACH_* exported to the children);
+    production defaults are seconds-scale, same ladder."""
+    import shutil
+    import tempfile
+
+    from distributed_reinforcement_learning_tpu.runtime.shm_ring import (
+        _attach_shm)
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # Probe pacing scaled to the bench window; the ladder shape (bounded
+    # attempts, exponential backoff) is the production one.
+    env.setdefault("DRL_FLEET_HB_S", "0.25")
+    env.setdefault("DRL_REATTACH_BASE_S", "0.25")
+    env.setdefault("DRL_REATTACH_MAX_S", "1.0")
+
+    def reap(names) -> None:
+        for name in names:
+            try:
+                seg = _attach_shm(name)
+                seg.unlink()
+                seg.close()
+            except (FileNotFoundError, OSError):
+                pass
+
+    def run_variant(chaos: bool) -> dict:
+        tag = f"drlchaos-{os.getpid()}-{os.urandom(3).hex()}"
+        ring_names = [f"{tag}-r{i}" for i in range(n_actors)]
+        board_name = f"{tag}-b"
+        tmp = tempfile.mkdtemp(prefix="bench_chaos_")
+        state_path = os.path.join(tmp, "state.json")
+        stats_path = os.path.join(tmp, "learner_stats.jsonl")
+        port = _free_port()
+        learner_argv = [sys.executable, "-c", _CHAOS_LEARNER_CHILD,
+                        "127.0.0.1", str(port), json.dumps(ring_names),
+                        board_name, state_path, stats_path,
+                        str(publish_period_s)]
+        reattach_times: list = []  # (monotonic, line) from actor stderr
+        stderr_tails: dict = {}
+
+        def watch_stderr(name, proc):
+            tail = stderr_tails.setdefault(name, [])
+            for line in proc.stderr:
+                if "re-attached" in line or "re-promoted" in line:
+                    reattach_times.append((time.monotonic(), line.strip()))
+                tail.append(line)
+                del tail[:-40]
+
+        watchers: list = []
+
+        def spawn_learner():
+            proc = subprocess.Popen(learner_argv, env=env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True)
+            t = threading.Thread(target=watch_stderr, args=("learner", proc),
+                                 daemon=True)
+            t.start()
+            watchers.append(t)
+            line = proc.stdout.readline()
+            if "LEARNER_READY" not in line:
+                raise RuntimeError(
+                    f"chaos learner failed to start: "
+                    f"{''.join(stderr_tails.get('learner', []))[-500:]}")
+            return proc
+
+        learner = actors = None
+        try:
+            learner = spawn_learner()
+            actors = [subprocess.Popen(
+                [sys.executable, "-c", _CHAOS_ACTOR_CHILD, "127.0.0.1",
+                 str(port), str(i), ring_names[i], board_name, str(steps),
+                 str(obs_dim), str(secs)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True) for i in range(n_actors)]
+            for i, proc in enumerate(actors):
+                t = threading.Thread(target=watch_stderr,
+                                     args=(f"actor{i}", proc),
+                                     daemon=True)
+                t.start()
+                watchers.append(t)
+            t_ready = None
+            if chaos:
+                # Gate the kill on OBSERVED traffic, not wall clock: on a
+                # loaded 2-core host the actor child's imports+attach can
+                # exceed kill_at, and a kill landing before the actor is
+                # flowing produces a vacuous drill (the actor attaches
+                # straight to incarnation 2 and never exercises the
+                # demote/re-promote ladder it is supposed to pin).
+                t_gate = time.monotonic() + 60.0
+                while time.monotonic() < t_gate:
+                    per = _chaos_read_stats(stats_path)
+                    if sum(r["verified"] for r in per.values()) >= 50:
+                        break
+                    time.sleep(0.1)
+                else:
+                    raise RuntimeError(
+                        "chaos drill: no verified traffic within 60s — "
+                        "cannot place a meaningful kill")
+                time.sleep(kill_at)
+                learner.kill()  # SIGKILL: no atexit, segments leak until
+                learner.wait()  # the respawn's creator-pid reclaim
+                learner = spawn_learner()  # same names, same state file
+                t_ready = time.monotonic()
+            results = []
+            for proc in actors:
+                # The watcher thread is the SOLE stderr reader —
+                # communicate() here would race it for the pipe and
+                # sometimes swallow the re-attach lines the re-promote
+                # latency is computed from. The result line on stdout is
+                # tiny (one json object), so wait-then-read cannot
+                # deadlock on a full pipe.
+                proc.wait(timeout=secs + 120)
+                out_s = proc.stdout.read()
+                if proc.returncode != 0:
+                    name = f"actor{actors.index(proc)}"
+                    raise RuntimeError(
+                        f"chaos actor rc={proc.returncode}: "
+                        f"{''.join(stderr_tails.get(name, []))[-500:]}")
+                line = next(ln for ln in out_s.splitlines()
+                            if ln.startswith("CHAOS_ACTOR="))
+                results.append(json.loads(line.split("=", 1)[1]))
+            # weights_compare precedent: an actor that never attached its
+            # fast plane would ride TCP the whole window — fail the
+            # variant instead of recording a mislabeled drill. Fleet-on
+            # attach failure returns a DEMOTED-AT-BIRTH surface (stats
+            # present, zero shm traffic), so presence of the stats dict
+            # alone proves nothing: require actual shm traffic.
+            bad = [i for i, r in enumerate(results)
+                   if r["ring_stats"] is None or r["board_stats"] is None
+                   or r["ring_stats"]["unrolls_sent"] == 0
+                   or r["board_stats"]["board_pulls"] == 0]
+            if bad:
+                raise RuntimeError(
+                    f"chaos actors {bad} never exercised ring/board: "
+                    f"{''.join(stderr_tails.get(f'actor{bad[0]}', []))[-400:]}")
+        finally:
+            for proc in (actors or []):
+                if proc.poll() is None:
+                    proc.kill()
+            if learner is not None:
+                learner.terminate()
+                try:
+                    learner.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    learner.kill()
+            reap([*ring_names, board_name])
+        for t in watchers:  # drain trailing stderr before reading
+            t.join(timeout=5.0)  # reattach_times / stderr_tails
+        # Verified/corrupt tallies, summed over incarnations (per pid).
+        per_pid = _chaos_read_stats(stats_path)
+        shutil.rmtree(tmp, ignore_errors=True)
+        verified = sum(r["verified"] for r in per_pid.values())
+        corrupt = sum(r["corrupt"] for r in per_pid.values())
+        ring_reattaches = sum((r["ring_stats"] or {}).get("reattaches", 0)
+                              for r in results)
+        board_reattaches = sum((r["board_stats"] or {}).get("reattaches", 0)
+                               for r in results)
+        repromote_s = None
+        if t_ready is not None and reattach_times:
+            late = [t for t, _ in reattach_times if t >= t_ready]
+            if late:
+                repromote_s = round(max(late) - t_ready, 2)
+        return {
+            "frames_per_s": round(verified * steps / secs, 1),
+            "unrolls_verified": verified, "unrolls_corrupt": corrupt,
+            "unrolls_sent": sum(r["sent"] for r in results),
+            "incarnations": len(per_pid),
+            # Attach honesty: an actor that never attached its ring or
+            # board at startup would ride TCP the whole window and show
+            # a vacuous zero-reattach "success" — surface the count so
+            # the drill (and the committed verdict) can prove the fast
+            # plane was actually exercised (demoted-at-birth surfaces
+            # carry a stats dict with zero shm traffic, hence the
+            # traffic check, not a None check).
+            "actors_on_ring": sum(r["ring_stats"] is not None
+                                  and r["ring_stats"]["unrolls_sent"] > 0
+                                  for r in results),
+            "actors_on_board": sum(r["board_stats"] is not None
+                                   and r["board_stats"]["board_pulls"] > 0
+                                   for r in results),
+            "ring_reattaches": ring_reattaches,
+            "board_reattaches": board_reattaches,
+            "repromote_s": repromote_s,
+            "hb_stats": [r["hb_stats"] for r in results],
+            "ring_stats": [r["ring_stats"] for r in results],
+            "board_stats": [r["board_stats"] for r in results],
+        }
+
+    out: dict = {
+        "n_actors": n_actors, "window_s": secs, "kill_at_s": kill_at,
+        "dip_bound": dip_bound,
+        "repromote_deadline_s": repromote_deadline_s,
+        "note": ("real kill/respawn drill: learner child SIGKILLed "
+                 "mid-window and respawned under the SAME shm names "
+                 "(creator-pid reclaim) + checkpoint republish; actors "
+                 "ride through on the fleet heartbeat reattach ladders; "
+                 "every landed unroll crc32-verified across both "
+                 "incarnations")}
+    best_b = best_c = None
+    for _ in range(reps):
+        b = run_variant(chaos=False)
+        c = run_variant(chaos=True)
+        if best_b is None or b["frames_per_s"] > best_b["frames_per_s"]:
+            best_b = b
+        if best_c is None or c["frames_per_s"] > best_c["frames_per_s"]:
+            best_c = c
+    out["baseline"] = best_b
+    out["chaos"] = best_c
+    corrupt = best_b["unrolls_corrupt"] + best_c["unrolls_corrupt"]
+    ratio = best_c["frames_per_s"] / max(best_b["frames_per_s"], 1e-9)
+    repromoted = (best_c["ring_reattaches"] >= n_actors
+                  and best_c["board_reattaches"] >= n_actors
+                  and best_c["repromote_s"] is not None
+                  and best_c["repromote_s"] <= repromote_deadline_s)
+    out["dip_ratio"] = round(ratio, 2)
+    out["zero_corruption"] = corrupt == 0
+    out["repromoted_in_deadline"] = repromoted
+    out["chaos_pass"] = bool(corrupt == 0 and ratio >= dip_bound
+                             and repromoted)
+    rs = best_c["repromote_s"]
+    out["verdict"] = (
+        f"chaos {ratio:.2f}x baseline (bound {dip_bound}), "
+        f"{corrupt} corrupt, re-promote "
+        f"{'%.1fs' % rs if rs is not None else 'MISSING'}"
+        f"/{repromote_deadline_s:.0f}s: "
+        + ("PASS" if out["chaos_pass"] else "FAIL"))
+    print(f"[bench] chaos_compare: baseline "
+          f"{best_b['frames_per_s']:,.0f} f/s vs chaos "
+          f"{best_c['frames_per_s']:,.0f} f/s -> {out['verdict']}",
+          file=sys.stderr)
+    return out
+
+
 def bench_r2d2_learn(B: int, iters: int) -> dict:
     """R2D2 learn-step throughput (env-frames/s) at the reference replay
     shape — the training hot path that runs the fused Pallas LSTM
@@ -3406,6 +3837,20 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["replay_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] replay_compare failed: {e}", file=sys.stderr)
+
+    # Multi-process chaos drill (the elastic-fleet adjudication,
+    # runtime/fleet.py): kill+respawn the learner mid-window, assert
+    # zero corrupted trajectories, bounded throughput dip, and full
+    # re-promotion within the deadline.
+    if os.environ.get("BENCH_CHAOS", "1") == "1" and _ok("chaos_compare", 150):
+        try:
+            r = bench_chaos_compare()
+            extra["chaos_compare"] = r
+            if "verdict" in r:
+                extra["chaos_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["chaos_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] chaos_compare failed: {e}", file=sys.stderr)
 
     # Multi-process act-path client-swarm A/B (the auto-enable
     # adjudication for the inference serving tier, runtime/serving.py).

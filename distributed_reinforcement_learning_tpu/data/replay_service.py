@@ -369,6 +369,14 @@ def merge_is_weights(priorities: np.ndarray, global_total: float,
 # -- the service --------------------------------------------------------------
 
 
+class ReplayServiceEmpty(RuntimeError):
+    """sample() found no live, populated shard. Distinct from a generic
+    RuntimeError so the learner's `_train_guarded` can treat it as a
+    transient skip (a fleet-sweep `revive()` can empty the shards
+    between the caller's len() guard and its sample()) rather than a
+    learn-step fault that must propagate."""
+
+
 class ShardedReplayService:
     """N-shard replay with the monolithic backend's sampling surface.
 
@@ -465,11 +473,29 @@ class ShardedReplayService:
     def note_shard_death(self, shard: ReplayShard) -> None:
         """Ingest-side failure path: mark the shard dead; when none are
         left, latch the service unhealthy (the facade and the learner
-        both demote to the monolithic path — never back)."""
+        both demote to the monolithic path until `revive()` — the fleet
+        supervisor's bounded re-promote ladder — restarts the shards)."""
         shard.mark_dead()
         if not self.live_shards():
             with self._lock:
                 self._healthy = False
+
+    def revive(self) -> int:
+        """Restart every dead shard under a fresh epoch and re-latch the
+        service healthy — the learner-side re-promotion the fleet
+        supervisor's sweep drives (runtime/replay_shard.py). Contents of
+        a restarted shard are gone by design (replay overwrites its
+        oldest anyway; everything re-ingested starts at max priority)
+        and in-flight priority updates against the old epoch drop
+        loss-free. Returns how many shards were restarted."""
+        restarted = 0
+        for shard in self.shards:
+            if shard.mass_count()[2]:
+                shard.restart()
+                restarted += 1
+        with self._lock:
+            self._healthy = True
+        return restarted
 
     # -- sampling (learner thread) -----------------------------------------
 
@@ -490,7 +516,7 @@ class ShardedReplayService:
         global_count = sum(c for _, c, _ in stats)
         if all(dead for _, _, dead in stats) or global_count == 0 \
                 or global_total <= 0:
-            raise RuntimeError("sharded replay is empty or dead")
+            raise ReplayServiceEmpty("sharded replay is empty or dead")
         with self._lock:
             self._beta = min(1.0, self._beta + self.BETA_INCREMENT)
             beta = self._beta

@@ -21,9 +21,15 @@ per PUT is gone.
 
 Failure containment: an ingest error marks the calling thread's shard
 dead and re-routes the thread to a surviving shard; when none survive,
-the facade demotes PERMANENTLY to the real trajectory queue — the
-learner's monolithic ingest loop (still running, normally idle) takes
-over, exactly like the ring's demote-to-TCP.
+the facade demotes to the real trajectory queue — the learner's
+monolithic ingest loop (still running, normally idle) takes over,
+exactly like the ring's demote-to-TCP. Demotion is no longer
+permanent: the fleet supervisor's sweep (runtime/fleet.py) drives
+`reattach()` on a bounded RetryLadder, which `revive()`s the dead
+shards under a fresh epoch and un-latches the facade (the learner's
+`_active_replay` follows `service.healthy` back automatically); an
+exhausted ladder — shards that keep dying — restores the permanent
+demotion, logged once.
 """
 
 from __future__ import annotations
@@ -121,8 +127,11 @@ class ReplayIngestFifo:
         "_demoted": "_lock",
     }
 
+    surface_name = "replay_shards"  # fleet supervisor watch label
+
     def __init__(self, service, fallback_queue):
         from distributed_reinforcement_learning_tpu.data.fifo import blob_ingest
+        from distributed_reinforcement_learning_tpu.runtime.fleet import RetryLadder
 
         self.service = service
         self.fallback = fallback_queue
@@ -131,6 +140,47 @@ class ReplayIngestFifo:
         self._by_thread: dict[int, Any] = {}
         self._next = 0
         self._demoted = False
+        # Revive accounting burns a ladder slot on SUCCESS too, so the
+        # budget can exhaust while sharded ingest is healthy — the
+        # default "demotion is now permanent" would be wrong then.
+        self._ladder = RetryLadder(
+            "replay-shards",
+            exhausted_note="revive budget spent; the next shard death "
+                           "(if any) becomes a permanent demotion")
+
+    def reattach(self, ctx=None) -> None:
+        """Learner-side re-promotion, driven from the fleet supervisor's
+        sweep cadence: while demoted, `revive()` the service's dead
+        shards (fresh epoch, empty contents — the Ape-X overwrite
+        semantic makes that loss-equivalent) and un-latch the facade so
+        ingest threads re-map to live shards. Ladder-bounded: shards
+        that keep dying exhaust the budget and the demotion becomes
+        permanent again (logged once by the ladder)."""
+        del ctx  # learner-local: no peer identity to validate
+        with self._lock:
+            demoted = self._demoted
+        if not demoted or not self._ladder.try_acquire():
+            return
+        try:
+            revived = self.service.revive()
+        except Exception:  # noqa: BLE001 — a revive fault = failed probe
+            self._ladder.note_failure()
+            raise
+        with self._lock:
+            self._demoted = False
+            self._by_thread.clear()
+            self._next = 0
+        # Every revive CONSUMES a ladder slot (note_failure, never
+        # note_success): shard death is process-internal — unlike a
+        # respawned peer there is no external signal that the fault is
+        # gone, so a repeat offender (shards that keep dying on ingest)
+        # must burn down to the permanent latch instead of revive-die
+        # looping forever. The budget is the run's total revive count.
+        self._ladder.note_failure()
+        self._warn(f"replay shards revived ({revived} restarted); "
+                   f"sharded ingest re-promoted")
+        if _OBS.enabled:
+            _OBS.count("replay_shard/revives")
 
     def _shard_for_thread(self):
         """This thread's shard (round-robin over LIVE shards on first

@@ -44,13 +44,19 @@ class ReplayTrainMixin:
 
     def _train_guarded(self, replay):
         """`_train_once(replay)` with the service-demotion escape hatch:
-        ONLY the sharded service's own all-shards-dead RuntimeError is
-        converted to None (next train() resolves to the monolithic
-        path); any RuntimeError while the service is still healthy —
-        e.g. jax's XlaRuntimeError from the learn step, which
-        subclasses RuntimeError — propagates."""
+        the sharded service's own empty/dead signal is converted to None
+        (next train() resolves to the monolithic path, or waits for
+        re-ingest after a fleet revive emptied the shards mid-call);
+        any other RuntimeError — e.g. jax's XlaRuntimeError from the
+        learn step, which subclasses RuntimeError — propagates."""
+        from distributed_reinforcement_learning_tpu.data.replay_service import (
+            ReplayServiceEmpty)
         try:
             return self._train_once(replay)
+        except ReplayServiceEmpty:
+            if replay is self.replay:
+                raise  # not the service's signal to swallow
+            return None
         except RuntimeError:
             svc = self.replay_service
             if replay is self.replay or (svc is not None and svc.healthy):

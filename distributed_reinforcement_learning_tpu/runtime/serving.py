@@ -324,15 +324,24 @@ def run_replica(
     # to the whole-blob op otherwise; DRL_WEIGHTS_KEYS scopes this
     # replica's refreshes). BoardWeights demotes ITSELF to the TCP
     # client permanently on any board failure.
-    weights_src = ShardedRemoteWeights(client, keys=weight_shards.role_keys())
+    tcp_weights = ShardedRemoteWeights(client, keys=weight_shards.role_keys())
+    weights_src = tcp_weights
     board_name = os.environ.get("DRL_SHM_WEIGHTS_NAME")
     if board_name:
         from distributed_reinforcement_learning_tpu.runtime import weight_board
 
-        bw = weight_board.attach_board_weights(board_name, client)
+        # fallback: a demoted board keeps the shard-scoped TCP pull
+        # path (and its own reattach ladder) instead of regressing to
+        # whole-blob transfers.
+        bw = weight_board.attach_board_weights(board_name, client,
+                                               fallback=tcp_weights)
         if bw is not None:
             weights_src = bw
-            print(f"[infer {task}] shm weight board attached: {board_name}")
+            print(f"[infer {task}] shm weight board attached: {board_name}"
+                  if bw.attached else
+                  f"[infer {task}] shm weight board {board_name} "
+                  f"unavailable; starting demoted to TCP pulls "
+                  f"(reattach ladder armed)")
     agent = launch.make_agent(algo, agent_cfg, rt, actor=True)
     local = WeightStore()
     # First weights BEFORE serving: a replica that answered ST_ERROR
@@ -367,6 +376,20 @@ def run_replica(
         seed=seed + 7777 + 131 * task)
     server = TransportServer(None, local, host="0.0.0.0", port=port,
                              inference=inference).start()
+    # Fleet membership (runtime/fleet.py): register + heartbeat with the
+    # learner's supervisor; replies drive the weight surface's bounded
+    # reattach probes (a respawned learner's board/sharded op re-enters
+    # service instead of this replica staying on TCP whole-blob pulls
+    # forever). DRL_FLEET=0 disables.
+    from distributed_reinforcement_learning_tpu.runtime import fleet as fleet_mod
+
+    heartbeats = fleet_mod.start_member_loop(
+        rt, "inference", task,
+        surfaces=[s for s in (weights_src,
+                              None if tcp_weights is weights_src
+                              else tcp_weights)
+                  if hasattr(s, "reattach")],
+        version_fn=lambda: local.version)
     # Per-replica telemetry shard (obs_report "Inference serving"):
     # cumulative service counters become per-flush timelines via
     # providers polled from the telemetry flush thread.
@@ -388,6 +411,8 @@ def run_replica(
                 _OBS.sample(f"{wprefix}/{key}",
                             lambda k=key: weights_src.stat(k),
                             kind="counter")
+        if heartbeats is not None:
+            fleet_mod.register_member_telemetry(heartbeats)
     pull_s = float(os.environ.get("DRL_INFER_PULL_S", "0.2"))
     print(f"[infer {task}] serving acts on :{port} "
           f"(weights v{version} from {host}:{lport}, "
@@ -414,6 +439,8 @@ def run_replica(
                     return
             time.sleep(pull_s)
     finally:
+        if heartbeats is not None:  # stop probes before surfaces close
+            heartbeats.stop()
         server.stop()
         inference.stop()
         if hasattr(weights_src, "close"):

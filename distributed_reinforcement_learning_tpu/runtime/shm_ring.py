@@ -78,10 +78,12 @@ import time
 from typing import Any
 
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+from distributed_reinforcement_learning_tpu.runtime.fleet import ShmReattachMixin
 from distributed_reinforcement_learning_tpu.runtime.transport import _LockedStatsMixin
 
 _MAGIC = 0x52494E47  # "RING"
 _VERSION = 1
+_PID_OFF = 24  # creator pid u64 — shared with the weight-board layouts
 _HEAD_OFF = 64
 _TAIL_OFF = 128
 _PCLOSED_OFF = 192
@@ -93,6 +95,20 @@ _U64 = struct.Struct("<Q")
 _SPIN = 200          # bounded spin before the first sleep
 _SLEEP_MIN = 50e-6   # first sleep once the spin budget is burned
 _SLEEP_MAX = 1e-3    # backoff cap: worst-case wake latency
+# Confirm-before-corrupt budget for the consumer: a record-length
+# validation failure is re-checked this many times (fresh head + length
+# re-reads; the first _SPIN confirms are back-to-back, the remainder
+# sleep with the same 50us->1ms escalation as the empty-ring wait, so
+# the full budget spans ~200ms of wall clock) before the ring is
+# declared corrupt. Rationale: on some sandboxed kernels (this
+# container reports 4.4.0) a cross-process mmap read can TRANSIENTLY
+# return stale bytes — observed as a zero head word while the producer
+# was thousands of records ahead — and the old fail-fast check turned
+# that one stale read into a permanently dropped ring. A real torn
+# publish stays torn across every re-read (the ~200ms confirm cost is
+# paid once, on the way to a permanent verdict); a stale snapshot
+# heals within the window.
+_CORRUPT_CONFIRM = 400
 
 
 def _align8(n: int) -> int:
@@ -119,6 +135,65 @@ def _attach_shm(name: str):
     except Exception:  # noqa: BLE001 — tracker internals moved: worst case
         pass           # is a spurious warning at exit, never corruption
     return shm
+
+
+def pid_alive(pid: int) -> bool:
+    """Best-effort liveness for the creator-pid word (0 = unknown
+    creator, treated as not-alive: only ever consulted for a segment
+    bearing OUR name, so reclaiming an unowned homonym is correct)."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, just not ours to signal
+
+
+def segment_owner_pid(name: str) -> int:
+    """Creator pid recorded in a ring/board segment header (offset 24 in
+    every layout); 0 when unreadable/absent. The launcher's reaper keys
+    its sweep on this so it never unlinks a RESPAWNED learner's live
+    segment while reaping the dead incarnation's leftovers."""
+    try:
+        shm = _attach_shm(name)
+    except (FileNotFoundError, OSError, ValueError):
+        return 0
+    try:
+        if shm.size < _PID_OFF + 8:
+            return 0
+        return int(_U64.unpack_from(shm.buf, _PID_OFF)[0])
+    finally:
+        shm.close()
+
+
+def create_or_reclaim_shm(name: str, size: int):
+    """`SharedMemory(create=True)` that RECLAIMS a stale same-name
+    segment whose creator process is dead (the header's pid word,
+    offset 24). A SIGKILLed learner leaves its segments in /dev/shm;
+    without this, the respawned learner's create fails and the whole
+    fast plane silently stays demoted to TCP. A live creator still
+    fails the create — two learners must never share a segment name."""
+    from multiprocessing import shared_memory
+
+    try:
+        return shared_memory.SharedMemory(name=name, create=True, size=size)
+    except FileExistsError:
+        if pid_alive(segment_owner_pid(name)):
+            raise
+        import sys
+
+        try:
+            stale = _attach_shm(name)
+            stale.unlink()
+            stale.close()
+        except (FileNotFoundError, OSError):
+            pass  # raced another reaper: the name may be free now
+        print(f"[shm] reclaimed stale segment {name!r} (creator dead)",
+              file=sys.stderr)
+        return shared_memory.SharedMemory(name=name, create=True, size=size)
 
 
 class ShmRing:
@@ -150,21 +225,29 @@ class ShmRing:
         self._tail = self._read_u64(_TAIL_OFF)
         self._cached_tail = self._tail
         self._cached_head = self._head
+        # Confirm-before-corrupt state (consumer-thread-only): persists
+        # ACROSS get_blob calls so a short-timeout caller (the drainer's
+        # 0.2s polls) still accumulates toward the corrupt verdict on a
+        # genuinely torn record instead of restarting the budget every
+        # call and spinning on it forever.
+        self._suspect = 0  # consecutive failed validations at one tail
+        self._confirm_sleep = _SLEEP_MIN
 
     # -- construction -----------------------------------------------------
 
     @classmethod
     def create(cls, name: str, capacity: int) -> "ShmRing":
-        from multiprocessing import shared_memory
-
         capacity = _align8(max(capacity, 4096))
-        shm = shared_memory.SharedMemory(
-            name=name, create=True, size=_DATA_OFF + capacity)
+        # create_or_reclaim: a respawned learner re-creates its rings
+        # under the SAME names; the dead incarnation's stale segment
+        # (SIGKILL skipped the unlink) is reclaimed by creator-pid.
+        shm = create_or_reclaim_shm(name, _DATA_OFF + capacity)
         ring = cls(shm, capacity, owner=True)
         # Magic is written LAST: it is the header's commit word, so an
         # attacher racing this constructor either sees no magic (and
         # retries) or a fully-initialized header — never a zero capacity.
         ring._write_u64(8, capacity)
+        ring._write_u64(_PID_OFF, os.getpid())
         ring._write_u64(_HEAD_OFF, 0)
         ring._write_u64(_TAIL_OFF, 0)
         ring._write_u32(_PCLOSED_OFF, 0)
@@ -202,6 +285,13 @@ class ShmRing:
 
     def _write_u64(self, off: int, value: int) -> None:
         _U64.pack_into(self._buf, off, value)
+
+    @property
+    def creator_pid(self) -> int:
+        """The creating process's pid (header word): reattach probes
+        validate a reappeared segment belongs to the CURRENT learner
+        incarnation, not the dead one's un-reaped corpse."""
+        return int(self._read_u64(_PID_OFF))
 
     @property
     def producer_closed(self) -> bool:
@@ -312,18 +402,52 @@ class ShmRing:
             if n == _WRAP:
                 self._tail += to_end
                 self._write_u64(_TAIL_OFF, self._tail)
+                self._suspect = 0  # tail advanced: suspicion resolved
+                self._confirm_sleep = _SLEEP_MIN
+                continue
+            if n == 0 and self._suspect <= _CORRUPT_CONFIRM:
+                # A zero length here is almost certainly the same stale
+                # read as above (no plane ships empty blobs), and unlike
+                # an oversize length it would pass validation and DESYNC
+                # the framing. Confirm through the same budget; a zero
+                # that persists is a genuine empty record and falls
+                # through to normal consumption.
+                self._suspect += 1
+                self._cached_head = self._read_u64(_HEAD_OFF)
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None  # confirm state persists to the next call
+                if self._suspect > _SPIN:
+                    time.sleep(self._confirm_sleep)
+                    self._confirm_sleep = min(2 * self._confirm_sleep,
+                                              _SLEEP_MAX)
                 continue
             if _align8(4 + n) > to_end or \
                     self._tail + _align8(4 + n) > self._cached_head:
-                # A length that overruns the readable span can only be a
-                # corrupt/torn publish (e.g. a weakly-ordered CPU without
-                # DRL_SHM_RING forced — see the module docstring). Fail
-                # LOUDLY: the drainer drops the ring, the actor's next
-                # put sees consumer_closed and demotes to TCP.
+                # A length that overruns the readable span is EITHER a
+                # real torn publish (weakly-ordered CPU without
+                # DRL_SHM_RING forced — module docstring) or a stale
+                # cross-process read (this container's kernel: observed
+                # zero head words; _CORRUPT_CONFIRM comment). CONFIRM
+                # before the nuclear verdict: refresh the head snapshot
+                # and re-read the length; only a validation failure that
+                # SURVIVES the whole confirm budget drops the ring.
+                self._suspect += 1
+                if self._suspect <= _CORRUPT_CONFIRM:
+                    self._cached_head = self._read_u64(_HEAD_OFF)
+                    if deadline is not None and time.monotonic() >= deadline:
+                        return None  # confirm state persists to next call
+                    if self._suspect > _SPIN:
+                        time.sleep(self._confirm_sleep)
+                        self._confirm_sleep = min(2 * self._confirm_sleep,
+                                                  _SLEEP_MAX)
+                    continue
                 self.close_consumer()
                 raise RingClosed(
                     f"ring {self.name}: corrupt record length {n} at "
-                    f"tail {self._tail} (torn publish?)")
+                    f"tail {self._tail} (torn publish? confirmed "
+                    f"{_CORRUPT_CONFIRM}x)")
+            self._suspect = 0
+            self._confirm_sleep = _SLEEP_MIN
             start = _DATA_OFF + pos + 4
             blob = bytes(self._buf[start:start + n])
             self._tail += _align8(4 + n)
@@ -526,44 +650,114 @@ def serve_rings(names: list[str], queue) -> RingDrainer | None:
 # -- actor side: put surface with graceful TCP fallback ----------------------
 
 
-class RingQueue(_LockedStatsMixin):
+class RingQueue(_LockedStatsMixin, ShmReattachMixin):
     """The actor-runner queue surface (`put`/`put_many`/`size`) with the
     DATA plane on a shm ring and the CONTROL plane (queue-size polls) on
     the TCP client. Mirrors `RemoteQueue` semantics: puts block under
     backpressure, a wedged learner surfaces as ConnectionError after
     `full_timeout`, and a dead ring (consumer closed — learner gone or
-    restarted) demotes this queue to the TCP path permanently rather
-    than killing the actor.
+    restarted) demotes this queue to the TCP path rather than killing
+    the actor. Demotion is no longer permanent: `reattach()` (driven
+    from the fleet heartbeat cadence, runtime/fleet.py) re-attaches the
+    SAME ring name on a bounded RetryLadder once a respawned learner
+    re-creates the segment — validated fresh (neither side latched
+    closed) and belonging to the CURRENT learner incarnation (the
+    header's creator-pid word against the heartbeat-reported pid), so
+    the probe can never re-adopt the dead incarnation's corpse.
 
     Concurrency map (tools/drlint lock-discipline): `stats` is bumped on
     the actor loop thread and polled by the telemetry flush thread's
     providers (accessors from transport._LockedStatsMixin). `_ring` is
-    only ever touched by the actor loop thread (the fallback demotion
-    included), so it needs no lock.
+    swapped by the actor loop thread (demote/close) AND the heartbeat
+    thread (reattach install), so the reference lives under `_lock`;
+    the ring OBJECT stays actor-thread-only — the heartbeat thread only
+    installs a fresh attach it has not used, never touches an installed
+    one.
     """
 
-    _GUARDED_BY = {"stats": "_stats_lock"}
+    _GUARDED_BY = {"stats": "_stats_lock", "_ring": "_lock",
+                   "_closed": "_lock", "_stale": "_lock"}
 
-    def __init__(self, ring: ShmRing, client, full_timeout: float = 90.0):
+    surface_name = "ring"  # fleet heartbeat registration label
+
+    def __init__(self, ring: ShmRing | None, client,
+                 full_timeout: float = 90.0, name: str | None = None):
+        from distributed_reinforcement_learning_tpu.runtime.fleet import RetryLadder
+
+        self._closed = False
+        self._stale = False  # heartbeat-flagged: demote on next put
         self._ring: ShmRing | None = ring
+        self._name = name or (ring.name if ring is not None else None)
         self._client = client
         self.full_timeout = full_timeout
-        self.stats = {"unrolls_sent": 0, "bytes_sent": 0, "tcp_fallbacks": 0}
+        self._lock = threading.Lock()
+        self._ladder = RetryLadder(f"ring-{self._name}")
+        self.stats = {"unrolls_sent": 0, "bytes_sent": 0, "tcp_fallbacks": 0,
+                      "reattaches": 0}
         self._stats_lock = threading.Lock()
 
-    def _demote(self) -> None:
+    @property
+    def attached(self) -> bool:
+        """True when PUTs currently ride shared memory (False while
+        demoted to TCP — including a demoted-at-birth queue that has
+        not yet won a reattach probe)."""
+        with self._lock:
+            return self._ring is not None
+
+    def _ring_ref(self) -> ShmRing | None:
+        """The attached ring, or None — handling a heartbeat-flagged
+        STALE attachment by demoting here, on the actor thread (the
+        ring object is actor-thread-owned; the heartbeat thread never
+        closes it, only flags it)."""
+        with self._lock:
+            ring, stale = self._ring, self._stale
+        if ring is not None and stale:
+            self._demote(reason=f"ring {self._name!r} belongs to a dead "
+                                f"learner incarnation")
+            return None
+        return ring
+
+    def _demote(self, reason: str = "ring closed under the actor") -> None:
         import sys
 
-        ring, self._ring = self._ring, None
+        with self._lock:
+            ring, self._ring = self._ring, None
+            self._stale = False
         if ring is not None:
             ring.close()
         self._bump("tcp_fallbacks")
-        print("[shm_ring] WARNING: ring closed under the actor; "
-              "falling back to TCP PUTs", file=sys.stderr)
+        print(f"[shm_ring] WARNING: {reason}; "
+              f"falling back to TCP PUTs", file=sys.stderr)
 
-    def _put_blob(self, blob) -> None:
-        assert self._ring is not None
-        if not self._ring.put_blob(blob, timeout=self.full_timeout):
+    # -- reattach (fleet.ShmReattachMixin template) -----------------------
+    # The stale-attach consequence here: a SIGKILLed learner latches
+    # nothing, so the actor would otherwise keep memcpying unrolls into
+    # the dead incarnation's orphan segment forever — a trajectory
+    # black hole no put-side error ever surfaces. The actor thread
+    # demotes on its next put via _ring_ref.
+
+    _ref_attr = "_ring"
+
+    def _probe_attach(self):
+        return ShmRing.attach(self._name)
+
+    def _probe_fresh(self, ring, expect) -> bool:
+        return (not ring.consumer_closed
+                and not ring.producer_closed
+                and (expect is None or ring.creator_pid == expect))
+
+    def _on_reattached(self) -> None:
+        import sys
+
+        print(f"[shm_ring] ring {self._name!r} re-attached; PUTs back on "
+              f"shared memory", file=sys.stderr)
+
+    def reset_reattach(self) -> None:
+        """Fresh probe budget (learner epoch change)."""
+        self._ladder.reset()
+
+    def _put_blob(self, ring: ShmRing, blob) -> None:
+        if not ring.put_blob(blob, timeout=self.full_timeout):
             # Learner alive but the ring stayed full through the whole
             # window: the ring analogue of the TCP client's busy_timeout.
             raise ConnectionError(
@@ -574,12 +768,14 @@ class RingQueue(_LockedStatsMixin):
     def put(self, item: Any, timeout: float | None = None) -> bool:
         from distributed_reinforcement_learning_tpu.data import codec
 
-        if self._ring is None:
+        ring = self._ring_ref()
+        if ring is None:
             return self._client.put_trajectory(item)
         try:
             # Same dedup gating as the TCP client's trajectory PUTs: the
             # drainer's blob_ingest reconstructs before the queue.
-            self._put_blob(codec.encode(item, dedup=codec.obs_dedup_enabled()))
+            self._put_blob(ring,
+                           codec.encode(item, dedup=codec.obs_dedup_enabled()))
             return True
         except (RingClosed, ValueError):
             # ValueError = blob too large for this ring's capacity: TCP
@@ -590,13 +786,14 @@ class RingQueue(_LockedStatsMixin):
     def put_many(self, items: list[Any], timeout: float | None = None) -> int:
         from distributed_reinforcement_learning_tpu.data import codec
 
-        if self._ring is None:
+        ring = self._ring_ref()
+        if ring is None:
             return self._client.put_trajectories(items)
         sent = 0
         dedup = codec.obs_dedup_enabled()
         for item in items:
             try:
-                self._put_blob(codec.encode(item, dedup=dedup))
+                self._put_blob(ring, codec.encode(item, dedup=dedup))
                 sent += 1
             except (RingClosed, ValueError):  # dead ring / oversize blob
                 self._demote()
@@ -607,7 +804,9 @@ class RingQueue(_LockedStatsMixin):
         return self._client.queue_size()
 
     def close(self) -> None:
-        ring, self._ring = self._ring, None
+        with self._lock:
+            ring, self._ring = self._ring, None
+            self._closed = True  # a late reattach must not resurrect us
         if ring is not None:
             ring.close()
 
@@ -622,8 +821,16 @@ def attach_ring_queue(name: str, client,
     server starts accepting — so a missing segment a few seconds past
     connect almost certainly means the learner declined (creation
     failed, e.g. an undersized /dev/shm) and a long wait would only
-    delay every actor's start in an already-degraded run."""
+    delay every actor's start in an already-degraded run.
+
+    With the fleet plane on, attach failure returns a DEMOTED-AT-BIRTH
+    RingQueue (ring=None, name kept): PUTs ride TCP immediately, but
+    the queue still exposes `reattach()` so the heartbeat-driven ladder
+    can promote it once the segment appears — an actor respawned
+    DURING a learner outage must not be stranded on TCP forever."""
     import sys
+
+    from distributed_reinforcement_learning_tpu.runtime import fleet
 
     if deadline_s is None:
         deadline_s = float(os.environ.get("DRL_SHM_RING_ATTACH_S", "5"))
@@ -633,6 +840,11 @@ def attach_ring_queue(name: str, client,
             return RingQueue(ShmRing.attach(name), client)
         except (FileNotFoundError, ValueError) as e:
             if time.monotonic() >= deadline:
+                if fleet.fleet_enabled():
+                    print(f"[shm_ring] WARNING: cannot attach ring "
+                          f"{name!r} ({e}); starting demoted to TCP "
+                          f"(reattach ladder armed)", file=sys.stderr)
+                    return RingQueue(None, client, name=name)
                 print(f"[shm_ring] WARNING: cannot attach ring {name!r} "
                       f"({e}); falling back to TCP", file=sys.stderr)
                 return None

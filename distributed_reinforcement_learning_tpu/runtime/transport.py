@@ -45,6 +45,8 @@ OP_PING = 4
 OP_ACT = 5  # SEED-style remote inference (runtime/inference.py)
 OP_PUT_TRAJ_N = 6  # K unrolls per round trip (kills the per-unroll RTT)
 OP_GET_WEIGHTS_SHARDED = 7  # manifest + per-shard blobs (weight_shards)
+OP_REGISTER = 8   # fleet control plane: member registration (runtime/fleet.py)
+OP_HEARTBEAT = 9  # fleet control plane: liveness + incarnation echo
 
 ST_OK = 0
 ST_ERROR = 1
@@ -194,6 +196,22 @@ class ShardedWeightsUnavailableError(RuntimeError):
     ST_ERROR to the unknown op). Deliberately NOT a TransportError —
     the caller must demote to the whole-blob op, not treat the learner
     as a transient outage."""
+
+
+class FleetUnavailableError(RuntimeError):
+    """OP_REGISTER/OP_HEARTBEAT unserved here: the learner predates the
+    fleet supervisor or runs with DRL_FLEET=0 (an old server answers
+    ST_ERROR to the unknown op — same meaning). Deliberately NOT a
+    TransportError: the heartbeat loop must fall back to plain pings,
+    not treat the learner as a transient outage. `permanent` is True
+    for ST_UNAVAILABLE (the server explicitly has no supervisor — latch
+    immediately); ST_ERROR is ambiguous (old server vs one transient
+    supervisor fault the server's own handler calls non-fatal), so the
+    loop latches only after consecutive occurrences."""
+
+    def __init__(self, msg: str, permanent: bool = True):
+        super().__init__(msg)
+        self.permanent = permanent
 
 
 class InferenceBusyError(RuntimeError):
@@ -358,7 +376,7 @@ class TransportServer(_LockedStatsMixin):
     }
 
     def __init__(self, queue, weights, host: str = "0.0.0.0", port: int = 8000,
-                 inference=None):
+                 inference=None, fleet=None):
         # queue=None: an act-serving endpoint with no trajectory ingest
         # (an inference replica, runtime/serving.py) — PUT/QUEUE_SIZE
         # ops answer ST_UNAVAILABLE so a misrouted actor fails fast
@@ -366,6 +384,7 @@ class TransportServer(_LockedStatsMixin):
         self.queue = queue
         self.weights = weights
         self.inference = inference  # optional InferenceServer for OP_ACT
+        self.fleet = fleet  # optional FleetSupervisor for OP_REGISTER/HEARTBEAT
         self.host, self.port = host, port
         self._sock: socket.socket | None = None
         self._threads: list[threading.Thread] = []
@@ -720,6 +739,28 @@ class TransportServer(_LockedStatsMixin):
                         else:
                             self._bump("acts_served")
                             _send_msg(conn, ST_OK, codec.encode(out))
+                elif op in (OP_REGISTER, OP_HEARTBEAT):
+                    # Fleet control plane (runtime/fleet.py): tiny json
+                    # request/reply pairs on the existing framing. A
+                    # supervisor fault must answer ST_ERROR, never fall
+                    # into the queue-closed arm and kill the member's
+                    # control connection.
+                    if self.fleet is None:
+                        _send_msg(conn, ST_UNAVAILABLE)
+                    else:
+                        from distributed_reinforcement_learning_tpu.runtime import (
+                            fleet as _fleet)
+
+                        try:
+                            info = _fleet.unpack_fleet_msg(payload)
+                            reply = (self.fleet.register(info)
+                                     if op == OP_REGISTER
+                                     else self.fleet.heartbeat(info))
+                            blob = _fleet.pack_fleet_msg(reply)
+                        except Exception:  # noqa: BLE001 — malformed
+                            _send_msg(conn, ST_ERROR)  # member, not fatal
+                        else:
+                            _send_msg(conn, ST_OK, blob)
                 elif op == OP_QUEUE_SIZE:
                     _send_msg(conn, ST_OK, _I64.pack(self.queue.size()))
                 elif op == OP_PING:
@@ -999,6 +1040,45 @@ class TransportClient(_LockedStatsMixin):
         except (TransportError, OSError):
             return False
 
+    def _fleet_call(self, op: int, info: dict) -> dict:
+        """OP_REGISTER/OP_HEARTBEAT exchange (runtime/fleet.py). Raises
+        FleetUnavailableError on ST_UNAVAILABLE or ST_ERROR — an old
+        server replies ST_ERROR to the unknown op, and the heartbeat
+        loop must latch over to plain pings, not retry forever."""
+        from distributed_reinforcement_learning_tpu.runtime import fleet as _fleet
+
+        status, resp = self._exchange(op, _fleet.pack_fleet_msg(info),
+                                      retry=True, resend=True)
+        if status == ST_CLOSED:
+            raise TransportError("learner closed the data plane")
+        if status != ST_OK:
+            raise FleetUnavailableError(
+                "endpoint does not serve the fleet control plane",
+                permanent=(status == ST_UNAVAILABLE))
+        return _fleet.unpack_fleet_msg(resp)
+
+    def fleet_register(self, info: dict) -> dict:
+        return self._fleet_call(OP_REGISTER, info)
+
+    def fleet_heartbeat(self, info: dict) -> dict:
+        return self._fleet_call(OP_HEARTBEAT, info)
+
+    def abort(self) -> None:
+        """Best-effort LOCK-FREE teardown for watchdog/shutdown paths.
+        A thread stuck inside `_exchange` holds `_lock` for up to the
+        socket timeout (300s), so `close()` would block its caller
+        behind the outage that prompted the shutdown. Shutting the
+        socket down out-of-band makes the blocked recv/send raise
+        immediately; the owning thread then tears down under the lock
+        as usual. An in-flight `create_connection` cannot be
+        interrupted this way — callers must not wait on it."""
+        sock = self._sock  # drlint: disable=lock-discipline — see above
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
     def close(self) -> None:
         with self._lock:
             self._close_locked()
@@ -1046,12 +1126,19 @@ class ShardedRemoteWeights(_LockedStatsMixin):
     shards entirely, dequantizes a bf16/int8 broadcast back to f32,
     and assembles the pytree via `weight_shards.materialize`.
 
-    Demotes PERMANENTLY to the whole-blob op on the first
-    ST_UNAVAILABLE/ST_ERROR (the learner's store is not sharded, or an
-    old server), so pre-shard topologies pay one round trip at startup
-    and nothing after. Any cache/protocol inconsistency (a delta whose
-    base this client no longer holds) is repaired with ONE full sharded
-    pull, never an actor kill.
+    Demotes to the whole-blob op on the first ST_UNAVAILABLE/ST_ERROR
+    (the learner's store is not sharded, or an old server), so
+    pre-shard topologies pay one round trip at startup and nothing
+    after. The latch is re-probeable on a bounded RetryLadder
+    (runtime/fleet.py): `reattach()` — driven from the fleet heartbeat
+    cadence — clears it so the NEXT pull retries the sharded op (one
+    extra round trip per probe, on the pull cadence, never a second
+    hot-path exchange); a restarted learner that now publishes per
+    shard re-promotes this client, while a genuinely un-sharded
+    learner re-latches and the exhausted ladder restores the old
+    permanent behavior. Any cache/protocol inconsistency (a delta
+    whose base this client no longer holds) is repaired with ONE full
+    sharded pull, never an actor kill.
 
     `keys` scopes REFRESHES to the listed shard keys after the first
     full pull (`DRL_WEIGHTS_KEYS`): unlisted shards stay pinned at
@@ -1063,24 +1150,36 @@ class ShardedRemoteWeights(_LockedStatsMixin):
 
     Concurrency map (tools/drlint lock-discipline): `stats` is bumped
     on the actor loop thread and polled by the telemetry flush thread
-    (accessors from _LockedStatsMixin). `_blobs`/`_cache_version`/
-    `_plain` are only ever touched by the actor loop thread — same
-    single-thread contract as BoardWeights._board."""
+    (accessors from _LockedStatsMixin); `_plain`/`_reprobe` share that
+    lock because the fleet heartbeat thread's reattach() clears the
+    latch while the actor loop reads it. `_blobs`/`_cache_version` are
+    only ever touched by the actor loop thread — same single-thread
+    contract as BoardWeights' cache."""
 
-    _GUARDED_BY = {"stats": "_stats_lock"}
+    _GUARDED_BY = {
+        "stats": "_stats_lock",
+        "_plain": "_stats_lock",
+        "_reprobe": "_stats_lock",
+    }
 
     telemetry_prefix = "wshard"
+    surface_name = "wshard"  # fleet heartbeat registration label
 
     def __init__(self, client: TransportClient, keys=None):
+        from distributed_reinforcement_learning_tpu.runtime.fleet import RetryLadder
+
         self._client = client
         self._keys = list(keys) if keys else None
-        self._plain = False  # permanent whole-blob demote latch
+        self._plain = False    # whole-blob demote latch (ladder-probed)
+        self._reprobe = False  # a reattach probe is pending on the pull path
+        self._ladder = RetryLadder("wshard-op")
         self._blobs: dict[str, np.ndarray] = {}
         self._metas: dict[str, dict] = {}  # manifest entry per cached blob
         self._cache_version = -2
         self.stats = {"shard_pulls": 0, "shards_full": 0, "shards_delta": 0,
                       "shards_skipped": 0, "bytes_received": 0,
-                      "repair_pulls": 0, "whole_fallbacks": 0}
+                      "repair_pulls": 0, "whole_fallbacks": 0,
+                      "reattaches": 0}
         self._stats_lock = threading.Lock()
 
     def _resolve(self, shards):
@@ -1136,10 +1235,47 @@ class ShardedRemoteWeights(_LockedStatsMixin):
             for sh in manifest["shards"]]
         return manifest
 
+    def reattach(self, ctx=None) -> None:
+        """Clear the whole-blob latch (bounded ladder) so the NEXT pull
+        re-probes the sharded op. Driven from the fleet heartbeat
+        cadence; the probe itself rides the normal pull path — one
+        extra round trip against a still-unsharded learner, never a
+        hot-path reconnect storm."""
+        del ctx  # nothing shm-backed to validate: the op IS the probe
+        with self._stats_lock:
+            plain = self._plain
+        if not plain or not self._ladder.try_acquire():
+            return
+        with self._stats_lock:
+            self._plain = False
+            self._reprobe = True
+
+    def reset_reattach(self) -> None:
+        """Fresh probe budget (learner epoch change: the restarted
+        learner may publish sharded where the old one did not)."""
+        self._ladder.reset()
+
+    def _note_sharded_ok(self) -> None:
+        """The sharded op answered: if a reattach probe was pending,
+        the re-promotion is confirmed."""
+        with self._stats_lock:
+            confirmed = self._reprobe
+            self._reprobe = False
+            if confirmed:
+                self.stats["reattaches"] += 1
+        if confirmed:
+            self._ladder.note_success()
+            import sys
+
+            print("[wshard] sharded weight pulls re-promoted (learner "
+                  "serves the shard-scoped op again)", file=sys.stderr)
+
     def get_if_newer(self, have_version: int) -> tuple[Any, int] | None:
         from distributed_reinforcement_learning_tpu.runtime import weight_shards
 
-        if self._plain:
+        with self._stats_lock:
+            plain = self._plain
+        if plain:
             return self._client.get_weights_if_newer(have_version)
         t0 = time.perf_counter()
         keys = self._keys if self._cache_version >= 0 else None
@@ -1148,9 +1284,15 @@ class ShardedRemoteWeights(_LockedStatsMixin):
                 have_version, keys=keys,
                 base_version=self._cache_version, accept_delta=True)
         except ShardedWeightsUnavailableError:
-            self._plain = True
-            self._bump("whole_fallbacks")
+            with self._stats_lock:
+                self._plain = True
+                reprobe = self._reprobe
+                self._reprobe = False
+                self.stats["whole_fallbacks"] += 1
+            if reprobe:  # a failed reattach probe burns a ladder slot
+                self._ladder.note_failure()
             return self._client.get_weights_if_newer(have_version)
+        self._note_sharded_ok()
         if got is None:
             if _OBS.enabled:
                 _OBS.gauge("actor/weight_pull_ms",
@@ -1236,9 +1378,14 @@ class RemoteActService(_LockedStatsMixin):
       replica has rejected does the request back off with jitter
       (bounded by `busy_timeout`) before starting a fresh round.
     - A dead replica (TransportError/OSError after the client's own
-      bounded reconnect) is demoted PERMANENTLY — same one-way latch as
-      the ring/board demotions; replicas are cattle, a flapping one
-      must not absorb retries forever.
+      bounded reconnect) is demoted — acts skip it from that moment on,
+      so a flapping replica never absorbs act-path retries. Demotion is
+      no longer permanent, though: `reattach()` (driven from the fleet
+      heartbeat cadence, runtime/fleet.py — never the act path) pings
+      demoted endpoints on a bounded per-replica RetryLadder and
+      re-promotes one the moment it answers, so a respawned replica
+      re-enters rotation. An exhausted ladder restores the old
+      permanent latch (logged once).
     - With every replica demoted, requests fall back to the learner
       client, so pre-replica topologies (and a fully-dead tier) keep
       working exactly as before; learner failures propagate as
@@ -1270,9 +1417,17 @@ class RemoteActService(_LockedStatsMixin):
         self._dead = [False] * len(self._endpoints)
         self._rr = 0
         self.stats = {"acts": 0, "busy_failovers": 0, "replica_demotes": 0,
-                      "fallback_acts": 0}
+                      "fallback_acts": 0, "replica_repromotes": 0}
         self._stats_lock = threading.Lock()
         self._jitter = random.Random()
+        # One bounded re-promote ladder per endpoint (runtime/fleet.py);
+        # the list is immutable after construction, each ladder locks
+        # itself. Probes run from reattach() only — the fleet control
+        # cadence — never from the act path.
+        from distributed_reinforcement_learning_tpu.runtime.fleet import RetryLadder
+
+        self._ladders = [
+            RetryLadder(f"replica-{c.host}:{c.port}") for c in self._endpoints]
 
     @classmethod
     def from_addrs(cls, addrs: list[str],
@@ -1396,6 +1551,53 @@ class RemoteActService(_LockedStatsMixin):
     def live_endpoints(self) -> int:
         with self._sel_lock:
             return sum(not d for d in self._dead)
+
+    surface_name = "remote_act"  # fleet heartbeat registration label
+
+    def reattach(self, ctx=None) -> None:
+        """Probe demoted replicas (bounded per-endpoint ladder) and
+        re-promote any that answer a ping — a respawned replica
+        re-enters rotation instead of staying latched dead. Called from
+        the fleet heartbeat loop's cadence, NEVER the act path: a probe
+        against a still-dead replica costs its bounded connect attempt
+        on the control thread only."""
+        import sys
+
+        del ctx  # replicas carry no shm identity to validate
+        with self._sel_lock:
+            dead = [i for i, d in enumerate(self._dead) if d]
+        for i in dead:
+            ladder = self._ladders[i]
+            if not ladder.try_acquire():
+                continue
+            ep = self._endpoints[i]
+            # Short probe budget: the generous from_addrs budget exists
+            # for topology start; a re-promote probe must return to the
+            # control loop quickly and lean on the ladder for pacing.
+            # RESTORED afterwards — a re-promoted replica must keep its
+            # original reconnect budget on the act path, or one blip
+            # re-demotes it and the flapping burns the ladder.
+            saved_retries = ep.connect_retries
+            ep.connect_retries = 1
+            try:
+                alive = ep.ping()
+            finally:
+                ep.connect_retries = saved_retries
+            if alive:
+                with self._sel_lock:
+                    self._dead[i] = False
+                ladder.note_success()
+                self._bump("replica_repromotes")
+                print(f"[remote_act] inference replica {ep.host}:{ep.port} "
+                      f"re-promoted (answered ping)", file=sys.stderr)
+            else:
+                ladder.note_failure()
+
+    def reset_reattach(self) -> None:
+        """Fresh probe budgets (learner epoch change: the tier may have
+        been respawned wholesale)."""
+        for ladder in self._ladders:
+            ladder.reset()
 
     def close(self) -> None:
         """Close the replica clients this service owns (the fallback
@@ -1616,13 +1818,26 @@ def run_role(
             inference = InferenceServer.for_agent(algo, learner.agent, weights,
                                                   seed=seed + 7777)
             print("[learner] SEED-style inference service enabled")
+        # Fleet supervisor (runtime/fleet.py): the control-channel
+        # roster actors/replicas register + heartbeat against, the
+        # launcher's respawn loop reads, and the learner-side
+        # re-promote sweep (replay-shard revive) runs on. DRL_FLEET=0
+        # restores the pre-fleet one-way demotions.
+        from distributed_reinforcement_learning_tpu.runtime import fleet as fleet_mod
+
+        supervisor = None
+        if fleet_mod.fleet_enabled():
+            supervisor = fleet_mod.FleetSupervisor().start()
+            if replay_service is not None:
+                supervisor.watch(ingest_queue)  # ReplayIngestFifo revive
         # Each multihost learner process serves its own data plane on
         # server_port + process_index: globally unambiguous (actors pick
         # a learner via DRL_LEARNER_INDEX) and collision-free when the
         # processes share one machine (tests; single-host multi-chip).
         serve_port = rt.server_port + (jax.process_index() if multihost else 0)
         server = TransportServer(ingest_queue, weights, host="0.0.0.0",
-                                 port=serve_port, inference=inference).start()
+                                 port=serve_port, inference=inference,
+                                 fleet=supervisor).start()
         # Co-hosted actors' zero-copy data plane (runtime/shm_ring.py):
         # the launcher names one ring per co-hosted actor; this side
         # creates the segments and drains them into the same bounded
@@ -1689,6 +1904,10 @@ def run_role(
                             lambda: inference.batches_run, kind="counter")
                 _OBS.sample("inference/admission_rejects",
                             inference.admission_reject_count, kind="counter")
+            if supervisor is not None:
+                # Roster gauges + join/suspect/dead/rejoin counters —
+                # the obs_report "Fleet health" section.
+                fleet_mod.register_supervisor_telemetry(supervisor)
         print(f"[learner] serving on :{serve_port}; training {num_updates} updates")
         try:
             _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
@@ -1709,6 +1928,8 @@ def run_role(
                 inference.stop()
             if replay_service is not None:
                 replay_service.close()  # stop the update-router thread
+            if supervisor is not None:
+                supervisor.stop()
             _OBS.close()  # final shard flush + trace terminator
         print(f"[learner] done: {learner.train_steps} updates")
     elif mode == "actor":
@@ -1731,7 +1952,10 @@ def run_role(
             rq = shm_ring.attach_ring_queue(ring_name, client)
             if rq is not None:
                 actor_queue = rq
-                print(f"[actor {task}] shm ring attached: {ring_name}")
+                print(f"[actor {task}] shm ring attached: {ring_name}"
+                      if rq.attached else
+                      f"[actor {task}] shm ring {ring_name} unavailable; "
+                      f"starting demoted to TCP (reattach ladder armed)")
         # Publish-once weight plane: when the launcher named a board, a
         # weight pull becomes a shared-memory version peek (no syscall)
         # plus one memcpy only when the version actually changed. Attach
@@ -1742,16 +1966,25 @@ def run_role(
         # DRL_WEIGHTS_KEYS scopes this role's refreshes to named shards.
         from distributed_reinforcement_learning_tpu.runtime import weight_shards
 
-        actor_weights: Any = ShardedRemoteWeights(
+        tcp_weights = ShardedRemoteWeights(
             client, keys=weight_shards.role_keys())
+        actor_weights: Any = tcp_weights
         board_name = os.environ.get("DRL_SHM_WEIGHTS_NAME")
         if board_name:
             from distributed_reinforcement_learning_tpu.runtime import weight_board
 
-            bw = weight_board.attach_board_weights(board_name, client)
+            # fallback: a demoted board keeps the shard-scoped TCP pull
+            # path (and its own reattach ladder) instead of regressing
+            # to whole-blob transfers.
+            bw = weight_board.attach_board_weights(board_name, client,
+                                                   fallback=tcp_weights)
             if bw is not None:
                 actor_weights = bw
-                print(f"[actor {task}] shm weight board attached: {board_name}")
+                print(f"[actor {task}] shm weight board attached: "
+                      f"{board_name}" if bw.attached else
+                      f"[actor {task}] shm weight board {board_name} "
+                      f"unavailable; starting demoted to TCP pulls "
+                      f"(reattach ladder armed)")
         # Remote acting: with DRL_INFER_ADDRS (the launcher's replica
         # tier) acts go through RemoteActService — round-robin/least-
         # pending over the replicas, permanent demote of dead ones, the
@@ -1772,6 +2005,21 @@ def run_role(
             seed=seed + 1 + task,
             remote_act=remote,
         )
+        # Fleet membership (runtime/fleet.py): register with the
+        # learner's supervisor and heartbeat on a control connection;
+        # each reply drives the demoted surfaces' bounded reattach
+        # probes (ring, board, sharded pull, replica rotation) so a
+        # respawned learner segment or replica re-enters service
+        # instead of staying demoted forever. DRL_FLEET=0 disables.
+        from distributed_reinforcement_learning_tpu.runtime import fleet as fleet_mod
+
+        heartbeats = fleet_mod.start_member_loop(
+            rt, "actor", task,
+            surfaces=[s for s in (actor_queue, actor_weights,
+                                  None if tcp_weights is actor_weights
+                                  else tcp_weights, remote)
+                      if hasattr(s, "reattach")],
+            version_fn=lambda: getattr(actor, "_version", -1))
         # Per-actor telemetry shard (observability/): this is the half of
         # the topology the old MetricsLogger never covered (actors log
         # nothing). The client's cumulative stats become per-flush
@@ -1793,6 +2041,14 @@ def run_role(
                     _OBS.sample(f"{wprefix}/{key}",
                                 lambda k=key: actor_weights.stat(k),
                                 kind="counter")
+            if tcp_weights is not actor_weights:
+                # The board's demoted-pull fallback surface: its own
+                # wshard/ counters (demote->re-promote rows in the
+                # obs_report "Fleet health" section).
+                for key in tcp_weights.snapshot_stats():
+                    _OBS.sample(f"wshard/{key}",
+                                lambda k=key: tcp_weights.stat(k),
+                                kind="counter")
             if hasattr(remote, "snapshot_stats"):  # RemoteActService only
                 for key in remote.snapshot_stats():
                     _OBS.sample(f"remote_act/{key}",
@@ -1806,6 +2062,10 @@ def run_role(
                             kind="counter")
             _OBS.sample("actor/weight_version_held",
                         lambda: getattr(actor, "_version", -1))
+            if heartbeats is not None:
+                # fleet/heartbeats + registration/restart counters (the
+                # obs_report "Fleet health" member rows).
+                fleet_mod.register_member_telemetry(heartbeats)
         print(f"[actor {task}] connected to {server_ip}:{port}")
         # Elastic recovery (SURVEY §5.3 — the reference had none: a dead
         # learner left actors blocked forever): on transport failure the
@@ -1849,6 +2109,8 @@ def run_role(
                     s["weight_version"] = getattr(actor, "_version", None)
                     print(f"[actor {task}] stats {s}", flush=True)
         finally:
+            if heartbeats is not None:  # stop probes before surfaces close
+                heartbeats.stop()
             if hasattr(actor_queue, "close"):  # RingQueue: release the shm map
                 actor_queue.close()
             if hasattr(actor_weights, "close"):  # BoardWeights: ditto

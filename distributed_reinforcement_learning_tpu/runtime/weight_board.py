@@ -75,12 +75,17 @@ from typing import Any
 import numpy as np
 
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
-from distributed_reinforcement_learning_tpu.runtime.shm_ring import _attach_shm
+from distributed_reinforcement_learning_tpu.runtime.fleet import ShmReattachMixin
+from distributed_reinforcement_learning_tpu.runtime.shm_ring import (
+    _attach_shm,
+    create_or_reclaim_shm,
+)
 from distributed_reinforcement_learning_tpu.runtime.transport import _LockedStatsMixin
 
 _MAGIC = 0x44525742  # "DRWB"
 _MAGIC_SHARDED = 0x44525753  # "DRWS": segmented (per-shard) layout
 _VERSION = 1
+_PID_OFF = 24  # creator pid u64 — same offset as the ring layout
 _META_SEQ_OFF = 64
 _ACTIVE_OFF = 72
 _VER_OFF = 80
@@ -137,16 +142,17 @@ class WeightBoard:
 
     @classmethod
     def create(cls, name: str, slot_bytes: int) -> "WeightBoard":
-        from multiprocessing import shared_memory
-
         slot_bytes = _align8(max(slot_bytes, 4096))
-        shm = shared_memory.SharedMemory(
-            name=name, create=True, size=_DATA_OFF + 2 * slot_bytes)
+        # create_or_reclaim: a respawned learner re-creates its board
+        # under the SAME name; a dead incarnation's stale segment is
+        # reclaimed by creator-pid (runtime/shm_ring.py).
+        shm = create_or_reclaim_shm(name, _DATA_OFF + 2 * slot_bytes)
         board = cls(shm, slot_bytes, owner=True)
         # Magic is written LAST: the header's commit word (an attacher
         # racing this constructor either sees no magic and retries, or a
         # fully-initialized header — never a zero slot size).
         board._write_u64(8, slot_bytes)
+        board._write_u64(_PID_OFF, os.getpid())
         board._write_u64(_META_SEQ_OFF, 0)
         board._write_u64(_ACTIVE_OFF, 0)
         board._write_i64(_VER_OFF, -1)  # nothing published yet
@@ -191,6 +197,13 @@ class WeightBoard:
 
     def _write_i64(self, off: int, value: int) -> None:
         _I64.pack_into(self._buf, off, value)
+
+    @property
+    def creator_pid(self) -> int:
+        """The creating process's pid (header word, offset 24 in every
+        layout): reattach probes validate a reappeared board belongs to
+        the CURRENT learner incarnation."""
+        return int(self._read_u64(_PID_OFF))
 
     @property
     def writer_closed(self) -> bool:
@@ -433,15 +446,16 @@ class ShardedWeightBoard:
     @classmethod
     def create(cls, name: str, arena_bytes: int,
                mslot_bytes: int = 1 << 20) -> "ShardedWeightBoard":
-        from multiprocessing import shared_memory
-
         arena_bytes = _align64(max(arena_bytes, 1 << 16))
         mslot_bytes = _align64(mslot_bytes)
         size = _S_MSLOT_OFF + 2 * mslot_bytes + arena_bytes
-        shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+        # Same stale-segment reclaim as the classic board (respawned
+        # learner, SAME name, dead creator — runtime/shm_ring.py).
+        shm = create_or_reclaim_shm(name, size)
         board = cls(shm, arena_bytes, mslot_bytes, owner=True)
         board._write_u64(8, arena_bytes)
         board._write_u64(16, mslot_bytes)
+        board._write_u64(_PID_OFF, os.getpid())
         board._write_u64(_S_MSEQ_OFF, 0)
         board._write_u64(_S_MACT_OFF, 0)
         board._write_i64(_S_VER_OFF, -1)
@@ -476,6 +490,7 @@ class ShardedWeightBoard:
     _write_u64 = WeightBoard._write_u64
     _read_i64 = WeightBoard._read_i64
     _write_i64 = WeightBoard._write_i64
+    creator_pid = WeightBoard.creator_pid
 
     @property
     def writer_closed(self) -> bool:
@@ -795,45 +810,132 @@ def serve_board(name: str):
 # -- actor side: get_if_newer surface with graceful TCP fallback --------------
 
 
-class BoardWeights(_LockedStatsMixin):
+class BoardWeights(_LockedStatsMixin, ShmReattachMixin):
     """The actor-runner weights surface (`get_if_newer`) with the data
     plane on the shm board and the TCP client as fallback. Mirrors
     `RemoteWeights` semantics exactly — version identity (a rollback
     republish's backward version still lands), decoded owned pytrees —
-    and demotes PERMANENTLY to TCP pulls on any board failure (writer
-    latched closed at learner shutdown, a read that never stabilizes)
-    rather than killing the actor.
+    and demotes to TCP pulls on any board failure (writer latched
+    closed at learner shutdown, a read that never stabilizes) rather
+    than killing the actor. Demotion is no longer permanent:
+    `reattach()` (driven from the fleet heartbeat cadence,
+    runtime/fleet.py) re-attaches the SAME board name on a bounded
+    RetryLadder once a respawned learner re-creates it — validated
+    writer-open and belonging to the CURRENT learner incarnation (the
+    header's creator-pid word against the heartbeat-reported pid).
 
     Concurrency map (tools/drlint lock-discipline): `stats` is bumped on
     the actor loop thread and polled by the telemetry flush thread's
-    providers (accessors from transport._LockedStatsMixin). `_board` and
-    `_retries_seen` are only ever touched by the actor loop thread (the
-    fallback demotion included), so they need no lock — same contract as
-    shm_ring.RingQueue._ring.
+    providers (accessors from transport._LockedStatsMixin). `_board` is
+    swapped by the actor loop thread (demote/close) AND the heartbeat
+    thread (reattach install), so the reference lives under `_lock`;
+    the board OBJECT stays actor-thread-only, as does `_retries_seen`.
     """
 
-    _GUARDED_BY = {"stats": "_stats_lock"}
+    _GUARDED_BY = {"stats": "_stats_lock", "_board": "_lock",
+                   "_closed": "_lock", "_stale": "_lock"}
 
     telemetry_prefix = "board"
+    surface_name = "board"  # fleet heartbeat registration label
 
-    def __init__(self, board, client):
+    def __init__(self, board, client, name: str | None = None,
+                 fallback=None):
+        from distributed_reinforcement_learning_tpu.runtime.fleet import RetryLadder
+
         self._board = board  # WeightBoard | ShardedWeightBoard | None
+        self._name = name or (board.name if board is not None else None)
         self._client = client
+        # Demoted pulls ride `fallback` (a get_if_newer surface —
+        # ShardedRemoteWeights in the deployed wiring, keeping the
+        # shard-scoped/delta TCP path and DRL_WEIGHTS_KEYS scoping)
+        # when provided; the bare whole-blob client op otherwise.
+        self._fallback = fallback
+        self._lock = threading.Lock()
+        self._closed = False
+        self._stale = False  # heartbeat-flagged: demote on next pull
+        self._ladder = RetryLadder(f"board-{self._name}")
         self._retries_seen = 0
         self.stats = {"board_pulls": 0, "board_checks": 0,
                       "tcp_fallbacks": 0, "seqlock_retries": 0,
-                      "shard_pulls": 0, "board_shard_fallbacks": 0}
+                      "shard_pulls": 0, "board_shard_fallbacks": 0,
+                      "reattaches": 0}
         self._stats_lock = threading.Lock()
 
-    def _demote(self) -> None:
+    @property
+    def attached(self) -> bool:
+        """True when pulls currently ride shared memory (False while
+        demoted to TCP — including a demoted-at-birth surface that has
+        not yet won a reattach probe)."""
+        with self._lock:
+            return self._board is not None
+
+    def _board_ref(self):
+        """The attached board, or None — handling a heartbeat-flagged
+        STALE attachment by demoting here, on the actor thread (the
+        board object is actor-thread-owned; the heartbeat thread never
+        closes it, only flags it)."""
+        with self._lock:
+            board, stale = self._board, self._stale
+        if board is not None and stale:
+            self._demote(reason=f"board {self._name!r} belongs to a dead "
+                                f"learner incarnation")
+            return None
+        return board
+
+    def _tcp_pull(self, have_version: int):
+        """One demoted-path pull: the sharded TCP surface when the
+        wiring provided one (it demotes ITSELF to the whole-blob op
+        against an un-sharded store), else the whole-blob client op."""
+        if self._fallback is not None:
+            return self._fallback.get_if_newer(have_version)
+        return self._client.get_weights_if_newer(have_version)
+
+    def _demote(self, reason: str = "board closed under the actor") -> None:
         import sys
 
-        board, self._board = self._board, None
+        with self._lock:
+            board, self._board = self._board, None
+            self._stale = False
         if board is not None:
             board.close()
         self._bump("tcp_fallbacks")
-        print("[weight_board] WARNING: board closed under the actor; "
-              "falling back to TCP weight pulls", file=sys.stderr)
+        print(f"[weight_board] WARNING: {reason}; "
+              f"falling back to TCP weight pulls", file=sys.stderr)
+
+    # -- reattach (fleet.ShmReattachMixin template) -----------------------
+    # The stale-attach consequence here: a SIGKILLed learner latches no
+    # writer_closed, so reads off its orphan board would keep
+    # 'succeeding' at a frozen weight version forever. The actor thread
+    # demotes on its next pull via _board_ref. A respawned learner
+    # restores from checkpoint and republishes BEFORE serving, so the
+    # very first pull off a re-attached board already lands real
+    # weights (version identity tolerates the rollback).
+
+    _ref_attr = "_board"
+
+    def _probe_attach(self):
+        return attach_any(self._name)
+
+    def _probe_fresh(self, board, expect) -> bool:
+        return (not board.writer_closed
+                and (expect is None or board.creator_pid == expect))
+
+    def _install_extra_locked(self) -> None:
+        # Reset INSIDE the install's locked section: the actor thread
+        # can only obtain the new board ref after this block, so it can
+        # never pair the fresh board with the old incarnation's
+        # retry-counter base.
+        self._retries_seen = 0
+
+    def _on_reattached(self) -> None:
+        import sys
+
+        print(f"[weight_board] board {self._name!r} re-attached; weight "
+              f"pulls back on shared memory", file=sys.stderr)
+
+    def reset_reattach(self) -> None:
+        """Fresh probe budget (learner epoch change)."""
+        self._ladder.reset()
 
     def _fetch_latched(self, manifest: dict, blobs: dict, version: int):
         """Fill shards the board latched off (oversize) from the TCP
@@ -875,7 +977,7 @@ class BoardWeights(_LockedStatsMixin):
             self._bump("board_shard_fallbacks")
             filled = self._fetch_latched(manifest, blobs, version)
             if filled is None:
-                return self._client.get_weights_if_newer(have_version)
+                return self._tcp_pull(have_version)
             blobs = filled
         self._bump("shard_pulls")
         # Materialize inside the caller's guarded region: an assembly
@@ -890,9 +992,9 @@ class BoardWeights(_LockedStatsMixin):
     def get_if_newer(self, have_version: int) -> tuple[Any, int] | None:
         from distributed_reinforcement_learning_tpu.data import codec
 
-        board = self._board
+        board = self._board_ref()
         if board is None:
-            return self._client.get_weights_if_newer(have_version)
+            return self._tcp_pull(have_version)
         t0 = time.perf_counter()  # unconditional (see TCP client note)
         try:
             if board.writer_closed:
@@ -910,9 +1012,11 @@ class BoardWeights(_LockedStatsMixin):
                     got = (codec.decode(got[0]), got[1])
         except (BoardClosed, ValueError, KeyError):
             self._demote()
-            return self._client.get_weights_if_newer(have_version)
+            return self._tcp_pull(have_version)
         self._bump("board_checks")
-        retries = board.read_retries - self._retries_seen
+        # Clamped: a reattach swaps in a fresh board whose retry counter
+        # restarts at zero, so a raced read here must never go negative.
+        retries = max(board.read_retries - self._retries_seen, 0)
         if retries:
             self._retries_seen = board.read_retries
             self._bump("seqlock_retries", retries)
@@ -932,30 +1036,53 @@ class BoardWeights(_LockedStatsMixin):
         return params, version
 
     def close(self) -> None:
-        board, self._board = self._board, None
+        with self._lock:
+            board, self._board = self._board, None
+            self._closed = True  # a late reattach must not resurrect us
         if board is not None:
             board.close()
 
 
 def attach_board_weights(name: str, client,
-                         deadline_s: float | None = None) -> BoardWeights | None:
+                         deadline_s: float | None = None,
+                         fallback=None) -> BoardWeights | None:
     """Actor-side wiring: attach the named board with a bounded retry
     and wrap it in a BoardWeights. None = stay on plain TCP pulls.
 
     Short window on purpose (same reasoning as shm_ring's attach): this
     runs after the TransportClient connected, and the learner creates
     its board before serving — a missing segment a few seconds later
-    almost certainly means the learner declined."""
+    almost certainly means the learner declined.
+
+    With the fleet plane on, attach failure returns a DEMOTED-AT-BIRTH
+    BoardWeights (board=None, name kept): pulls ride TCP immediately,
+    but the surface still exposes `reattach()` so the heartbeat-driven
+    ladder can promote it once the segment appears — a member respawned
+    DURING a learner outage must not be stranded on TCP forever.
+
+    `fallback` (the caller's ShardedRemoteWeights in the deployed
+    wiring) is the surface demoted pulls ride — without it a demotion
+    regresses to whole-blob TCP transfers even against a learner that
+    publishes per shard."""
     import sys
+
+    from distributed_reinforcement_learning_tpu.runtime import fleet
 
     if deadline_s is None:
         deadline_s = float(os.environ.get("DRL_SHM_WEIGHTS_ATTACH_S", "5"))
     deadline = time.monotonic() + deadline_s
     while True:
         try:
-            return BoardWeights(attach_any(name), client)
+            return BoardWeights(attach_any(name), client, fallback=fallback)
         except (FileNotFoundError, ValueError) as e:
             if time.monotonic() >= deadline:
+                if fleet.fleet_enabled():
+                    print(f"[weight_board] WARNING: cannot attach board "
+                          f"{name!r} ({e}); starting demoted to TCP "
+                          f"weight pulls (reattach ladder armed)",
+                          file=sys.stderr)
+                    return BoardWeights(None, client, name=name,
+                                        fallback=fallback)
                 print(f"[weight_board] WARNING: cannot attach board "
                       f"{name!r} ({e}); falling back to TCP weight pulls",
                       file=sys.stderr)

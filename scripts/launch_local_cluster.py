@@ -14,6 +14,18 @@ the global mesh; actors are partitioned round-robin across the learners'
 data planes via DRL_LEARNER_INDEX. This is exactly the topology
 tests/test_multihost.py::test_socket_topology_two_learners_with_restart
 exercises.
+
+ELASTIC FLEET (runtime/fleet.py): `--respawn on-exit` re-spawns any
+role process that dies mid-run with the SAME command and environment —
+a respawned learner re-creates its shm segments under the same names
+(stale segments are reclaimed by creator-pid, runtime/shm_ring.py),
+restores from `--checkpoint_dir` when given, and the surviving actors'
+heartbeat-driven reattach ladders re-promote them off their TCP
+demotions. `--chaos` additionally KILLS roles mid-run on an escalation
+schedule (actor, then inference replica, then learner, every
+`--chaos_interval` seconds) — the launcher-level chaos drill
+`bench.py chaos_compare` adjudicates; it implies `--respawn chaos`
+(same respawn behavior as on-exit, plus the kill schedule).
 """
 
 from __future__ import annotations
@@ -22,11 +34,77 @@ import argparse
 import os
 import signal
 import socket
+import struct
 import subprocess
 import sys
 import threading
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# Segment-header creator-pid helpers, INLINED (mirroring
+# runtime/shm_ring.segment_owner_pid / pid_alive, the canonical
+# definitions) for the same reason as the gates below: importing the
+# package pulls jax into the launcher parent. Offset 24 carries the
+# creating pid in every ring/board layout.
+_SHM_PID_OFF = 24
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _segment_owner_pid(name: str) -> int:
+    from multiprocessing import shared_memory
+
+    try:
+        seg = shared_memory.SharedMemory(name=name)
+    except (FileNotFoundError, OSError, ValueError):
+        return 0
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
+    except Exception:  # noqa: BLE001 — tracker internals moved
+        pass
+    try:
+        if seg.size < _SHM_PID_OFF + 8:
+            return 0
+        return int(struct.unpack_from("<Q", seg.buf, _SHM_PID_OFF)[0])
+    finally:
+        seg.close()
+
+
+def _reap_segments(names, why: str) -> None:
+    """Unlink the named shm segments whose OWNING pid is dead — keyed by
+    the header's creator-pid word, never just the name: a respawned
+    learner re-creating segments under the same names must not lose
+    them to a sweep aimed at the dead incarnation's leftovers."""
+    from multiprocessing import shared_memory
+
+    for name in names:
+        owner = _segment_owner_pid(name)
+        if _pid_alive(owner):
+            continue  # a live (respawned) owner: not ours to reap
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+            print(f"[cluster] reaped leaked shm segment {name} ({why})",
+                  file=sys.stderr)
+        except FileNotFoundError:
+            pass  # the owner cleaned up, as it should
+        except OSError:
+            pass
 
 ALGO_LAUNCHER = {
     "impala": "train_impala.py", "apex": "train_apex.py", "r2d2": "train_r2d2.py",
@@ -96,6 +174,27 @@ def main() -> None:
                         "defers to the committed "
                         "benchmarks/weights_shard_verdict.json; see "
                         "docs/performance.md 'Sharded weight plane'")
+    p.add_argument("--respawn", choices=("off", "on-exit", "chaos"),
+                   default=None,
+                   help="elastic-fleet respawn policy: on-exit re-spawns "
+                        "any role process that dies mid-run with the same "
+                        "command/env (a respawned learner re-creates its "
+                        "shm segments under the same names and restores "
+                        "from --checkpoint_dir); chaos = on-exit plus the "
+                        "--chaos kill schedule. Default off (unless "
+                        "--chaos, which implies chaos)")
+    p.add_argument("--chaos", action="store_true",
+                   help="kill roles mid-run on an escalation schedule "
+                        "(actor, inference replica, learner — one each, "
+                        "--chaos_interval apart) and respawn them; the "
+                        "fleet supervisor + reattach ladders must carry "
+                        "the topology through (bench.py chaos_compare is "
+                        "the adjudicated version of this drill)")
+    p.add_argument("--chaos_interval", type=float, default=20.0,
+                   help="seconds between chaos kills (default 20)")
+    p.add_argument("--max_respawns", type=int, default=5,
+                   help="per-role respawn budget (default 5); an "
+                        "exhausted role stays down")
     p.add_argument("--staleness_budget", type=int, default=None,
                    help="bound the weight staleness actors can be observed "
                         "at (in train steps, the unit of the "
@@ -114,19 +213,50 @@ def main() -> None:
         # learner idles on an empty queue forever.
         p.error("--remote_act needs the learner to serve inference; "
                 "pass --serve_inference too")
+    respawn = args.respawn or ("chaos" if args.chaos else "off")
+    if args.chaos and respawn == "off":
+        p.error("--chaos needs a respawn policy; drop --respawn off")
+    if respawn != "off" and args.learners > 1:
+        # jax.distributed offers no single-process rejoin of a pjit
+        # group — the whole learner set restarts together (the
+        # test_multihost restart pattern), which this per-role loop
+        # cannot express.
+        p.error("--respawn needs --learners 1 (a pjit group can only "
+                "restart wholesale)")
     launcher = os.path.join(REPO, ALGO_LAUNCHER[algo])
-    procs: list[subprocess.Popen] = []
+
+    class Role:
+        """One respawnable seat of the topology: the command + env it
+        was (re)launched with, its live process, and — for learners —
+        the shm segment names it owns (the respawn loop reaps a dead
+        incarnation's leftovers by creator-pid before re-spawning)."""
+
+        def __init__(self, name: str, cmd: list[str], env: dict,
+                     kind: str, segments: tuple = ()):
+            self.name, self.cmd, self.env, self.kind = name, cmd, env, kind
+            self.segments = list(segments)
+            self.proc: subprocess.Popen | None = None
+            self.respawns = 0
+            self.done = False  # finished normally / budget exhausted
+
+    roles: list[Role] = []
     pumps: list[threading.Thread] = []
 
-    def spawn(name: str, cmd: list[str], env: dict) -> subprocess.Popen:
-        proc = subprocess.Popen(
-            cmd, cwd=REPO, env=env, text=True,
+    def spawn_proc(role: Role) -> subprocess.Popen:
+        role.proc = subprocess.Popen(
+            role.cmd, cwd=REPO, env=role.env, text=True,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        t = threading.Thread(target=_pump, args=(name, proc), daemon=True)
+        t = threading.Thread(target=_pump, args=(role.name, role.proc),
+                             daemon=True)
         t.start()
-        procs.append(proc)
         pumps.append(t)
-        return proc
+        return role.proc
+
+    def spawn(name: str, cmd: list[str], env: dict, kind: str,
+              segments: tuple = ()) -> subprocess.Popen:
+        role = Role(name, cmd, env, kind, segments)
+        roles.append(role)
+        return spawn_proc(role)
 
     base = [sys.executable, launcher, "--config", args.config,
             "--section", args.section]
@@ -272,7 +402,9 @@ def main() -> None:
             lenv["DRL_SHM_WEIGHTS_CREATE"] = board_names[pid]
         learners.append(spawn(
             f"learner{pid}" if args.learners > 1 else "learner",
-            learner_cmd, lenv))
+            learner_cmd, lenv, kind="learner",
+            segments=(*mine, *((board_names[pid],)
+                               if pid in board_names else ()))))
 
     # Inference replicas sit between the learners and the actors: each
     # serves OP_ACT on its own port, pulling weights from learner
@@ -288,7 +420,7 @@ def main() -> None:
                 "DRL_LEARNER_INDEX": str(k % args.learners)}
         if k % args.learners in board_names:
             ienv["DRL_SHM_WEIGHTS_NAME"] = board_names[k % args.learners]
-        spawn(f"infer{k}", infer_cmd, ienv)
+        spawn(f"infer{k}", infer_cmd, ienv, kind="infer")
         infer_addrs.append(f"127.0.0.1:{iport}")
     if infer_addrs:
         env["DRL_INFER_ADDRS"] = ",".join(infer_addrs)
@@ -305,67 +437,138 @@ def main() -> None:
             aenv["DRL_SHM_RING_NAME"] = ring_names[task]
         if task % args.learners in board_names:
             aenv["DRL_SHM_WEIGHTS_NAME"] = board_names[task % args.learners]
-        actor_procs.append(spawn(f"actor{task}", actor_cmd, aenv))
+        actor_procs.append(spawn(f"actor{task}", actor_cmd, aenv,
+                                 kind="actor"))
+
+    stop_evt = threading.Event()
 
     def shutdown(*_):
-        for proc in procs:
-            if proc.poll() is None:
-                proc.terminate()
+        stop_evt.set()
+        for role in roles:
+            if role.proc is not None and role.proc.poll() is None:
+                role.proc.terminate()
 
     signal.signal(signal.SIGINT, shutdown)
     signal.signal(signal.SIGTERM, shutdown)
-    # The liveness check below watches the ACTORS, not the inference
-    # replicas: replicas are a serving tier, and a topology whose actors
-    # all died must come down even while replicas idle healthily.
-    actors = actor_procs
+
+    learner_roles = [r for r in roles if r.kind == "learner"]
+    actor_roles = [r for r in roles if r.kind == "actor"]
+    infer_roles = [r for r in roles if r.kind == "infer"]
+    respawn_tally = {"learner": 0, "actor": 0, "infer": 0}
+
+    # Chaos schedule: one kill per role kind, escalating actor ->
+    # inference replica -> learner, --chaos_interval apart. SIGKILL on
+    # purpose — the drill is preemption, not polite shutdown: no atexit
+    # runs, shm segments leak until the pid-keyed reap, and the fleet
+    # supervisor must detect the death by missed heartbeats alone.
+    if args.chaos:
+        def chaos_loop() -> None:
+            seq = [r for r in (actor_roles[:1] + infer_roles[:1]
+                               + learner_roles[:1])]
+            for role in seq:
+                if stop_evt.wait(args.chaos_interval):
+                    return
+                if role.proc is not None and role.proc.poll() is None:
+                    print(f"[cluster] chaos: SIGKILL {role.name} "
+                          f"(pid {role.proc.pid})", file=sys.stderr)
+                    role.proc.kill()
+
+        threading.Thread(target=chaos_loop, daemon=True,
+                         name="chaos").start()
+
     rc = 0
-    # Wait on the whole topology: learners finishing is the normal end,
-    # but every actor dying while the learner idles (e.g. misconfigured
-    # envs) must also tear the run down rather than hang forever.
-    while any(proc.poll() is None for proc in learners):
-        if actors and all(proc.poll() is not None for proc in actors):
-            print("[cluster] all actors exited; shutting down", file=sys.stderr)
+    # Wait on the whole topology: learners finishing (exit 0) is the
+    # normal end; with respawn on, any other death re-spawns the seat
+    # (same cmd/env) until its budget runs out. A learner respawn first
+    # reaps the dead incarnation's shm segments BY CREATOR-PID — the
+    # new learner re-creates the same names, and a name-keyed sweep
+    # here would race it and unlink the live segments.
+    while not stop_evt.is_set():
+        for role in roles:
+            code = role.proc.poll() if role.proc is not None else None
+            if code is None or role.done:
+                continue
+            if code == 0:
+                # Clean exit is completion for EVERY role, not a death:
+                # a learner trained out, an actor ended its grace window
+                # — respawning either would churn processes and inflate
+                # the respawn tally until the budget exhausted.
+                role.done = True
+                continue
+            if (respawn != "off" and role.respawns < args.max_respawns
+                    and not all(r.done for r in learner_roles)):
+                # The learner-completion re-check keeps a role that died
+                # in the SAME poll pass the (earlier-listed) learner
+                # finished in from being respawned just to be SIGTERMed
+                # by the shutdown below.
+                role.respawns += 1
+                respawn_tally[role.kind] += 1
+                if role.kind == "learner":
+                    _reap_segments(role.segments, "pre-respawn")
+                print(f"[cluster] respawning {role.name} "
+                      f"(exit {code}, attempt {role.respawns}/"
+                      f"{args.max_respawns})", file=sys.stderr)
+                spawn_proc(role)
+            else:
+                role.done = True
+                if role.kind == "learner":
+                    # A signal-killed learner (negative returncode) is a
+                    # failure, not exit 0: the shell's 128+sig convention.
+                    rc = max(rc, 128 - code if code < 0 else code)
+        if all(r.done for r in learner_roles):
+            break
+        # The liveness check watches the ACTORS, not the inference
+        # replicas: replicas are a serving tier, and a topology whose
+        # actors all died for good (respawn off, or budget exhausted —
+        # either way the loop above marked them done) must come down
+        # rather than hang while the learner idles.
+        if actor_roles and all(r.done for r in actor_roles):
+            print("[cluster] all actors exited; shutting down",
+                  file=sys.stderr)
             rc = 1
             break
         try:
             signal.sigtimedwait([signal.SIGCHLD], 1.0)
         except (AttributeError, InterruptedError):
-            import time
-
             time.sleep(1.0)
-    for proc in learners:
-        code = proc.poll()
-        if code is None:
-            continue
-        # A signal-killed learner (negative returncode) is a failure,
-        # not exit 0: map to the shell's 128+sig convention.
-        rc = max(rc, 128 - code if code < 0 else code)
     shutdown()  # bring everything down
-    for proc in procs:
+    for role in roles:
+        if role.proc is None:
+            continue
         try:
-            proc.wait(timeout=10)
+            role.proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            proc.kill()
+            role.proc.kill()
+            # Reap the SIGKILLed child: a zombie still passes the shm
+            # sweep's _pid_alive check below, which would skip every
+            # segment the dead learner owned.
+            try:
+                role.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+    # An interrupted run (operator SIGINT/SIGTERM -> stop_evt) must not
+    # exit 0: map a learner seat whose FINAL incarnation did not finish
+    # cleanly to the shell's 128+sig convention, exactly like the
+    # in-loop budget-exhausted branch. (Chaos-mode mid-run SIGKILLs are
+    # consumed by the respawn branch and never reach here — the final
+    # incarnation trains to completion and reports 0.)
+    for role in learner_roles:
+        code = role.proc.poll() if role.proc is not None else None
+        if code is not None and code != 0:
+            rc = max(rc, 128 - code if code < 0 else code)
     for t in pumps:
         # Drain the relay threads: without the join, the children's final
         # lines (e.g. the learner's "done: N updates") race sys.exit.
         t.join(timeout=5.0)
+    if sum(respawn_tally.values()):
+        print(f"[cluster] respawn tally: {respawn_tally}", file=sys.stderr)
     # Shm reaper: the learner unlinks its segments (rings AND weight
     # boards) on a clean stop, but a SIGKILLed/crashed learner leaves
-    # them in /dev/shm — sweep every name this launch created,
-    # best-effort, after the children are dead.
-    for name in [*ring_names.values(), *board_names.values()]:
-        try:
-            from multiprocessing import shared_memory
-
-            seg = shared_memory.SharedMemory(name=name)
-            seg.close()
-            seg.unlink()
-            print(f"[cluster] reaped leaked shm segment {name}", file=sys.stderr)
-        except FileNotFoundError:
-            pass  # the learner cleaned up, as it should
-        except OSError:
-            pass
+    # them in /dev/shm — sweep every name this launch created, KEYED BY
+    # OWNING PID (never just the name prefix), best-effort, after the
+    # children are dead.
+    _reap_segments([*ring_names.values(), *board_names.values()],
+                   "final sweep")
     sys.exit(rc)
 
 

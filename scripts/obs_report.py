@@ -293,7 +293,8 @@ def build_report(tdir: str, merge: bool = True) -> str:
         for name, stats in sorted(shard.counter_rates().items()):
             if name.startswith(("staleness_bucket/", "codec/", "board/",
                                 "replay_shard/", "inference/",
-                                "remote_act/", "wshard/", "weights/")):
+                                "remote_act/", "wshard/", "weights/",
+                                "fleet/")):
                 continue  # rendered as their own sections below
             any_counter = True
             out(f"  {shard_label(shard):<14} {name:<28} "
@@ -420,6 +421,80 @@ def build_report(tdir: str, merge: bool = True) -> str:
         out("")
         out("-- Replay shards (ingest-time prioritization) --")
         lines.extend(shard_lines)
+
+    # Fleet health (runtime/fleet.py): the learner shard carries the
+    # roster gauges (alive/suspect/dead over time) + the supervisor's
+    # join/rejoin/death/respawn event counters; member shards carry
+    # heartbeat counters and per-surface demote -> re-promote tallies.
+    # The heartbeat latency p50/p99 comes from the `heartbeat` trace
+    # span each member's loop records. Section only appears when a run
+    # had the fleet plane on.
+    fleet_lines: list[str] = []
+    for shard in shards:
+        rates = shard.counter_rates()
+        alive = shard.gauge_stats("fleet/alive")
+        if alive is not None:  # the supervisor (learner) side
+
+            def total(key, rates=rates):
+                return rates.get(key, {}).get("total", 0)
+
+            suspect = shard.gauge_stats("fleet/suspect")
+            dead = shard.gauge_stats("fleet/dead")
+            fleet_lines.append(
+                f"  {shard_label(shard)}: roster last {alive['last']:.0f} "
+                f"alive / {suspect['last'] if suspect else 0:.0f} suspect "
+                f"/ {dead['last'] if dead else 0:.0f} dead  (peak "
+                f"{alive['max']:.0f} alive)")
+            fleet_lines.append(
+                f"    [{sparkline(shard.series.get('fleet/alive', []))}]")
+            fleet_lines.append(
+                f"    events: {total('fleet/joins'):.0f} joins, "
+                f"{total('fleet/rejoins'):.0f} rejoins, "
+                f"{total('fleet/suspects'):.0f} suspects, "
+                f"{total('fleet/deaths'):.0f} deaths, "
+                f"{total('fleet/respawns'):.0f} respawns, "
+                f"{total('fleet/heartbeats'):.0f} heartbeats served")
+    hb_rows = [r for r in rows if r["stage"] == "heartbeat"]
+    for r in hb_rows:
+        fleet_lines.append(
+            f"  {r['proc']}: heartbeat p50 {r['p50_ms']:.2f}ms  "
+            f"p99 {r['p99_ms']:.2f}ms  ({r['count']} beats)")
+    for shard in shards:
+        rates = shard.counter_rates()
+        beats = rates.get("fleet/heartbeats")
+        if beats is None or shard.gauge_stats("fleet/alive") is not None:
+            continue  # supervisor shard: fleet/heartbeats is the SERVED
+            # tally, already rendered on the events line above — the
+            # member-counter row would misread it as member beats.
+
+        def total(key, rates=rates):
+            return rates.get(key, {}).get("total", 0)
+
+        fleet_lines.append(
+            f"  {shard_label(shard)}: {beats['total']:.0f} heartbeats, "
+            f"{total('fleet/heartbeat_failures'):.0f} failures, "
+            f"{total('fleet/registrations'):.0f} registrations, "
+            f"{total('fleet/learner_restarts'):.0f} learner restarts seen")
+        # Demote -> re-promote per surface: the demote counters live in
+        # each surface's own stats (tcp_fallbacks / whole_fallbacks /
+        # replica_demotes), re-promotions in the new `reattaches` /
+        # `replica_repromotes` counters registered under the same
+        # prefixes.
+        pairs = (("ring", "ring/tcp_fallbacks", "ring/reattaches"),
+                 ("board", "board/tcp_fallbacks", "board/reattaches"),
+                 ("wshard", "wshard/whole_fallbacks", "wshard/reattaches"),
+                 ("remote_act", "remote_act/replica_demotes",
+                  "remote_act/replica_repromotes"))
+        surf = [f"{label} {total(dem):.0f}->{total(rep):.0f}"
+                for label, dem, rep in pairs
+                if total(dem) or total(rep)]
+        if surf:
+            fleet_lines.append(
+                f"    demote->re-promote: {'  '.join(surf)}")
+    if fleet_lines:
+        out("")
+        out("-- Fleet health (supervisor + heartbeats) --")
+        lines.extend(fleet_lines)
 
     # Inference serving (runtime/inference.py + runtime/serving.py):
     # per-service act throughput, batch occupancy, admission rejects and

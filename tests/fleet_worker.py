@@ -1,0 +1,177 @@
+"""Subprocess workers for tests/test_fleet.py's two-process kill drills.
+
+Deliberately training-free (no agents, no learn step): the fleet tests
+pin CONTROL-PLANE semantics — registration, heartbeat liveness, learner
+kill + checkpoint-restore + same-name shm re-creation, replica kill +
+re-entry into RemoteActService rotation — and a full training learner
+would only add minutes of jit warmup around the same transport surface.
+
+Modes:
+
+  learner <port> <ring_name|-> <board_name|-> <ckpt_path> <stats_path>
+      A fleet-supervised learner endpoint: bounded queue + encode-once
+      stub weight store + (optionally) shm weight board and one shm
+      ring, FleetSupervisor on the transport server. Restores its
+      version from <ckpt_path> (json) when present and republishes on
+      the SAME board name — the learner-restart-survival contract.
+      Every trajectory landing in the queue is crc32-verified
+      (bit-identity through the queue); tallies append to <stats_path>
+      as json lines so a SIGKILL cannot lose them. Runs until SIGTERM.
+
+  replica <port>
+      A queue-less act-serving endpoint (stub inference: echoes the
+      request row count) — enough surface for RemoteActService demote/
+      re-promote drills without jax act adapters. Runs until SIGTERM.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.data import codec, fifo
+from distributed_reinforcement_learning_tpu.runtime import fleet, shm_ring, weight_board
+from distributed_reinforcement_learning_tpu.runtime.transport import TransportServer
+
+
+class StubStore:
+    """The slice of WeightStore the transport server + board need,
+    jax-free: encode-once blobs, version identity, board mirroring."""
+
+    sharded = False
+
+    def __init__(self, board=None):
+        self._lock = threading.Lock()
+        self._blob = None
+        self._version = -1
+        self._board = board
+
+    def publish(self, params, version: int) -> None:
+        blob = codec.encode(params)
+        with self._lock:
+            self._blob, self._version = blob, version
+            if self._board is not None:
+                try:
+                    self._board.publish_blob(blob, version)
+                except ValueError:
+                    self._board = None
+
+    def get_blob(self):
+        with self._lock:
+            return self._blob, self._version
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def get(self):
+        with self._lock:
+            blob = self._blob
+            return (None if blob is None else codec.decode(blob, copy=True),
+                    self._version)
+
+
+def run_learner(port: int, ring_name: str, board_name: str,
+                ckpt_path: str, stats_path: str) -> None:
+    queue = fifo.TrajectoryQueue(128)
+    board = None
+    if board_name != "-":
+        board = weight_board.WeightBoard.create(board_name, 1 << 20)
+    store = StubStore(board)
+    version = 0
+    if os.path.exists(ckpt_path):  # checkpoint restore: republish as-is
+        with open(ckpt_path) as f:
+            version = int(json.load(f)["version"])
+    store.publish({"w": np.full(256, version % 251, np.uint8),
+                   "v": np.int64(version)}, version)
+    drainer = None
+    if ring_name != "-":
+        drainer = shm_ring.RingDrainer(
+            [shm_ring.ShmRing.create(ring_name, 1 << 20)], queue).start()
+    sup = fleet.FleetSupervisor().start()
+    server = TransportServer(queue, store, host="127.0.0.1", port=port,
+                             fleet=sup).start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    counts = {"verified": 0, "corrupt": 0}
+    lock = threading.Lock()
+
+    def verify_loop() -> None:
+        while not stop.is_set():
+            item = queue.get(timeout=0.2)
+            if item is None:
+                continue
+            try:
+                ok = int(item["crc"]) == (zlib.crc32(np.ascontiguousarray(
+                    item["payload"]).tobytes()) & 0xFFFFFFFF)
+            except Exception:  # noqa: BLE001 — anything mangled = corrupt
+                ok = False
+            with lock:
+                counts["verified" if ok else "corrupt"] += 1
+
+    threading.Thread(target=verify_loop, daemon=True).start()
+    print("LEARNER_READY", os.getpid(), flush=True)
+    while not stop.wait(0.1):
+        version += 1
+        store.publish({"w": np.full(256, version % 251, np.uint8),
+                       "v": np.int64(version)}, version)
+        tmp = ckpt_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": version}, f)
+        os.replace(tmp, ckpt_path)
+        with lock:
+            line = dict(counts, pid=os.getpid(), version=version)
+        with open(stats_path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    server.stop()
+    sup.stop()
+    if drainer is not None:
+        drainer.stop()
+    if board is not None:
+        board.close_writer()
+        board.close()
+        board.unlink()
+
+
+class StubInference:
+    """OP_ACT surface: echo the request's row count (enough to prove
+    which endpoint served an act)."""
+
+    def submit(self, request: dict) -> dict:
+        rows = int(np.asarray(request["rows"]).shape[0])
+        return {"served_by": np.int64(os.getpid()),
+                "n": np.int64(rows)}
+
+
+def run_replica(port: int) -> None:
+    store = StubStore()
+    store.publish({"w": np.zeros(8, np.uint8)}, 0)
+    server = TransportServer(None, store, host="127.0.0.1", port=port,
+                             inference=StubInference()).start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    print("REPLICA_READY", os.getpid(), flush=True)
+    while not stop.wait(0.2):
+        pass
+    server.stop()
+
+
+def main() -> None:
+    mode = sys.argv[1]
+    if mode == "learner":
+        run_learner(int(sys.argv[2]), sys.argv[3], sys.argv[4],
+                    sys.argv[5], sys.argv[6])
+    elif mode == "replica":
+        run_replica(int(sys.argv[2]))
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,7 @@ _TRIMMED = {
     "BENCH_ANAKIN": "0", "BENCH_ANAKIN_R2D2": "0",
     "BENCH_TRANSPORT": "0", "BENCH_CODEC": "0", "BENCH_WEIGHTS": "0",
     "BENCH_WEIGHTS_SHARD": "0", "BENCH_REPLAY": "0", "BENCH_INFER": "0",
+    "BENCH_CHAOS": "0",
 }
 
 
@@ -408,6 +409,61 @@ class TestInferenceCompare:
         assert replica_count() == 3  # env force wins over the verdict
         monkeypatch.setenv("DRL_INFER_REPLICAS", "0")
         assert replica_count() == 0
+
+
+class TestChaosCompare:
+    """bench_chaos_compare: the kill/respawn drill adjudicating the
+    elastic fleet (runtime/fleet.py) — baseline vs learner-SIGKILL
+    window over the REAL ring+board+heartbeat topology. Driven directly
+    at a tiny config; the committed adjudication lives in
+    benchmarks/chaos_verdict.json."""
+
+    def test_section_shape_and_verdict(self):
+        bench = _load_bench()
+        # Window sized for a loaded 2-core host: the kill is gated on
+        # observed verified traffic (so a slow-starting actor child
+        # cannot make the drill vacuous) and lands kill_at seconds
+        # after, leaving the respawned incarnation a multi-second
+        # re-promote runway inside the actor's window.
+        r = bench.bench_chaos_compare(n_actors=1, secs=10.0, kill_at=1.5,
+                                      steps=4, obs_dim=8,
+                                      repromote_deadline_s=10.0)
+        for side in ("baseline", "chaos"):
+            assert r[side]["unrolls_verified"] > 0, r
+            assert r[side]["unrolls_corrupt"] == 0, r
+        # The chaos window really crossed a learner restart: two
+        # incarnations tallied, and the surviving actor's ring AND
+        # board ladders each re-promoted at least once.
+        assert r["chaos"]["incarnations"] == 2, r
+        assert r["chaos"]["ring_reattaches"] >= 1, r
+        assert r["chaos"]["board_reattaches"] >= 1, r
+        assert r["zero_corruption"] is True
+        assert r["dip_ratio"] > 0
+        assert r["chaos_pass"] == (
+            r["zero_corruption"] and r["dip_ratio"] >= r["dip_bound"]
+            and r["repromoted_in_deadline"])
+        assert r["verdict"].startswith("chaos ") and (
+            "PASS" in r["verdict"] or "FAIL" in r["verdict"])
+
+    def test_compact_line_carries_chaos_verdict_key(self):
+        bench = _load_bench()
+        assert "chaos_verdict" in bench._COMPACT_KEYS
+        # The trimmed env the failure-mode subprocess tests run under
+        # must gate this (multi-process) section off.
+        assert _TRIMMED["BENCH_CHAOS"] == "0"
+
+    def test_committed_verdict_file_consistent(self):
+        """The committed chaos adjudication parses and is internally
+        consistent (pass flag == its three measured sub-verdicts)."""
+        verdict = json.loads(
+            (REPO / "benchmarks" / "chaos_verdict.json").read_text())
+        assert isinstance(verdict["chaos_pass"], bool)
+        assert verdict["chaos_pass"] == (
+            verdict["zero_corruption"]
+            and verdict["dip_ratio"] >= verdict["dip_bound"]
+            and verdict["repromoted_in_deadline"])
+        assert verdict["chaos"]["incarnations"] == 2
+        assert verdict["repromote_deadline_s"] > 0
 
 
 class TestDeviceChunkGate:

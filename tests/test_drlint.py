@@ -542,6 +542,8 @@ class TestCliAndTreeGate:
             "data/replay_service.py": 2,  # ReplayShard + ShardedReplayService
             "runtime/replay_shard.py": 1,  # ReplayIngestFifo
             "data/native.py": 1,
+            "runtime/fleet.py": 3,       # RetryLadder + FleetSupervisor
+            #                              + HeartbeatLoop
         }
         for rel, want in expected.items():
             src = (PKG / rel).read_text()

@@ -1,0 +1,657 @@
+"""Elastic fleet supervisor (runtime/fleet.py): registration, liveness,
+and the re-promote ladders that undo the PR-3/5/6/7/8 one-way demotions.
+
+The two-process drills kill REAL processes over real TCP + real shm:
+a learner SIGKILLed mid-run and respawned under the SAME segment names
+(creator-pid reclaim) with a checkpoint republish, while the surviving
+actor side re-promotes off its TCP demotions with zero corrupted
+trajectories; an inference replica killed and respawned re-enters
+RemoteActService rotation. Workers live in tests/fleet_worker.py —
+training-free on purpose (control-plane semantics, not learn math).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import zlib
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from distributed_reinforcement_learning_tpu.data import codec, fifo
+from distributed_reinforcement_learning_tpu.runtime import fleet, shm_ring, weight_board
+from distributed_reinforcement_learning_tpu.runtime.transport import (
+    RemoteActService,
+    TransportClient,
+    TransportServer,
+)
+
+_WORKER = Path(__file__).parent / "fleet_worker.py"
+REPO = Path(__file__).parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DRL_FLEET_HB_S"] = "0.15"
+    env["DRL_REATTACH_BASE_S"] = "0.1"
+    env["DRL_REATTACH_MAX_S"] = "0.5"
+    return env
+
+
+def _wait_until(cond, timeout: float, what: str = "condition") -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class _StubWeights:
+    sharded = False
+    version = -1
+
+    def get_blob(self):
+        return None, -1
+
+    def get(self):
+        return None, -1
+
+
+def _crc_tree(rank: int, i: int) -> dict:
+    payload = ((np.arange(128, dtype=np.int64) * (i + 1) + rank)
+               % 251).astype(np.uint8)
+    return {"payload": payload,
+            "crc": np.uint32(zlib.crc32(payload.tobytes()) & 0xFFFFFFFF)}
+
+
+class TestRetryLadder:
+    def test_bounded_attempts_and_backoff(self, monkeypatch):
+        ladder = fleet.RetryLadder("t", base_s=0.05, max_s=0.2,
+                                   max_attempts=3)
+        assert ladder.try_acquire()
+        assert not ladder.try_acquire()  # in flight
+        ladder.note_failure()
+        assert not ladder.try_acquire()  # backoff: not due yet
+        time.sleep(0.06)
+        assert ladder.try_acquire()
+        ladder.note_failure()
+        time.sleep(0.12)  # doubled
+        assert ladder.try_acquire()
+        ladder.note_failure()  # third failure = the cap
+        assert ladder.exhausted
+        time.sleep(0.25)
+        assert not ladder.try_acquire()  # permanent
+
+    def test_success_and_reset_restore_budget(self):
+        ladder = fleet.RetryLadder("t", base_s=0.01, max_s=0.02,
+                                   max_attempts=2)
+        assert ladder.try_acquire()
+        ladder.note_success()
+        assert ladder.attempts == 0 and not ladder.exhausted
+        for _ in range(2):
+            _wait_until(ladder.try_acquire, 1.0, "ladder due")
+            ladder.note_failure()
+        assert ladder.exhausted
+        ladder.reset()  # learner epoch change: fresh budget
+        assert not ladder.exhausted and ladder.try_acquire()
+
+
+class TestSupervisor:
+    def test_roster_suspect_dead_eviction_and_rejoin(self):
+        sup = fleet.FleetSupervisor(heartbeat_s=0.05)
+        sup.register({"role": "actor", "rank": 0, "pid": 111,
+                      "surfaces": ["ring"], "version": 3})
+        assert sup.counts() == {"alive": 1, "suspect": 0, "dead": 0}
+        # Stale heartbeats: suspect after 3x, dead (evicted) after 10x.
+        time.sleep(0.2)
+        sup.sweep()
+        assert sup.counts()["suspect"] == 1
+        time.sleep(0.4)
+        sup.sweep()
+        assert sup.counts() == {"alive": 0, "suspect": 0, "dead": 1}
+        kinds = [e["event"] for e in sup.events()]
+        assert kinds == ["join", "suspect", "dead"]
+        # Respawned member (same seat, new pid): rejoin + respawn tally.
+        sup.register({"role": "actor", "rank": 0, "pid": 222})
+        assert sup.counts()["alive"] == 1
+        assert sup.stat("rejoins") == 1 and sup.stat("respawns") == 1
+
+    def test_heartbeat_unknown_member_and_recovery(self):
+        sup = fleet.FleetSupervisor(heartbeat_s=0.05)
+        assert sup.heartbeat({"role": "actor", "rank": 7,
+                              "pid": 1})["known"] is False
+        sup.register({"role": "actor", "rank": 7, "pid": 1})
+        time.sleep(0.2)
+        sup.sweep()
+        assert sup.counts()["suspect"] == 1
+        reply = sup.heartbeat({"role": "actor", "rank": 7, "pid": 1})
+        assert reply["known"] and sup.counts()["alive"] == 1
+        assert any(e["event"] == "recover" for e in sup.events())
+        # A pid mismatch is NOT this member: it must re-register.
+        assert sup.heartbeat({"role": "actor", "rank": 7,
+                              "pid": 2})["known"] is False
+
+
+class TestHeartbeatLoop:
+    def test_register_probe_and_learner_restart_detection(self):
+        port = _free_port()
+        sup1 = fleet.FleetSupervisor(heartbeat_s=0.1).start()
+        srv1 = TransportServer(fifo.TrajectoryQueue(4), _StubWeights(),
+                               host="127.0.0.1", port=port,
+                               fleet=sup1).start()
+
+        class Rec:
+            surface_name = "rec"
+
+            def __init__(self):
+                self.ctxs, self.resets = [], 0
+
+            def reattach(self, ctx=None):
+                self.ctxs.append((ctx.learner_pid, ctx.restarted))
+
+            def reset_reattach(self):
+                self.resets += 1
+
+        rec = Rec()
+        loop = fleet.HeartbeatLoop("127.0.0.1", port, "actor", 0,
+                                   interval_s=0.1)
+        loop.watch(rec)
+        loop.start()
+        try:
+            _wait_until(lambda: rec.ctxs, 5.0, "first probe")
+            assert rec.ctxs[0] == (os.getpid(), False)
+            _wait_until(lambda: sup1.counts()["alive"] == 1, 5.0,
+                        "registration")
+            # Learner "restart": a NEW supervisor incarnation behind the
+            # same port must be detected via the epoch, trigger ladder
+            # resets, and re-register the member.
+            srv1.stop()
+            sup1.stop()
+            sup2 = fleet.FleetSupervisor(heartbeat_s=0.1).start()
+            srv2 = TransportServer(fifo.TrajectoryQueue(4), _StubWeights(),
+                                   host="127.0.0.1", port=port,
+                                   fleet=sup2).start()
+            try:
+                _wait_until(lambda: any(r for _, r in rec.ctxs), 10.0,
+                            "restart detection")
+                assert rec.resets >= 1
+                assert loop.stat("learner_restarts") >= 1
+                _wait_until(lambda: sup2.counts()["alive"] == 1, 5.0,
+                            "re-registration")
+            finally:
+                srv2.stop()
+                sup2.stop()
+        finally:
+            loop.stop()
+
+    def test_pre_fleet_learner_degrades_to_pings(self):
+        port = _free_port()
+        srv = TransportServer(fifo.TrajectoryQueue(4), _StubWeights(),
+                              host="127.0.0.1", port=port).start()  # no fleet
+        probes = []
+
+        class Rec:
+            def reattach(self, ctx=None):
+                probes.append(ctx.learner_pid)
+
+        loop = fleet.HeartbeatLoop("127.0.0.1", port, "actor", 0,
+                                   interval_s=0.1)
+        loop.watch(Rec())
+        loop.start()
+        try:
+            _wait_until(lambda: probes, 5.0, "ping-driven probe")
+            assert probes[0] is None  # no pid proof without fleet ops
+            assert loop.stat("registrations") == 0
+        finally:
+            loop.stop()
+            srv.stop()
+
+
+class TestRingStaleReads:
+    """Regression pins for the confirm-before-corrupt consumer fix: on
+    this container a cross-process mmap read transiently returned a ZERO
+    head word, and the old fail-fast check dropped a healthy ring
+    permanently (reproduced at the seed; see shm_ring._CORRUPT_CONFIRM).
+    """
+
+    def test_stale_zero_head_read_survives(self, tmp_path):
+        name = f"fleett-{os.getpid()}-a"
+        ring = shm_ring.ShmRing.create(name, 1 << 16)
+        try:
+            ring.put_blob(b"x" * 100)
+            orig = ring._read_u64
+            state = {"n": 0}
+
+            def flaky(off):
+                if off == shm_ring._HEAD_OFF and state["n"] < 5:
+                    state["n"] += 1
+                    return 0
+                return orig(off)
+
+            ring._read_u64 = flaky
+            assert bytes(ring.get_blob(timeout=2.0)) == b"x" * 100
+            assert state["n"] >= 1  # the stale reads actually happened
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_stale_zero_length_read_survives(self):
+        name = f"fleett-{os.getpid()}-b"
+        ring = shm_ring.ShmRing.create(name, 1 << 16)
+        try:
+            ring.put_blob(b"y" * 64)
+            orig = ring._read_u32
+            state = {"n": 0}
+
+            def flaky(off):
+                if off == shm_ring._DATA_OFF and state["n"] < 3:
+                    state["n"] += 1
+                    return 0  # stale zero of the length word
+                return orig(off)
+
+            ring._read_u32 = flaky
+            assert bytes(ring.get_blob(timeout=2.0)) == b"y" * 64
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_true_corruption_still_raises(self):
+        name = f"fleett-{os.getpid()}-c"
+        ring = shm_ring.ShmRing.create(name, 1 << 16)
+        try:
+            # A length that overruns the capacity, persisting across
+            # every confirm re-read = a REAL torn publish: still loud.
+            ring._write_u32(shm_ring._DATA_OFF, 0x7FFFFF0)
+            ring._write_u64(shm_ring._HEAD_OFF, 8)
+            with pytest.raises(shm_ring.RingClosed):
+                ring.get_blob(timeout=5.0)
+            assert ring.consumer_closed
+        finally:
+            ring.close()
+            ring.unlink()
+
+
+def _spawn_learner(port, ring_name, board_name, ckpt, stats):
+    proc = subprocess.Popen(
+        [sys.executable, str(_WORKER), "learner", str(port), ring_name,
+         board_name, str(ckpt), str(stats)],
+        env=_child_env(), cwd=str(REPO), text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    line = proc.stdout.readline()
+    assert "LEARNER_READY" in line, line
+    return proc
+
+
+def _read_stats(stats_path) -> dict:
+    per_pid: dict = {}
+    try:
+        with open(stats_path) as f:
+            for raw in f:
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    continue  # torn final line of a killed incarnation
+                per_pid[rec["pid"]] = rec
+    except FileNotFoundError:
+        pass
+    return per_pid
+
+
+class TestLearnerRestartSurvival:
+    def test_kill_restore_same_names_actors_repromote(self, tmp_path,
+                                                      monkeypatch):
+        """THE acceptance pin: SIGKILL the learner mid-run; the respawn
+        reclaims + re-creates the shm segments under the SAME names,
+        restores its version from the checkpoint file and republishes;
+        the surviving actor side (ring + board + heartbeats, the
+        deployed surfaces) demotes to TCP and then RE-PROMOTES onto the
+        new incarnation's segments — with every delivered trajectory
+        crc-verified across both incarnations."""
+        for k, v in _child_env().items():
+            if k.startswith("DRL_"):
+                monkeypatch.setenv(k, v)
+        port = _free_port()
+        tag = f"fleetkill-{os.getpid()}"
+        ring_name, board_name = f"{tag}-r", f"{tag}-b"
+        ckpt = tmp_path / "ckpt.json"
+        stats = tmp_path / "stats.jsonl"
+        learner = _spawn_learner(port, ring_name, board_name, ckpt, stats)
+        client = rq = bw = hb = None
+        try:
+            client = TransportClient("127.0.0.1", port)
+            rq = shm_ring.attach_ring_queue(ring_name, client)
+            bw = weight_board.attach_board_weights(board_name, client)
+            assert rq is not None and bw is not None
+            client.connect_retries = 3  # bounded rides during the outage
+            hb = fleet.HeartbeatLoop("127.0.0.1", port, "actor", 0,
+                                     interval_s=0.15)
+            hb.watch(rq)
+            hb.watch(bw)
+            hb.start()
+            for i in range(20):
+                assert rq.put(_crc_tree(0, i))
+            _wait_until(
+                lambda: sum(r["verified"] for r in
+                            _read_stats(stats).values()) >= 20,
+                10.0, "pre-kill delivery")
+            # The restart counter pins "a member that HAD heartbeated
+            # against incarnation 1 sees the epoch change" — so the
+            # kill must wait for that first successful exchange (under
+            # 2-core contention the loop's first beat can lag).
+            _wait_until(lambda: hb.stat("heartbeats") >= 1, 10.0,
+                        "first heartbeat against incarnation 1")
+            got = bw.get_if_newer(-1)
+            assert got is not None and int(got[0]["v"]) == got[1]
+            pid1 = learner.pid
+
+            learner.kill()  # SIGKILL: no unlink, no writer-closed latch
+            learner.wait()
+            learner = _spawn_learner(port, ring_name, board_name, ckpt,
+                                     stats)
+            assert learner.pid != pid1
+
+            # Keep the actor loop alive through the outage: puts + pulls
+            # are what let the stale-flag demotes + reattaches land.
+            def repromoted() -> bool:
+                try:
+                    rq.put(_crc_tree(0, 999))
+                except (ConnectionError, OSError):
+                    pass
+                try:
+                    bw.get_if_newer(-1)
+                except (ConnectionError, OSError):
+                    pass
+                s_ring = rq.snapshot_stats()
+                s_board = bw.snapshot_stats()
+                return (s_ring["reattaches"] >= 1
+                        and s_board["reattaches"] >= 1)
+
+            _wait_until(repromoted, 20.0, "ring+board re-promotion")
+            assert hb.stat("learner_restarts") >= 1
+            # Post-restart traffic rides the NEW segments, verified.
+            for i in range(20, 35):
+                assert rq.put(_crc_tree(0, i))
+            _wait_until(
+                lambda: _read_stats(stats).get(learner.pid,
+                                               {}).get("verified", 0) >= 15,
+                10.0, "post-restart delivery")
+            per_pid = _read_stats(stats)
+            assert sum(r["corrupt"] for r in per_pid.values()) == 0
+            assert len(per_pid) == 2  # both incarnations reported
+            # Checkpoint restore: the new incarnation's version counter
+            # CONTINUED past the one observed pre-kill (a restart from
+            # zero could not overtake it this quickly).
+            assert per_pid[learner.pid]["version"] > got[1]
+            got2 = bw.get_if_newer(-1)
+            assert got2 is not None and int(got2[0]["v"]) == got2[1]
+        finally:
+            if hb is not None:
+                hb.stop()
+            if learner.poll() is None:
+                learner.terminate()
+                learner.wait(timeout=10)
+            if rq is not None:
+                rq.close()
+            if bw is not None:
+                bw.close()
+            if client is not None:
+                client.close()
+            for name in (ring_name, board_name):
+                try:
+                    seg = shm_ring._attach_shm(name)
+                    seg.unlink()
+                    seg.close()
+                except (FileNotFoundError, OSError):
+                    pass
+
+
+class TestReplicaRepromote:
+    def test_kill_respawn_reenters_rotation(self, monkeypatch):
+        """PR 7's permanent replica demote, undone: a killed replica is
+        demoted (acts fail over to the fallback), and after a respawn
+        on the same port a bounded reattach probe re-promotes it back
+        into RemoteActService rotation."""
+        monkeypatch.setenv("DRL_REATTACH_BASE_S", "0.05")
+        port, fb_port = _free_port(), _free_port()
+        from tests.fleet_worker import StubInference, StubStore
+
+        fb_store = StubStore()
+        fb_store.publish({"w": np.zeros(4, np.uint8)}, 0)
+        fb_server = TransportServer(None, fb_store, host="127.0.0.1",
+                                    port=fb_port,
+                                    inference=StubInference()).start()
+
+        def spawn_replica():
+            proc = subprocess.Popen(
+                [sys.executable, str(_WORKER), "replica", str(port)],
+                env=_child_env(), cwd=str(REPO), text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            line = proc.stdout.readline()
+            assert "REPLICA_READY" in line, line
+            return proc
+
+        replica = spawn_replica()
+        fallback = TransportClient("127.0.0.1", fb_port)
+        svc = RemoteActService.from_addrs(
+            [f"127.0.0.1:{port}"], fallback=fallback, connect_retries=2)
+        req = {"rows": np.zeros((4, 2), np.float32)}
+        try:
+            out = svc(req)
+            assert int(out["served_by"]) == replica.pid
+            replica.kill()
+            replica.wait()
+            out = svc(req)  # bounded reconnect -> demote -> fallback
+            assert int(out["served_by"]) == os.getpid()
+            stats = svc.snapshot_stats()
+            assert stats["replica_demotes"] == 1
+            assert svc.live_endpoints() == 0
+
+            replica = spawn_replica()
+            _wait_until(lambda: (svc.reattach(), svc.live_endpoints())[1] == 1,
+                        10.0, "replica re-promotion")
+            out = svc(req)
+            assert int(out["served_by"]) == replica.pid
+            stats = svc.snapshot_stats()
+            assert stats["replica_repromotes"] == 1
+            assert stats["fallback_acts"] == 1  # only the outage act
+        finally:
+            if replica.poll() is None:
+                replica.terminate()
+                replica.wait(timeout=10)
+            svc.close()
+            fallback.close()
+            fb_server.stop()
+
+
+class TestShardedPullReprobe:
+    def test_unsharded_latch_reprobes_then_exhausts(self, monkeypatch):
+        """The PR-8 whole-blob demote is now ladder-probed: reattach
+        clears the latch for ONE re-probe on the pull path; a learner
+        that stays un-sharded re-latches and the exhausted ladder
+        restores the permanent demotion."""
+        monkeypatch.setenv("DRL_REATTACH_BASE_S", "0.02")
+        monkeypatch.setenv("DRL_REATTACH_ATTEMPTS", "2")
+        from distributed_reinforcement_learning_tpu.runtime.transport import (
+            ShardedRemoteWeights)
+        from distributed_reinforcement_learning_tpu.runtime.weights import (
+            WeightStore)
+
+        port = _free_port()
+        store = WeightStore(sharded=False)
+        store.publish({"w": np.arange(16, dtype=np.float32)}, 1)
+        server = TransportServer(fifo.TrajectoryQueue(4), store,
+                                 host="127.0.0.1", port=port).start()
+        client = TransportClient("127.0.0.1", port)
+        srw = ShardedRemoteWeights(client)
+        try:
+            got = srw.get_if_newer(-1)
+            assert got is not None  # served via the whole-blob fallback
+            assert srw.snapshot_stats()["whole_fallbacks"] == 1
+            for expected_fallbacks in (2, 3):
+                time.sleep(0.05)
+                srw.reattach()
+                assert srw.get_if_newer(-1) is not None
+                assert (srw.snapshot_stats()["whole_fallbacks"]
+                        == expected_fallbacks)
+            assert srw._ladder.exhausted
+            time.sleep(0.1)
+            srw.reattach()  # permanent again: no more probes
+            assert srw.get_if_newer(-1) is not None
+            assert srw.snapshot_stats()["whole_fallbacks"] == 3
+        finally:
+            client.close()
+            server.stop()
+            store.close()
+
+    def test_restarted_sharded_learner_repromotes(self, monkeypatch):
+        monkeypatch.setenv("DRL_REATTACH_BASE_S", "0.02")
+        from distributed_reinforcement_learning_tpu.runtime.transport import (
+            ShardedRemoteWeights)
+        from distributed_reinforcement_learning_tpu.runtime.weights import (
+            WeightStore)
+
+        port = _free_port()
+        plain = WeightStore(sharded=False)
+        plain.publish({"dense/kernel": np.ones((8, 4), np.float32)}, 1)
+        server = TransportServer(fifo.TrajectoryQueue(4), plain,
+                                 host="127.0.0.1", port=port).start()
+        client = TransportClient("127.0.0.1", port)
+        srw = ShardedRemoteWeights(client)
+        sharded = None
+        try:
+            assert srw.get_if_newer(-1) is not None  # latches plain
+            server.stop()
+            plain.close()
+            sharded = WeightStore(sharded=True)
+            sharded.publish({"dense/kernel": np.ones((8, 4),
+                                                     np.float32)}, 2)
+            server = TransportServer(fifo.TrajectoryQueue(4), sharded,
+                                     host="127.0.0.1", port=port).start()
+            srw.reset_reattach()  # what the heartbeat's epoch change does
+            srw.reattach()
+            got = srw.get_if_newer(-1)
+            assert got is not None and got[1] == 2
+            stats = srw.snapshot_stats()
+            assert stats["reattaches"] == 1
+            assert stats["shard_pulls"] >= 1  # genuinely sharded again
+        finally:
+            client.close()
+            server.stop()
+            if sharded is not None:
+                sharded.close()
+
+
+class TestReplayRevive:
+    def test_fifo_demote_revive_and_ladder_cap(self, monkeypatch):
+        monkeypatch.setenv("DRL_REATTACH_BASE_S", "0.02")
+        monkeypatch.setenv("DRL_REATTACH_ATTEMPTS", "2")
+        from distributed_reinforcement_learning_tpu.data.replay_service import (
+            ShardedReplayService)
+        from distributed_reinforcement_learning_tpu.runtime.replay_shard import (
+            ReplayIngestFifo)
+
+        svc = ShardedReplayService(1, 64, mode="sequence", scorer="max",
+                                   seed=0)
+        fallback = fifo.TrajectoryQueue(8)
+        facade = ReplayIngestFifo(svc, fallback)
+        try:
+            svc.note_shard_death(svc.shards[0])
+            blob = bytes(codec.encode({"x": np.zeros(4, np.float32)}))
+            assert facade.ingest_blob(blob)  # routes to the fallback
+            assert facade.demoted and fallback.size() == 1
+            epoch0 = svc.shards[0].epoch
+            facade.reattach()  # revive #1
+            assert not facade.demoted and svc.healthy
+            assert svc.shards[0].epoch == epoch0 + 1  # fresh epoch
+            svc.note_shard_death(svc.shards[0])
+            assert facade.ingest_blob(blob) and facade.demoted
+            time.sleep(0.05)
+            facade.reattach()  # revive #2 = the budget
+            assert not facade.demoted
+            assert facade._ladder.exhausted
+            svc.note_shard_death(svc.shards[0])
+            assert facade.ingest_blob(blob) and facade.demoted
+            time.sleep(0.1)
+            facade.reattach()  # exhausted: demotion is permanent now
+            assert facade.demoted
+        finally:
+            svc.close()
+
+
+class TestFleetOverWire:
+    def test_actor_child_kill_and_respawn_rejoins(self):
+        """Two-process roster drill over real TCP: a member child
+        registers + heartbeats, gets SIGKILLed, the supervisor marks it
+        suspect then dead (evicted from the live roster), and a
+        respawned child re-registers as a rejoin + respawn."""
+        port = _free_port()
+        sup = fleet.FleetSupervisor(heartbeat_s=0.15).start()
+        server = TransportServer(fifo.TrajectoryQueue(4), _StubWeights(),
+                                 host="127.0.0.1", port=port,
+                                 fleet=sup).start()
+        child_src = (
+            "import os, sys, time\n"
+            "from distributed_reinforcement_learning_tpu.runtime import fleet\n"
+            "loop = fleet.HeartbeatLoop('127.0.0.1', int(sys.argv[1]),"
+            " 'actor', 0, interval_s=0.15)\n"
+            "loop.start()\n"
+            "print('CHILD_READY', flush=True)\n"
+            "time.sleep(120)\n")
+
+        def spawn():
+            proc = subprocess.Popen(
+                [sys.executable, "-c", child_src, str(port)],
+                env=_child_env(), cwd=str(REPO), text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            assert "CHILD_READY" in proc.stdout.readline()
+            return proc
+
+        child = spawn()
+        try:
+            _wait_until(lambda: sup.counts()["alive"] == 1, 10.0, "join")
+            child.kill()
+            child.wait()
+            _wait_until(lambda: sup.counts()["dead"] == 1, 15.0,
+                        "stale-heartbeat eviction")
+            child = spawn()
+            _wait_until(lambda: sup.counts()["alive"] == 1, 10.0, "rejoin")
+            assert sup.stat("rejoins") >= 1 and sup.stat("respawns") >= 1
+        finally:
+            if child.poll() is None:
+                child.kill()
+                child.wait()
+            server.stop()
+            sup.stop()
+
+
+@pytest.mark.slow
+def test_launcher_chaos_smoke(tmp_path):
+    """The full launcher drill: --chaos kills actor then learner mid-run
+    (no replicas here), the respawn loop brings each back (pid-keyed
+    segment reap first), and the topology still trains to completion.
+    Slow lane: two jax training processes + kills, minutes on this host.
+    """
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "launch_local_cluster.py"),
+         "--section", "impala_cartpole", "--actors", "1",
+         "--updates", "30", "--chaos", "--chaos_interval", "5",
+         "--checkpoint_dir", str(tmp_path / "ckpt"),
+         "--max_respawns", "3"],
+        cwd=str(REPO), env=_child_env(), text=True,
+        capture_output=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "chaos: SIGKILL" in proc.stderr, proc.stderr[-1000:]
+    assert "respawn tally" in proc.stderr, proc.stderr[-1000:]

@@ -262,9 +262,27 @@ class _FakeClient:
 
 
 class TestFallback:
-    def test_attach_failure_falls_back_to_tcp(self):
+    def test_attach_failure_falls_back_to_tcp(self, monkeypatch):
+        monkeypatch.setenv("DRL_FLEET", "0")
         assert attach_ring_queue("drltest-never-created", _FakeClient(),
                                  deadline_s=0.3) is None
+
+    def test_attach_failure_with_fleet_demotes_at_birth(self, monkeypatch):
+        """Fleet plane on: attach failure yields a demoted-at-birth
+        RingQueue (PUTs on TCP now, reattach() surface kept) so an actor
+        that starts during a learner outage can be re-promoted later."""
+        monkeypatch.setenv("DRL_FLEET", "1")
+        client = _FakeClient()
+        rq = attach_ring_queue("drltest-never-created", client,
+                               deadline_s=0.3)
+        assert rq is not None and not rq.attached
+        assert rq._name == "drltest-never-created"  # reattach target kept
+        try:
+            trajs = make_trajectories(1, 4)
+            assert rq.put(trajs[0])
+            assert len(client.single) == 1  # rode TCP
+        finally:
+            rq.close()
 
     def test_ring_death_demotes_to_tcp_mid_run(self):
         ring = ShmRing.create(f"drltest-demote-{os.getpid()}", 1 << 16)

@@ -373,9 +373,26 @@ class TestBoardWeights:
             writer.close()
             writer.unlink()
 
-    def test_attach_failure_falls_back_to_tcp(self):
+    def test_attach_failure_falls_back_to_tcp(self, monkeypatch):
+        monkeypatch.setenv("DRL_FLEET", "0")
         assert attach_board_weights("drltest-wb-never-created", _FakeClient(),
                                     deadline_s=0.3) is None
+
+    def test_attach_failure_with_fleet_demotes_at_birth(self, monkeypatch):
+        """Fleet plane on: attach failure yields a demoted-at-birth
+        BoardWeights (pulls on TCP now, reattach() surface kept) so a
+        member that starts during a learner outage can be re-promoted."""
+        monkeypatch.setenv("DRL_FLEET", "1")
+        client = _FakeClient()
+        bw = attach_board_weights("drltest-wb-never-created", client,
+                                  deadline_s=0.3)
+        assert bw is not None and not bw.attached
+        assert bw._name == "drltest-wb-never-created"  # reattach target
+        try:
+            assert bw.get_if_newer(-1)[1] == 999
+            assert client.pulls == [-1]  # rode TCP
+        finally:
+            bw.close()
 
 
 class TestGating:
