@@ -134,6 +134,34 @@ class XformerSequenceAccumulator(_StackedUnrollAccumulator):
         return XformerBatch
 
 
+class SlicedAccumulators:
+    """Per-slice accumulation for the pipelined actor data plane
+    (runtime/actor_pipeline.py): k independent accumulators of any of
+    the family classes in this module, one per env slice, so a slice
+    can accumulate its own unroll while another slice's act is in
+    flight and extract independently at round end. Indexing is by
+    slice, never shared — the pipeline's lockstep handoff guarantees a
+    slice's accumulator is only touched by one thread at a time."""
+
+    def __init__(self, make_accumulator, num_slices: int):
+        self._accs = [make_accumulator() for _ in range(num_slices)]
+
+    def __len__(self) -> int:
+        return len(self._accs)
+
+    def slice(self, index: int):
+        return self._accs[index]
+
+    def reset_slice(self, index: int, *args) -> None:
+        self._accs[index].reset(*args)
+
+    def append_slice(self, index: int, **step_fields: np.ndarray) -> None:
+        self._accs[index].append(**step_fields)
+
+    def extract_slice(self, index: int) -> list:
+        return self._accs[index].extract()
+
+
 class XImpalaTrajectoryAccumulator(_StackedUnrollAccumulator):
     """Collects T steps per env for the Transformer-IMPALA family: the
     IMPALA unroll payload minus the stored (h, c) — the transformer

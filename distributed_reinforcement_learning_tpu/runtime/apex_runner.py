@@ -33,6 +33,13 @@ from distributed_reinforcement_learning_tpu.data.fifo import (
 from distributed_reinforcement_learning_tpu.data.replay import UniformBuffer, make_replay
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+from distributed_reinforcement_learning_tpu.runtime.actor_pipeline import (
+    PipelineSlice,
+    run_async_loop,
+    shape_life_loss,
+    slice_seed,
+    split_batched_env,
+)
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.replay_train import ReplayTrainMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
@@ -67,6 +74,8 @@ class ApexActor:
         self.life_loss_shaping = life_loss_shaping
         self.remote_act = remote_act
 
+        self._seed = seed  # slice seeds derive from it (actor_pipeline)
+        self._local_capacity = local_capacity
         self._rng = jax.random.PRNGKey(seed)
         self._buffer = UniformBuffer(local_capacity, seed=seed)
         self._obs = env.reset()
@@ -125,11 +134,8 @@ class ApexActor:
 
             rec_reward, rec_done = reward.astype(np.float32), done.copy()
             if self.life_loss_shaping:
-                lives = infos.get("lives")
-                lost = (lives != self._lives) & (self._lives >= 0) & ~done
-                rec_reward = np.where(lost, -1.0, rec_reward)
-                rec_done = rec_done | lost
-                self._lives = np.where(done, -1, lives)
+                rec_reward, rec_done, self._lives = shape_life_loss(
+                    self._lives, reward, done, infos)
 
             for i in range(self._obs.shape[0]):
                 self._buffer.append(
@@ -165,6 +171,124 @@ class ApexActor:
             with _OBS.span("actor_put"):
                 put_round(self.queue, pending)
         return num_steps * self._obs.shape[0]
+
+    # -- slice protocol (runtime/actor_pipeline.py) --------------------
+    # A slice mirrors run_steps over its own env subset, RNG stream,
+    # LOCAL BUFFER (own re-sample RandomState) and epsilon schedule:
+    # with frozen weights a pipelined slice's puts are bit-identical to
+    # a plain ApexActor built over that slice (test-pinned). The
+    # publication unit is the per-step warm re-sample (or the
+    # DRL_PUT_BATCH pending round), exactly the sequential shapes.
+
+    def pipeline_round_steps(self) -> None:
+        return None  # step-driven family: the caller passes run_steps(n)
+
+    def pipeline_make_slices(self, k: int) -> list[PipelineSlice]:
+        total = self.env.num_envs
+        slices = []
+        lo = 0
+        for i, env in enumerate(split_batched_env(self.env, k)):
+            hi = lo + env.num_envs
+            seed = slice_seed(self._seed, i)
+            # Warmup and capacity scale by the slice's env fraction
+            # (ceil): a slice appends env.num_envs transitions per step
+            # instead of the full actor's N, so unscaled knobs would
+            # delay first publication k-fold and retain k x the replay
+            # window vs the sequential actor this replaces.
+            frac_w = -(-self.warmup * env.num_envs // total)
+            frac_cap = max(self.unroll_size,
+                           -(-self._local_capacity * env.num_envs // total))
+            slices.append(PipelineSlice(
+                i, env, seed,
+                rng=jax.random.PRNGKey(seed),
+                buffer=UniformBuffer(frac_cap, seed=seed),
+                warmup=frac_w,
+                obs=self._obs[lo:hi].copy(),
+                prev_action=np.zeros(env.num_envs, np.int32),
+                episodes=np.zeros(env.num_envs, np.int64),
+                lives=np.full(env.num_envs, -1),
+                steps=0,
+                pending=[],
+            ))
+            lo = hi
+        return slices
+
+    def pipeline_sync_weights(self, slices: list) -> None:
+        """One weights RPC per round shared by every due slice —
+        preserving the sequential loop's `sync_every_steps` cadence
+        (slices step in lockstep, so dueness is identical across
+        them)."""
+        if self.remote_act is not None:
+            return
+        due = [sl for sl in slices
+               if sl.steps % self.sync_every_steps == 0 or sl.params is None]
+        if not due:
+            return
+        self._sync_params()
+        if self._params is None:
+            raise RuntimeError("no weights published yet")
+        for sl in due:
+            if sl.version < self._version:
+                sl.params, sl.version = self._params, self._version
+
+    def slice_begin_round(self, sl: PipelineSlice, steps: int) -> None:
+        if self.remote_act is None and sl.params is None:
+            raise RuntimeError("no weights published yet")
+        sl.put_batch = max(1, put_batch_size())
+        sl.pending = []
+
+    def slice_act(self, sl: PipelineSlice) -> np.ndarray:
+        epsilon = 1.0 / (self.epsilon_decay * sl.episodes + 1.0)
+        if self.remote_act is not None:
+            r = self.remote_act({"obs": sl.obs, "prev_action": sl.prev_action,
+                                 "epsilon": epsilon.astype(np.float32)})
+            actions = r["action"]
+        else:
+            sl.rng, sub = jax.random.split(sl.rng)
+            actions, _ = self.agent.act(
+                sl.params, sl.obs, sl.prev_action, epsilon, sub)
+        return np.asarray(actions)
+
+    def slice_step(self, sl: PipelineSlice, actions: np.ndarray) -> tuple:
+        next_obs, reward, done, infos = sl.env.step(actions)
+        rec_reward, rec_done = reward.astype(np.float32), done.copy()
+        if self.life_loss_shaping:
+            rec_reward, rec_done, sl.lives = shape_life_loss(
+                sl.lives, reward, done, infos)
+        for i in range(sl.obs.shape[0]):
+            sl.buffer.append(
+                ApexBatch(
+                    state=sl.obs[i],
+                    next_state=next_obs[i],
+                    previous_action=sl.prev_action[i],
+                    action=actions[i],
+                    reward=rec_reward[i],
+                    done=rec_done[i],
+                )
+            )
+        sl.episodes += done
+        for ret in completed_returns(infos, done):
+            sl.episode_returns.append(float(ret))
+        sl.prev_action = np.where(done, 0, actions).astype(np.int32)
+        sl.obs = next_obs
+        sl.steps += 1
+        if len(sl.buffer) > sl.warmup:  # slice-scaled (pipeline_make_slices)
+            unroll = stack_pytrees(sl.buffer.sample(self.unroll_size))
+            if sl.put_batch <= 1:
+                return (("put", unroll),)
+            sl.pending.append(unroll)
+            if len(sl.pending) >= sl.put_batch:
+                payload = ("round", sl.pending)
+                sl.pending = []
+                return (payload,)
+        return ()
+
+    def slice_end_round(self, sl: PipelineSlice) -> tuple:
+        if sl.pending:
+            payload = ("round", sl.pending)
+            sl.pending = []
+            return (payload,)
+        return ()
 
 
 class ApexLearner(PublishCadenceMixin, ReplayTrainMixin):
@@ -485,3 +609,20 @@ def run_sync(learner: ApexLearner, actors: list[ApexActor], num_updates: int,
     # materialization); the public result is always host floats.
     metrics = {k: float(v) for k, v in metrics.items()}
     return {"frames": frames, "last_metrics": metrics, "episode_returns": returns}
+
+
+def run_async(learner: ApexLearner, actors: list[ApexActor], num_updates: int,
+              queue: TrajectoryQueue, actor_steps_per_round: int = 8) -> dict:
+    """Free-running actor threads + the ingest/train learner loop (one
+    copy in actor_pipeline.run_async_loop; actor deaths log and count
+    `actor/deaths` via the shared run_actor_thread body)."""
+
+    def drain_ingest(ln) -> bool:
+        drained = False
+        while ln.ingest_many(timeout=0.05):
+            drained = True
+        return drained
+
+    return run_async_loop(
+        learner, actors, num_updates, queue, ingest_fn=drain_ingest,
+        round_fn=lambda a: a.run_steps(actor_steps_per_round))

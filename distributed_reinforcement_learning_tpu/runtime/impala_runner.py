@@ -25,7 +25,18 @@ import numpy as np
 
 from distributed_reinforcement_learning_tpu.agents.impala import ActOutput, ImpalaAgent, ImpalaConfig
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round, stack_pytrees
-from distributed_reinforcement_learning_tpu.data.structures import ImpalaTrajectoryAccumulator
+from distributed_reinforcement_learning_tpu.data.structures import (
+    ImpalaTrajectoryAccumulator,
+    SlicedAccumulators,
+)
+from distributed_reinforcement_learning_tpu.runtime.actor_pipeline import (
+    PipelineSlice,
+    run_actor_thread,
+    shape_life_loss,
+    slice_seed,
+    split_batched_env,
+    sync_slices_params,
+)
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
@@ -54,6 +65,7 @@ class ImpalaActor:
         self.life_loss_shaping = life_loss_shaping
         self.remote_act = remote_act
 
+        self._seed = seed  # slice seeds derive from it (actor_pipeline)
         self._rng = jax.random.PRNGKey(seed)
         self._obs = env.reset()
         n = self._obs.shape[0]
@@ -101,13 +113,11 @@ class ImpalaActor:
 
             # Life-loss shaping (`train_impala.py:149-154`): a lost life is
             # recorded as r=-1, done=True while the env keeps running.
+            # One definition for sequential and slice paths (actor_pipeline).
             rec_reward, rec_done = reward.astype(np.float32), done.copy()
             if self.life_loss_shaping:
-                lives = infos.get("lives")
-                lost = (lives != self._lives) & (self._lives >= 0) & ~done
-                rec_reward = np.where(lost, -1.0, rec_reward)
-                rec_done = rec_done | lost
-                self._lives = np.where(done, -1, lives)
+                rec_reward, rec_done, self._lives = shape_life_loss(
+                    self._lives, reward, done, infos)
 
             acc.append(
                 state=self._obs,
@@ -139,6 +149,88 @@ class ImpalaActor:
         with _OBS.span("actor_put"):
             put_round(self.queue, acc.extract())
         return n * cfg.trajectory
+
+    # -- slice protocol (runtime/actor_pipeline.py) --------------------
+    # Each slice is the sequential loop's per-step math over its own
+    # env subset, RNG stream, carry and accumulator: with frozen
+    # weights, a pipelined slice's trajectories are bit-identical to a
+    # plain ImpalaActor built over that slice (test-pinned).
+
+    def pipeline_round_steps(self) -> int:
+        return self.agent.cfg.trajectory
+
+    def pipeline_make_slices(self, k: int) -> list[PipelineSlice]:
+        self._slice_accs = SlicedAccumulators(ImpalaTrajectoryAccumulator, k)
+        slices = []
+        lo = 0
+        for i, env in enumerate(split_batched_env(self.env, k)):
+            hi = lo + env.num_envs
+            h, c = self.agent.initial_lstm_state(env.num_envs)
+            seed = slice_seed(self._seed, i)
+            slices.append(PipelineSlice(
+                i, env, seed,
+                rng=jax.random.PRNGKey(seed),
+                obs=self._obs[lo:hi].copy(),
+                prev_action=np.zeros(env.num_envs, np.int32),
+                h=np.asarray(h), c=np.asarray(c),
+                lives=np.full(env.num_envs, -1),
+            ))
+            lo = hi
+        return slices
+
+    # One weights RPC per round, shared by all slices (actor_pipeline
+    # calls this before any slice_begin_round).
+    pipeline_sync_weights = sync_slices_params
+
+    def slice_begin_round(self, sl: PipelineSlice, steps: int) -> None:
+        if self.remote_act is None and sl.params is None:
+            raise RuntimeError("no weights published yet")
+        self._slice_accs.reset_slice(sl.index)
+
+    def slice_act(self, sl: PipelineSlice) -> ActOutput:
+        """Runs on the pipeline's act worker thread; returns HOST arrays
+        so the main thread's step never blocks on XLA."""
+        if self.remote_act is not None:
+            r = self.remote_act({"obs": sl.obs, "prev_action": sl.prev_action,
+                                 "h": sl.h, "c": sl.c})
+            out = ActOutput(r["action"], r["policy"], r["h"], r["c"])
+        else:
+            sl.rng, sub = jax.random.split(sl.rng)
+            out = self.agent.act(
+                sl.params, sl.obs, sl.prev_action, sl.h, sl.c, sub)
+        return ActOutput(np.asarray(out.action), np.asarray(out.policy),
+                         np.asarray(out.h), np.asarray(out.c))
+
+    def slice_step(self, sl: PipelineSlice, out: ActOutput) -> tuple:
+        actions = out.action
+        env_actions = actions % self.available_action if self.available_action else actions
+        next_obs, reward, done, infos = sl.env.step(env_actions)
+        rec_reward, rec_done = reward.astype(np.float32), done.copy()
+        if self.life_loss_shaping:
+            rec_reward, rec_done, sl.lives = shape_life_loss(
+                sl.lives, reward, done, infos)
+        self._slice_accs.append_slice(
+            sl.index,
+            state=sl.obs,
+            reward=rec_reward,
+            done=rec_done,
+            action=actions,
+            behavior_policy=out.policy,
+            previous_action=sl.prev_action,
+            initial_h=sl.h,
+            initial_c=sl.c,
+        )
+        keep = (~done).astype(np.float32)[:, None]
+        sl.h = out.h * keep
+        sl.c = out.c * keep
+        sl.prev_action = np.where(done, 0, actions).astype(np.int32)
+        sl.obs = next_obs
+        for ret in completed_returns(infos, done):
+            sl.episode_returns.append(float(ret))
+        return ()
+
+    def slice_end_round(self, sl: PipelineSlice) -> tuple:
+        return (("round", self._slice_accs.extract_slice(sl.index)),)
 
 
 class ImpalaLearner(PublishCadenceMixin):
@@ -378,14 +470,11 @@ def run_async(
     process; the multi-process version goes through runtime/transport)."""
     stop = threading.Event()
 
-    def actor_loop(actor: ImpalaActor):
-        while not stop.is_set():
-            try:
-                actor.run_unroll()
-            except RuntimeError:
-                return
-
-    threads = [threading.Thread(target=actor_loop, args=(a,), daemon=True) for a in actors]
+    # Shared free-running loop (actor_pipeline.run_actor_thread): a
+    # dying actor logs its traceback and bumps `actor/deaths` instead
+    # of silently vanishing into a throughput dip.
+    threads = [threading.Thread(target=run_actor_thread, args=(a, stop),
+                                daemon=True) for a in actors]
     for t in threads:
         t.start()
     try:

@@ -24,9 +24,20 @@ import jax
 from distributed_reinforcement_learning_tpu.agents.r2d2 import R2D2Agent, R2D2Batch
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, stack_pytrees, put_round
 from distributed_reinforcement_learning_tpu.data.replay import make_replay
-from distributed_reinforcement_learning_tpu.data.structures import R2D2SequenceAccumulator
+from distributed_reinforcement_learning_tpu.data.structures import (
+    R2D2SequenceAccumulator,
+    SlicedAccumulators,
+)
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+from distributed_reinforcement_learning_tpu.runtime.actor_pipeline import (
+    PipelineSlice,
+    run_async_loop,
+    shape_timeout,
+    slice_seed,
+    split_batched_env,
+    sync_slices_params,
+)
 from distributed_reinforcement_learning_tpu.runtime.publishing import PublishCadenceMixin
 from distributed_reinforcement_learning_tpu.runtime.replay_train import ReplayTrainMixin
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
@@ -69,6 +80,7 @@ class R2D2Actor:
         self.obs_transform = obs_transform or (lambda x: x)
         self.remote_act = remote_act
 
+        self._seed = seed  # slice seeds derive from it (actor_pipeline)
         self._rng = jax.random.PRNGKey(seed)
         self._obs = self.obs_transform(env.reset())
         n = self._obs.shape[0]
@@ -120,11 +132,9 @@ class R2D2Actor:
             next_obs = self.obs_transform(next_obs_raw)
 
             # Stable mode: a time-limit truncation is recorded (and
-            # carried) as if the episode continued — see __init__.
-            rec_done = done
-            if self.timeout_nonterminal:
-                trunc = np.asarray(infos.get("truncated", np.zeros_like(done)))
-                rec_done = done & ~trunc
+            # carried) as if the episode continued — see __init__. One
+            # definition for sequential and slice paths (actor_pipeline).
+            rec_done = shape_timeout(done, infos, self.timeout_nonterminal)
 
             acc.append(
                 state=self._obs,
@@ -155,6 +165,87 @@ class R2D2Actor:
         with _OBS.span("actor_put"):
             put_round(self.queue, acc.extract())
         return n * cfg.seq_len
+
+    # -- slice protocol (runtime/actor_pipeline.py) --------------------
+    # Sequence-start LSTM state, per-slice epsilon schedule and the
+    # stable-mode truncation recording all mirror run_unroll exactly
+    # over the slice's own envs/seed (bit-identity test-pinned).
+
+    def pipeline_round_steps(self) -> int:
+        return self.agent.cfg.seq_len
+
+    def pipeline_make_slices(self, k: int) -> list[PipelineSlice]:
+        self._slice_accs = SlicedAccumulators(R2D2SequenceAccumulator, k)
+        slices = []
+        lo = 0
+        for i, env in enumerate(split_batched_env(self.env, k)):
+            hi = lo + env.num_envs
+            h, c = self.agent.initial_lstm_state(env.num_envs)
+            seed = slice_seed(self._seed, i)
+            slices.append(PipelineSlice(
+                i, env, seed,
+                rng=jax.random.PRNGKey(seed),
+                obs=self._obs[lo:hi].copy(),
+                prev_action=np.zeros(env.num_envs, np.int32),
+                h=np.asarray(h), c=np.asarray(c),
+                episodes=np.zeros(env.num_envs, np.int64),
+            ))
+            lo = hi
+        return slices
+
+    def _slice_epsilon(self, sl: PipelineSlice) -> np.ndarray:
+        return np.maximum(
+            1.0 / (self.epsilon_decay * sl.episodes + 1.0),
+            self.epsilon_floor)
+
+    # One weights RPC per round, shared by all slices (actor_pipeline
+    # calls this before any slice_begin_round).
+    pipeline_sync_weights = sync_slices_params
+
+    def slice_begin_round(self, sl: PipelineSlice, steps: int) -> None:
+        if self.remote_act is None and sl.params is None:
+            raise RuntimeError("no weights published yet")
+        self._slice_accs.reset_slice(sl.index, sl.h, sl.c)
+
+    def slice_act(self, sl: PipelineSlice) -> tuple:
+        epsilon = self._slice_epsilon(sl)
+        if self.remote_act is not None:
+            r = self.remote_act({
+                "obs": sl.obs, "h": sl.h, "c": sl.c,
+                "prev_action": sl.prev_action,
+                "epsilon": epsilon.astype(np.float32)})
+            action, h, c = r["action"], r["h"], r["c"]
+        else:
+            sl.rng, sub = jax.random.split(sl.rng)
+            action, _, h, c = self.agent.act(
+                sl.params, sl.obs, sl.h, sl.c, sl.prev_action, epsilon, sub)
+        return np.asarray(action), np.asarray(h), np.asarray(c)
+
+    def slice_step(self, sl: PipelineSlice, out: tuple) -> tuple:
+        action, h, c = out
+        next_obs_raw, reward, done, infos = sl.env.step(action)
+        next_obs = self.obs_transform(next_obs_raw)
+        rec_done = shape_timeout(done, infos, self.timeout_nonterminal)
+        self._slice_accs.append_slice(
+            sl.index,
+            state=sl.obs,
+            previous_action=sl.prev_action,
+            action=action,
+            reward=reward.astype(np.float32),
+            done=rec_done,
+        )
+        keep = (~rec_done).astype(np.float32)[:, None]
+        sl.h = h * keep
+        sl.c = c * keep
+        sl.prev_action = np.where(rec_done, 0, action).astype(np.int32)
+        sl.obs = next_obs
+        sl.episodes += rec_done
+        for ret in completed_returns(infos, done):
+            sl.episode_returns.append(float(ret))
+        return ()
+
+    def slice_end_round(self, sl: PipelineSlice) -> tuple:
+        return (("round", self._slice_accs.extract_slice(sl.index)),)
 
 
 class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
@@ -444,3 +535,14 @@ def run_sync(learner: R2D2Learner, actors: list[R2D2Actor], num_updates: int,
     # materialization); the public result is always host floats.
     metrics = {k: float(v) for k, v in metrics.items()}
     return {"frames": frames, "last_metrics": metrics, "episode_returns": returns}
+
+
+def run_async(learner: R2D2Learner, actors: list[R2D2Actor],
+              num_updates: int, queue: TrajectoryQueue) -> dict:
+    """Free-running actor threads + the ingest/train learner loop (one
+    copy in actor_pipeline.run_async_loop; actor deaths log and count
+    `actor/deaths` via the shared run_actor_thread body). Shared by the
+    Transformer-R2D2 family (xformer_runner re-exports)."""
+    return run_async_loop(
+        learner, actors, num_updates, queue,
+        ingest_fn=lambda ln: ln.ingest_batch(timeout=0.05))

@@ -2005,6 +2005,31 @@ def run_role(
             seed=seed + 1 + task,
             remote_act=remote,
         )
+        # Pipelined actor data plane (runtime/actor_pipeline.py):
+        # double-buffered env slices + an async bounded publisher, so
+        # the jitted/remote act and the encode+PUT overlap the host env
+        # stepping. DRL_ACTOR_PIPE forces; unset defers to the
+        # committed benchmarks/actor_pipeline_verdict.json. On the TCP
+        # data plane the publisher gets its OWN client: the shared
+        # client's request/reply lock would otherwise serialize a
+        # publisher PUT against remote acts and weight pulls — exactly
+        # the blocking the pipeline exists to hide. (Ring PUTs are a
+        # lock-free memcpy; no second client needed.)
+        from distributed_reinforcement_learning_tpu.runtime import actor_pipeline
+
+        pub_client = None
+        if (actor_pipeline.pipeline_enabled()
+                and type(actor_queue) is RemoteQueue):
+            pub_client = TransportClient(server_ip, port)
+            actor = actor_pipeline.maybe_wrap(
+                actor, label=f"actor {task}",
+                publisher_queue=RemoteQueue(pub_client))
+        else:
+            actor = actor_pipeline.maybe_wrap(actor, label=f"actor {task}")
+        if pub_client is not None and not isinstance(
+                actor, actor_pipeline.ActorPipeline):
+            pub_client.close()  # wrap declined (unsliceable env)
+            pub_client = None
         # Fleet membership (runtime/fleet.py): register with the
         # learner's supervisor and heartbeat on a control connection;
         # each reply drives the demoted surfaces' bounded reattach
@@ -2111,6 +2136,10 @@ def run_role(
         finally:
             if heartbeats is not None:  # stop probes before surfaces close
                 heartbeats.stop()
+            if hasattr(actor, "close"):  # ActorPipeline: drain the publisher
+                actor.close()
+            if pub_client is not None:  # the publisher's dedicated lane
+                pub_client.close()
             if hasattr(actor_queue, "close"):  # RingQueue: release the shm map
                 actor_queue.close()
             if hasattr(actor_weights, "close"):  # BoardWeights: ditto

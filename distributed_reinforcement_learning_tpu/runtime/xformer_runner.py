@@ -24,11 +24,24 @@ import jax
 
 from distributed_reinforcement_learning_tpu.agents.xformer import XformerAgent
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round
-from distributed_reinforcement_learning_tpu.data.structures import XformerSequenceAccumulator
+from distributed_reinforcement_learning_tpu.data.structures import (
+    SlicedAccumulators,
+    XformerSequenceAccumulator,
+)
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+from distributed_reinforcement_learning_tpu.runtime.actor_pipeline import (
+    PipelineSlice,
+    push_window,
+    unpush_window,
+    shape_timeout,
+    slice_seed,
+    split_batched_env,
+    sync_slices_params,
+)
 from distributed_reinforcement_learning_tpu.runtime.r2d2_runner import (
     R2D2Learner,
+    run_async,  # noqa: F401  (re-exported: topology-only)
     run_sync,  # noqa: F401  (re-exported: the sync loop is topology-only)
 )
 from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
@@ -71,6 +84,7 @@ class XformerActor:
         self.obs_transform = obs_transform or (lambda x: x)
         self.remote_act = remote_act
 
+        self._seed = seed  # slice seeds derive from it (actor_pipeline)
         self._rng = jax.random.PRNGKey(seed)
         self._obs = self.obs_transform(env.reset())
         n = self._obs.shape[0]
@@ -98,11 +112,10 @@ class XformerActor:
 
     def _push_window(self, obs, prev_action) -> None:
         """Slide the window and append the CURRENT step (done not yet
-        known — False placeholder; segments only read earlier slots)."""
-        for arr, val in ((self._win_obs, obs), (self._win_pa, prev_action),
-                         (self._win_done, False)):
-            arr[:, :-1] = arr[:, 1:]
-            arr[:, -1] = val
+        known — False placeholder; segments only read earlier slots).
+        One definition for sequential and slice paths (actor_pipeline)."""
+        push_window(self._win_obs, self._win_pa, self._win_done,
+                    obs, prev_action)
 
     def run_unroll(self) -> int:
         """One seq_len unroll from all envs -> N sequences into the queue."""
@@ -134,10 +147,7 @@ class XformerActor:
 
             # Stable mode: a time-limit truncation is recorded (and
             # windowed) as if the episode continued — see R2D2Actor.
-            rec_done = done
-            if self.timeout_nonterminal:
-                trunc = np.asarray(infos.get("truncated", np.zeros_like(done)))
-                rec_done = done & ~trunc
+            rec_done = shape_timeout(done, infos, self.timeout_nonterminal)
 
             acc.append(
                 state=self._obs,
@@ -161,3 +171,98 @@ class XformerActor:
         with _OBS.span("actor_put"):
             put_round(self.queue, acc.extract())
         return n * cfg.seq_len
+
+    # -- slice protocol (runtime/actor_pipeline.py) --------------------
+    # The rolling window PERSISTS across rounds per slice (unlike
+    # ximpala's per-unroll reset); everything else mirrors run_unroll
+    # over the slice's own envs/seed.
+
+    def pipeline_round_steps(self) -> int:
+        return self.agent.cfg.seq_len
+
+    def pipeline_make_slices(self, k: int) -> list[PipelineSlice]:
+        self._slice_accs = SlicedAccumulators(XformerSequenceAccumulator, k)
+        w = self.agent.cfg.seq_len
+        slices = []
+        lo = 0
+        for i, env in enumerate(split_batched_env(self.env, k)):
+            hi = lo + env.num_envs
+            n = env.num_envs
+            seed = slice_seed(self._seed, i)
+            obs = self._obs[lo:hi].copy()
+            slices.append(PipelineSlice(
+                i, env, seed,
+                rng=jax.random.PRNGKey(seed),
+                obs=obs,
+                win_obs=np.zeros((n, w, *obs.shape[1:]), obs.dtype),
+                win_pa=np.zeros((n, w), np.int32),
+                win_done=np.ones((n, w), bool),
+                prev_action=np.zeros(n, np.int32),
+                episodes=np.zeros(n, np.int64),
+            ))
+            lo = hi
+        return slices
+
+    def _slice_epsilon(self, sl: PipelineSlice) -> np.ndarray:
+        return np.maximum(
+            1.0 / (self.epsilon_decay * sl.episodes + 1.0), self.epsilon_floor)
+
+    # One weights RPC per round, shared by all slices (actor_pipeline
+    # calls this before any slice_begin_round).
+    pipeline_sync_weights = sync_slices_params
+
+    def slice_begin_round(self, sl: PipelineSlice, steps: int) -> None:
+        if self.remote_act is None and sl.params is None:
+            raise RuntimeError("no weights published yet")
+        self._slice_accs.reset_slice(sl.index)
+
+    def slice_act(self, sl: PipelineSlice) -> np.ndarray:
+        # This family's window PERSISTS across rounds (no begin-round
+        # reset, unlike ximpala), so save what the push evicts: an act
+        # the pipeline discards mid-round-abort must be un-pushed.
+        sl.evicted = (sl.win_obs[:, 0].copy(), sl.win_pa[:, 0].copy(),
+                      sl.win_done[:, 0].copy())
+        push_window(sl.win_obs, sl.win_pa, sl.win_done, sl.obs, sl.prev_action)
+        epsilon = self._slice_epsilon(sl)
+        if self.remote_act is not None:
+            r = self.remote_act({
+                "obs": sl.win_obs, "prev_action": sl.win_pa,
+                "done": sl.win_done,
+                "epsilon": epsilon.astype(np.float32)})
+            action = r["action"]
+        else:
+            sl.rng, sub = jax.random.split(sl.rng)
+            action, _ = self.agent.act(
+                sl.params, sl.win_obs, sl.win_pa, sl.win_done, epsilon, sub)
+        return np.asarray(action)
+
+    def slice_discard_act(self, sl: PipelineSlice, out) -> None:
+        """An in-flight act the pipeline had to discard — settled
+        (`out` = its output) or RAISED (`out` = None; the push precedes
+        anything in slice_act that can raise) — pushed this slice's
+        persistent window; restore the pre-push bytes so the retry does
+        not condition every later act on a duplicated timestep."""
+        unpush_window(sl.win_obs, sl.win_pa, sl.win_done, sl.evicted)
+
+    def slice_step(self, sl: PipelineSlice, action: np.ndarray) -> tuple:
+        next_obs_raw, reward, done, infos = sl.env.step(action)
+        next_obs = self.obs_transform(next_obs_raw)
+        rec_done = shape_timeout(done, infos, self.timeout_nonterminal)
+        self._slice_accs.append_slice(
+            sl.index,
+            state=sl.obs,
+            previous_action=sl.prev_action,
+            action=action,
+            reward=reward.astype(np.float32),
+            done=rec_done,
+        )
+        sl.win_done[:, -1] = rec_done  # now known; future windows see it
+        sl.prev_action = np.where(rec_done, 0, action).astype(np.int32)
+        sl.obs = next_obs
+        sl.episodes += rec_done
+        for ret in completed_returns(infos, done):
+            sl.episode_returns.append(float(ret))
+        return ()
+
+    def slice_end_round(self, sl: PipelineSlice) -> tuple:
+        return (("round", self._slice_accs.extract_slice(sl.index)),)

@@ -25,9 +25,20 @@ import jax
 
 from distributed_reinforcement_learning_tpu.agents.ximpala import XImpalaAgent
 from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue, put_round
-from distributed_reinforcement_learning_tpu.data.structures import XImpalaTrajectoryAccumulator
+from distributed_reinforcement_learning_tpu.data.structures import (
+    SlicedAccumulators,
+    XImpalaTrajectoryAccumulator,
+)
 from distributed_reinforcement_learning_tpu.envs.batched import completed_returns
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
+from distributed_reinforcement_learning_tpu.runtime.actor_pipeline import (
+    PipelineSlice,
+    push_window,
+    shape_life_loss,
+    slice_seed,
+    split_batched_env,
+    sync_slices_params,
+)
 from distributed_reinforcement_learning_tpu.runtime.impala_runner import (
     ImpalaLearner,
     run_async,  # noqa: F401  (re-exported: topology-only)
@@ -62,6 +73,7 @@ class XImpalaActor:
         self.obs_transform = obs_transform or (lambda x: x)
         self.remote_act = remote_act
 
+        self._seed = seed  # slice seeds derive from it (actor_pipeline)
         self._rng = jax.random.PRNGKey(seed)
         self._obs = self.obs_transform(env.reset())
         n = self._obs.shape[0]
@@ -84,10 +96,9 @@ class XImpalaActor:
             self._params, self._version = got
 
     def _push_window(self, obs, prev_action) -> None:
-        for arr, val in ((self._win_obs, obs), (self._win_pa, prev_action),
-                         (self._win_done, False)):
-            arr[:, :-1] = arr[:, 1:]
-            arr[:, -1] = val
+        # One definition for sequential and slice paths (actor_pipeline).
+        push_window(self._win_obs, self._win_pa, self._win_done,
+                    obs, prev_action)
 
     def run_unroll(self) -> int:
         """Collect one T-step unroll from all N envs; enqueue N trajectories.
@@ -129,14 +140,12 @@ class XImpalaActor:
             next_obs_raw, reward, done, infos = self.env.step(env_actions)
             next_obs = self.obs_transform(next_obs_raw)
 
-            # Life-loss shaping (`train_impala.py:149-154`).
+            # Life-loss shaping (`train_impala.py:149-154`); one
+            # definition for sequential and slice paths (actor_pipeline).
             rec_reward, rec_done = reward.astype(np.float32), done.copy()
             if self.life_loss_shaping:
-                lives = infos.get("lives")
-                lost = (lives != self._lives) & (self._lives >= 0) & ~done
-                rec_reward = np.where(lost, -1.0, rec_reward)
-                rec_done = rec_done | lost
-                self._lives = np.where(done, -1, lives)
+                rec_reward, rec_done, self._lives = shape_life_loss(
+                    self._lives, reward, done, infos)
 
             acc.append(
                 state=self._obs,
@@ -161,3 +170,90 @@ class XImpalaActor:
         with _OBS.span("actor_put"):
             put_round(self.queue, acc.extract())
         return n * cfg.trajectory
+
+    # -- slice protocol (runtime/actor_pipeline.py) --------------------
+    # The window RESETS at each round start per slice (the family's
+    # behavior-policy conditioning contract — see run_unroll); life-loss
+    # shaping and the done/env_done split mirror the sequential loop.
+
+    def pipeline_round_steps(self) -> int:
+        return self.agent.cfg.trajectory
+
+    def pipeline_make_slices(self, k: int) -> list[PipelineSlice]:
+        self._slice_accs = SlicedAccumulators(XImpalaTrajectoryAccumulator, k)
+        w = self.agent.cfg.trajectory
+        slices = []
+        lo = 0
+        for i, env in enumerate(split_batched_env(self.env, k)):
+            hi = lo + env.num_envs
+            n = env.num_envs
+            seed = slice_seed(self._seed, i)
+            obs = self._obs[lo:hi].copy()
+            slices.append(PipelineSlice(
+                i, env, seed,
+                rng=jax.random.PRNGKey(seed),
+                obs=obs,
+                win_obs=np.zeros((n, w, *obs.shape[1:]), obs.dtype),
+                win_pa=np.zeros((n, w), np.int32),
+                win_done=np.ones((n, w), bool),
+                prev_action=np.zeros(n, np.int32),
+                lives=np.full(n, -1),
+            ))
+            lo = hi
+        return slices
+
+    # One weights RPC per round, shared by all slices (actor_pipeline
+    # calls this before any slice_begin_round).
+    pipeline_sync_weights = sync_slices_params
+
+    def slice_begin_round(self, sl: PipelineSlice, steps: int) -> None:
+        if self.remote_act is None and sl.params is None:
+            raise RuntimeError("no weights published yet")
+        self._slice_accs.reset_slice(sl.index)
+        sl.win_obs[:] = 0
+        sl.win_pa[:] = 0
+        sl.win_done[:] = True
+
+    def slice_act(self, sl: PipelineSlice) -> tuple:
+        push_window(sl.win_obs, sl.win_pa, sl.win_done, sl.obs, sl.prev_action)
+        if self.remote_act is not None:
+            r = self.remote_act({
+                "obs": sl.win_obs, "prev_action": sl.win_pa,
+                "done": sl.win_done})
+            action, policy = r["action"], r["policy"]
+        else:
+            sl.rng, sub = jax.random.split(sl.rng)
+            out = self.agent.act(
+                sl.params, sl.win_obs, sl.win_pa, sl.win_done, sub)
+            action, policy = out.action, out.policy
+        return np.asarray(action), np.asarray(policy)
+
+    def slice_step(self, sl: PipelineSlice, out: tuple) -> tuple:
+        action, policy = out
+        env_actions = (
+            action % self.available_action if self.available_action else action)
+        next_obs_raw, reward, done, infos = sl.env.step(env_actions)
+        next_obs = self.obs_transform(next_obs_raw)
+        rec_reward, rec_done = reward.astype(np.float32), done.copy()
+        if self.life_loss_shaping:
+            rec_reward, rec_done, sl.lives = shape_life_loss(
+                sl.lives, reward, done, infos)
+        self._slice_accs.append_slice(
+            sl.index,
+            state=sl.obs,
+            reward=rec_reward,
+            action=action,
+            done=rec_done,  # shaped -> V-trace discounts
+            env_done=done,  # true episode ends -> attention segments
+            behavior_policy=policy,
+            previous_action=sl.prev_action,
+        )
+        sl.win_done[:, -1] = done  # now known; future windows see it
+        sl.prev_action = np.where(done, 0, action).astype(np.int32)
+        sl.obs = next_obs
+        for ret in completed_returns(infos, done):
+            sl.episode_returns.append(float(ret))
+        return ()
+
+    def slice_end_round(self, sl: PipelineSlice) -> tuple:
+        return (("round", self._slice_accs.extract_slice(sl.index)),)

@@ -294,7 +294,7 @@ def build_report(tdir: str, merge: bool = True) -> str:
             if name.startswith(("staleness_bucket/", "codec/", "board/",
                                 "replay_shard/", "inference/",
                                 "remote_act/", "wshard/", "weights/",
-                                "fleet/")):
+                                "fleet/", "pipe/")):
                 continue  # rendered as their own sections below
             any_counter = True
             out(f"  {shard_label(shard):<14} {name:<28} "
@@ -354,6 +354,56 @@ def build_report(tdir: str, merge: bool = True) -> str:
         out("")
         out("-- Shm ring (co-hosted data plane) --")
         lines.extend(ring_lines)
+
+    # Actor pipeline (runtime/actor_pipeline.py): double-buffered
+    # sampling + async publication. Per actor shard: the step share
+    # (env-step span time over env-step + act-wait — 1.0 means the act
+    # worker's XLA/RPC latency is fully hidden behind host stepping),
+    # publisher depth/full-wait backpressure, per-slice frame counters
+    # and the demote/re-promote tallies. Section only appears when a
+    # run ran pipelined actors.
+    pipe_lines: list[str] = []
+    span_totals: dict[str, dict[str, float]] = {}
+    for r in rows:
+        if r["stage"] in ("pipe_act_wait", "pipe_env_step"):
+            span_totals.setdefault(r["proc"], {})[r["stage"]] = r["total_s"]
+    for shard in shards:
+        rates = shard.counter_rates()
+        if not any(k.startswith("pipe/") for k in rates):
+            continue
+
+        def total(key, rates=rates):
+            return rates.get(key, {}).get("total", 0)
+
+        spans = span_totals.get(shard_label(shard), {})
+        wait, step = spans.get("pipe_act_wait", 0.0), spans.get("pipe_env_step", 0.0)
+        share = f"step share {step / (wait + step):.0%}  " if wait + step else ""
+        pipe_lines.append(
+            f"  {shard_label(shard)}: {share}"
+            f"published {total('pipe/published_rounds'):.0f} rounds "
+            f"({total('pipe/published_unrolls'):.0f} unrolls), "
+            f"{total('pipe/demotions'):.0f} demotions, "
+            f"{total('pipe/repromotions'):.0f} re-promotions")
+        depth = shard.gauge_stats("pipe/publisher_depth")
+        if depth is not None:
+            fw = shard.gauge_stats("pipe/publisher_full_wait_ms")
+            fw_part = (f"  full-waits {total('pipe/publisher_full_waits'):.0f}"
+                       f" (mean {fw['mean']:.2f}ms, max {fw['max']:.2f}ms)"
+                       if fw is not None else "")
+            pipe_lines.append(
+                f"    publisher depth mean {depth['mean']:.1f}  "
+                f"max {depth['max']:.0f}{fw_part}")
+        per_slice = sorted(k for k in rates if k.startswith("pipe/slice")
+                           and k.endswith("_frames"))
+        if per_slice:
+            pipe_lines.append("    slice frames: " + "  ".join(
+                f"{k.removeprefix('pipe/').removesuffix('_frames')} "
+                f"{rates[k]['total']:.0f} ({rates[k]['rate']:.0f}/s)"
+                for k in per_slice))
+    if pipe_lines:
+        out("")
+        out("-- Actor pipeline (double-buffered sampling) --")
+        lines.extend(pipe_lines)
 
     # Codec fast path (data/codec.py): schema-cache hit rates and the
     # dedup wire-byte cut. Section only appears when a run recorded the

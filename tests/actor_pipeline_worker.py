@@ -1,0 +1,58 @@
+"""Actor-side child process for the actor-pipeline two-process e2e test.
+
+Runs a REAL ImpalaActor over CartPole envs against the parent's
+TransportServer through the deployed client surfaces (RemoteQueue PUTs,
+RemoteWeights pulls), wrapped in the pipelined data plane
+(double-buffered slices + async publisher). The parent decodes what
+landed in its queue and asserts it is bit-identical to plain sequential
+per-slice actors run in-process against the same published weights.
+Usage: python tests/actor_pipeline_worker.py <host> <port> <seed>
+       <num_envs> <rounds>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    host, port, seed, num_envs, rounds = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+        int(sys.argv[5]))
+
+    import jax  # noqa: F401  (configured cpu by the env)
+
+    from distributed_reinforcement_learning_tpu.agents.impala import (
+        ImpalaAgent, ImpalaConfig)
+    from distributed_reinforcement_learning_tpu.envs.batched import BatchedEnv
+    from distributed_reinforcement_learning_tpu.envs.registry import make_env
+    from distributed_reinforcement_learning_tpu.runtime import (
+        actor_pipeline, impala_runner)
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        RemoteQueue, RemoteWeights, TransportClient)
+
+    cfg = ImpalaConfig(obs_shape=(4,), num_actions=2, trajectory=8,
+                       lstm_size=32)
+    agent = ImpalaAgent(cfg)
+    env = BatchedEnv([
+        (lambda s=s: make_env("CartPole-v1", seed=s, num_actions=2))
+        for s in range(num_envs)
+    ])
+    client = TransportClient(host, port)
+    actor = impala_runner.ImpalaActor(
+        agent, env, RemoteQueue(client), RemoteWeights(client), seed=seed)
+    pipe = actor_pipeline.ActorPipeline(actor, num_slices=2)
+    frames = 0
+    for _ in range(rounds):
+        frames += pipe.run_unroll()
+    pipe.close()
+    client.close()
+    print("ACTOR_PIPE_WORKER=" + json.dumps(
+        {"frames": frames, "demotions": pipe.demotions,
+         "rounds": pipe.rounds}))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,7 +43,7 @@ _TRIMMED = {
     "BENCH_ANAKIN": "0", "BENCH_ANAKIN_R2D2": "0",
     "BENCH_TRANSPORT": "0", "BENCH_CODEC": "0", "BENCH_WEIGHTS": "0",
     "BENCH_WEIGHTS_SHARD": "0", "BENCH_REPLAY": "0", "BENCH_INFER": "0",
-    "BENCH_CHAOS": "0",
+    "BENCH_CHAOS": "0", "BENCH_ACTOR": "0",
 }
 
 
@@ -409,6 +409,69 @@ class TestInferenceCompare:
         assert replica_count() == 3  # env force wins over the verdict
         monkeypatch.setenv("DRL_INFER_REPLICAS", "0")
         assert replica_count() == 0
+
+
+class TestActorCompare:
+    """bench_actor_compare: the sequential-vs-pipelined actor A/B whose
+    verdict gates runtime/actor_pipeline's default. Driven directly at a
+    tiny config (CartPole flat obs — the child resolves envs by registry
+    name, so the tiny cfg rides an env whose shape the registry can
+    produce); the committed adjudication lives in
+    benchmarks/actor_pipeline_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        bench = _load_bench()
+        from distributed_reinforcement_learning_tpu.agents.impala import ImpalaConfig
+
+        cfg = ImpalaConfig(obs_shape=(4,), num_actions=2, trajectory=8,
+                           lstm_size=16)
+        r = bench.bench_actor_compare(cfg=cfg, num_envs=4, rounds=4,
+                                      warmup=1, env_name="CartPole-v0",
+                                      available_action=0)
+        for side in ("seq", "pipe"):
+            assert r[side]["frames_per_s"] > 0, r
+            assert r[side]["round_ms_p99"] >= r[side]["round_ms_p50"]
+        # Equal work per variant: same rounds x envs x trajectory.
+        assert r["seq"]["frames"] == r["pipe"]["frames"]
+        # Variant labeling honesty: the pipelined child reports the
+        # overlap it actually measured (act-wait/env-step per round
+        # interleave, put-wait per publisher submit), the sequential
+        # child the blocking PUT it actually paid.
+        overlap = r["pipe"]["overlap"]
+        for stage in ("act_wait_ms", "env_step_ms", "put_wait_ms"):
+            assert overlap[stage]["n"] > 0, overlap
+        assert r["seq"]["put_ms_p99"] >= r["seq"]["put_ms_p50"] > 0
+        assert r["pipe_vs_seq"] > 0
+        assert r["auto_enable"] == (r["pipe_vs_seq"] >= 1.2)
+        assert r["verdict"].startswith("actor pipeline ") and (
+            "auto-on" in r["verdict"] or "opt-in" in r["verdict"])
+
+    def test_compact_line_carries_actor_pipeline_verdict_key(self):
+        bench = _load_bench()
+        assert "actor_pipeline_verdict" in bench._COMPACT_KEYS
+        # The trimmed env the failure-mode subprocess tests run under
+        # must gate this (multi-process) section off.
+        assert _TRIMMED["BENCH_ACTOR"] == "0"
+
+    def test_committed_verdict_file_consistent(self, monkeypatch):
+        """The committed adjudication parses, and pipeline_enabled()
+        follows it when DRL_ACTOR_PIPE is unset (env force > committed
+        verdict > off)."""
+        monkeypatch.delenv("DRL_ACTOR_PIPE", raising=False)
+        verdict = json.loads(
+            (REPO / "benchmarks" / "actor_pipeline_verdict.json").read_text())
+        assert isinstance(verdict["auto_enable"], bool)
+        assert verdict["ratio_runs"] and verdict["bar"] == 1.2
+        from distributed_reinforcement_learning_tpu.runtime.actor_pipeline import (
+            pipeline_auto_enabled, pipeline_enabled)
+
+        assert pipeline_auto_enabled() is verdict["auto_enable"]
+        assert pipeline_enabled() is verdict["auto_enable"]
+        monkeypatch.setenv("DRL_ACTOR_PIPE", "1")
+        assert pipeline_enabled() is True  # env force wins over the verdict
+        monkeypatch.setenv("DRL_ACTOR_PIPE", "0")
+        assert pipeline_enabled() is False
 
 
 class TestChaosCompare:

@@ -524,7 +524,7 @@ class TestCliAndTreeGate:
         assert len(baseline.entries) <= BASELINE_MAX_ENTRIES
 
     def test_guarded_by_annotations_present(self):
-        """The seven threaded modules keep their concurrency maps — the
+        """The threaded modules keep their concurrency maps — the
         annotations double as documentation (ISSUE 2 satellite) and
         deleting one silently disables the race check for that class."""
         expected = {
@@ -544,6 +544,8 @@ class TestCliAndTreeGate:
             "data/native.py": 1,
             "runtime/fleet.py": 3,       # RetryLadder + FleetSupervisor
             #                              + HeartbeatLoop
+            "runtime/actor_pipeline.py": 2,  # UnrollPublisher +
+            #                                  ActorPipeline (doc form)
         }
         for rel, want in expected.items():
             src = (PKG / rel).read_text()
