@@ -80,7 +80,11 @@ def _load():
             raise RuntimeError(f"native library unavailable: {_build_error}")
         try:
             if _needs_build():
-                _build()
+                # Deliberate compile-under-lock: the exactly-once build
+                # of the .so IS what _lib_lock exists to serialize —
+                # sibling threads must wait for the artifact, not race
+                # the compiler. Cold path, runs once per checkout.
+                _build()  # drlint: disable=blocking-under-lock
             lib = ctypes.CDLL(_LIB_PATH)
         except (subprocess.CalledProcessError, OSError) as e:
             detail = getattr(e, "stderr", "") or str(e)

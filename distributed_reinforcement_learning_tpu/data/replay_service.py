@@ -571,7 +571,11 @@ class ShardedReplayService:
         while True:
             with self._work:
                 while not self._pending and not self._closed:
-                    self._work.wait()
+                    # Bounded wait (drlint blocking-under-lock): the
+                    # predicate is re-checked each wakeup, so a notify
+                    # lost to a close/enqueue race delays the router by
+                    # at most one tick instead of parking it forever.
+                    self._work.wait(timeout=0.5)
                 if self._closed and not self._pending:
                     return
                 packed, errs = self._pending.popleft()

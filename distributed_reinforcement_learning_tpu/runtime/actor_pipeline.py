@@ -297,7 +297,14 @@ class UnrollPublisher:
     def _run(self) -> None:
         while True:
             with self._cond:
-                self._cond.wait_for(lambda: self._pending or self._closed)
+                # Bounded wait (drlint blocking-under-lock): re-arm on
+                # timeout instead of parking forever behind a lost
+                # notify; a False return means neither pending work nor
+                # close, so just go around.
+                if not self._cond.wait_for(
+                        lambda: self._pending or self._closed,
+                        timeout=0.5):
+                    continue
                 if not self._pending:
                     return  # closed and empty: drain() owns nothing more
                 payload = self._pending[0]  # peek: a failure (or a drain

@@ -220,7 +220,11 @@ class ContinuousInferenceServer(InferenceServer):
                         batch.append(self._pending.popleft())
                     self._pending_rows -= rows
                     return batch
-                self._batch_ready.wait()
+                # Bounded wait (drlint blocking-under-lock): a lost
+                # notify — stop() racing a submit's early return — must
+                # not park the dispatch thread forever; the loop
+                # re-checks _stop/_pending each wakeup.
+                self._batch_ready.wait(timeout=0.5)
             return []
 
     def _loop(self) -> None:

@@ -822,16 +822,23 @@ class TransportClient(_LockedStatsMixin):
         # N blocking connects at actor startup).
 
     def _connect_locked(self) -> None:
+        # Deliberate blocking-under-lock (drlint): reconnect runs under
+        # the exchange lock BY DESIGN — `_lock` serializes the whole
+        # request/reply exchange including the socket lifecycle, so a
+        # concurrent caller must wait for the reconnect outcome rather
+        # than race a half-open socket. The lock-free escape for
+        # shutdown paths is abort() below; see its docstring.
         last: Exception | None = None
         for _ in range(self.connect_retries):
             try:
-                sock = socket.create_connection((self.host, self.port), timeout=300.0)
+                sock = socket.create_connection(  # drlint: disable=blocking-under-lock
+                    (self.host, self.port), timeout=300.0)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._sock = sock
                 return
             except OSError as e:
                 last = e
-                time.sleep(self.retry_interval)
+                time.sleep(self.retry_interval)  # drlint: disable=blocking-under-lock
         raise TransportError(f"cannot reach learner at {self.host}:{self.port}: {last}")
 
     def _exchange(self, op: int, payload, retry: bool, resend: bool) -> tuple[int, bytes]:
@@ -842,21 +849,28 @@ class TransportClient(_LockedStatsMixin):
 
         `payload` is bytes or a list of parts (sent without concatenating)."""
         parts = payload if isinstance(payload, list) else [payload]
+        # Deliberate blocking-under-lock (drlint): `_lock` exists to
+        # serialize the whole request/reply exchange on this socket —
+        # the send, the matching recv, and any reconnect between them
+        # are one atomic conversation, and a second caller interleaving
+        # frames would corrupt the protocol. Watchdog/shutdown paths
+        # that must not queue behind a wedged exchange use the
+        # lock-free abort() instead (see its docstring).
         with self._lock:
             if self._sock is None:  # a prior failed reconnect left us down
-                self._connect_locked()
+                self._connect_locked()  # drlint: disable=blocking-under-lock
             try:
-                _send_msg(self._sock, op, *parts)
-                return _recv_msg(self._sock)
+                _send_msg(self._sock, op, *parts)  # drlint: disable=blocking-under-lock
+                return _recv_msg(self._sock)  # drlint: disable=blocking-under-lock
             except (TransportError, OSError):
                 if not retry:
                     raise
                 self._close_locked()
-                self._connect_locked()
+                self._connect_locked()  # drlint: disable=blocking-under-lock
                 if not resend:
                     raise TransportError("connection lost mid-request") from None
-                _send_msg(self._sock, op, *parts)
-                return _recv_msg(self._sock)
+                _send_msg(self._sock, op, *parts)  # drlint: disable=blocking-under-lock
+                return _recv_msg(self._sock)  # drlint: disable=blocking-under-lock
 
     def _is_down(self) -> bool:
         """True when the last reconnect attempt failed (learner gone)."""

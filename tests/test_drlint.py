@@ -1,13 +1,15 @@
 """drlint (tools/drlint): per-pass fixtures + the tier-1 tree gate.
 
-Each of the five passes gets at least one positive fixture (violation
+Each of the nine passes gets at least one positive fixture (violation
 detected with the right rule id and line) and one negative fixture
 (idiomatic code passes), plus suppression-comment and baseline
-round-trip coverage — ISSUE 2's test contract. The final test IS the
-gate: the shipped package must lint clean against the committed
-baseline, forever. Everything here is pure-stdlib analysis of source
-strings — no jax import, so the whole module runs in well under the
-10 s budget on CPU.
+round-trip coverage — ISSUE 2's test contract, extended by ISSUE 12 to
+the whole-program passes (lock-order, blocking-under-lock,
+protocol-contract, knob-registry), the SARIF-lite JSON schema, and the
+`--changed` CLI mode. The final test IS the gate: the shipped package
+must lint clean against the committed baseline, forever. Everything
+here is pure-stdlib analysis of source strings — no jax import, so the
+whole module runs in a few seconds on one CPU core.
 """
 
 import json
@@ -19,13 +21,16 @@ from pathlib import Path
 import pytest
 
 from tools.drlint import (
+    ALL_RULES,
     Baseline,
     BaselineError,
     lint_paths,
     lint_source,
+    lint_sources,
     write_baseline,
 )
-from tools.drlint.core import BASELINE_MAX_ENTRIES, Finding
+from tools.drlint import knobs
+from tools.drlint.core import BASELINE_MAX_ENTRIES, Finding, ModuleInfo, Program
 
 REPO = Path(__file__).resolve().parent.parent
 PKG = REPO / "distributed_reinforcement_learning_tpu"
@@ -34,6 +39,12 @@ BASELINE = REPO / "tools" / "drlint" / "baseline.json"
 
 def lint(src: str, path: str = "distributed_reinforcement_learning_tpu/x.py"):
     return lint_source(textwrap.dedent(src), path)
+
+
+def lintp(src: str, path: str = "prog/x.py"):
+    """One-file PROGRAM lint — fixtures for the whole-program passes
+    (blocking-under-lock, lock-order, protocol-contract, knob-registry)."""
+    return lint_sources({path: textwrap.dedent(src)})
 
 
 def rules_of(findings):
@@ -266,7 +277,8 @@ class TestLockDiscipline:
 
                 def get(self):
                     with self._not_empty:
-                        self._not_empty.wait_for(lambda: len(self._items) > 0)
+                        self._not_empty.wait_for(
+                            lambda: len(self._items) > 0, timeout=1.0)
                         return self._items.pop()
         """)
         assert findings == []
@@ -551,3 +563,934 @@ class TestCliAndTreeGate:
             src = (PKG / rel).read_text()
             got = src.count("_GUARDED_BY")
             assert got >= want, f"{rel}: {got} _GUARDED_BY maps, want >= {want}"
+
+
+# -------------------------------------------------- blocking-under-lock
+
+class TestBlockingUnderLock:
+    def test_positive_pr9_heartbeat_stop_shape(self):
+        """The pinned PR 9 regression: a socket exchange (direct ops in
+        a *_locked helper + transitive calls under `with self._lock:`)
+        holds the client lock for the peer's full timeout, so stop()
+        blocks minutes behind it."""
+        findings = lintp("""
+            import socket
+            import threading
+            import time
+
+            def _recv_exact(sock, n):
+                buf = bytearray(n)
+                sock.recv_into(memoryview(buf), n)
+                return buf
+
+            class Client:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._sock = None
+
+                def _connect_locked(self):
+                    self._sock = socket.create_connection(("h", 1), timeout=300.0)
+                    time.sleep(1.0)
+
+                def exchange(self):
+                    with self._lock:
+                        if self._sock is None:
+                            self._connect_locked()
+                        return _recv_exact(self._sock, 8)
+        """)
+        assert set(rules_of(findings)) == {"blocking-under-lock"}
+        msgs = "\n".join(f.message for f in findings)
+        assert "socket.create_connection" in msgs     # in the _locked helper
+        assert "time.sleep" in msgs                   # ditto
+        assert "_connect_locked() which blocks" in msgs
+        assert "_recv_exact() which blocks" in msgs
+        assert {f.context for f in findings} == {
+            "Client._connect_locked", "Client.exchange"}
+
+    def test_positive_untimed_condition_waits(self):
+        """The ISSUE 12 tree fixes, pinned: ContinuousInferenceServer
+        ._take_batch / ShardedReplayService._route_loop (untimed
+        .wait()) and UnrollPublisher._run (untimed .wait_for())."""
+        findings = lintp("""
+            import threading
+
+            class Batcher:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._ready = threading.Condition(self._lock)
+                    self._pending = []
+                    self._stop = False
+
+                def take(self):
+                    with self._ready:
+                        while not self._stop:
+                            if self._pending:
+                                return self._pending.pop()
+                            self._ready.wait()
+                        return None
+
+                def run(self):
+                    with self._ready:
+                        self._ready.wait_for(lambda: self._pending or self._stop)
+        """)
+        assert rules_of(findings) == ["blocking-under-lock"] * 2
+        assert "untimed self._ready.wait()" in findings[0].message
+        assert "untimed self._ready.wait_for()" in findings[1].message
+
+    def test_positive_sleep_subprocess_shm_under_lock(self):
+        findings = lintp("""
+            import subprocess
+            import threading
+            import time
+            from multiprocessing.shared_memory import SharedMemory
+
+            class Seg:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._shm = None
+
+                def rebuild(self):
+                    with self._lock:
+                        time.sleep(0.5)
+                        subprocess.run(["true"], check=True)
+                        self._shm = SharedMemory(name="x", create=True, size=8)
+
+                def drop(self):
+                    with self._lock:
+                        self._shm.unlink()
+        """)
+        assert rules_of(findings) == ["blocking-under-lock"] * 4
+        msgs = "\n".join(f.message for f in findings)
+        assert "time.sleep(0.5)" in msgs
+        assert "subprocess.run" in msgs
+        assert "SharedMemory" in msgs
+        assert ".unlink()" in msgs
+
+    def test_positive_acquire_try_finally_release(self):
+        """Regression: the canonical explicit-lock idiom — blocking
+        work in a try body between acquire() and a finally release() —
+        runs lock-held and must be flagged."""
+        findings = lintp("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def slow(self):
+                    self._lock.acquire()
+                    try:
+                        time.sleep(1.0)
+                    finally:
+                        self._lock.release()
+
+                def flat(self):
+                    self._lock.acquire()
+                    time.sleep(1.0)
+                    self._lock.release()
+                    time.sleep(1.0)  # after release: not held
+        """)
+        assert rules_of(findings) == ["blocking-under-lock"] * 2
+        assert {f.context for f in findings} == {"C.slow", "C.flat"}
+
+    def test_positive_acquire_nested_in_compound_statements(self):
+        """Regression: acquires inside if/try bodies get the same
+        statement-list tracking as function-top-level ones."""
+        findings = lintp("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def in_try(self):
+                    try:
+                        self._lock.acquire()
+                        time.sleep(1.0)
+                    finally:
+                        self._lock.release()
+
+                def in_if(self, cond):
+                    if cond:
+                        self._lock.acquire()
+                        time.sleep(1.0)
+                        self._lock.release()
+        """)
+        assert rules_of(findings) == ["blocking-under-lock"] * 2
+        assert {f.context for f in findings} == {"C.in_try", "C.in_if"}
+
+    def test_negative_timed_waits_and_unlocked_blocking(self):
+        findings = lintp("""
+            import socket
+            import threading
+            import time
+
+            class Ok:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+
+                def fetch(self):
+                    sock = socket.create_connection(("h", 1))  # no lock held
+                    time.sleep(1.0)                            # ditto
+                    return sock.recv_into(bytearray(8), 8)
+
+                def wait_bounded(self):
+                    with self._cond:
+                        self._cond.wait(timeout=0.5)
+                        self._cond.wait_for(lambda: True, timeout=0.5)
+
+                def tiny_sleep(self):
+                    with self._lock:
+                        time.sleep(0.001)  # below threshold: tolerated
+        """)
+        assert findings == []
+
+    def test_positive_explicit_timeout_none_is_untimed(self):
+        """Regression: a literal `timeout=None` is provably unbounded
+        and must not satisfy the untimed-wait check."""
+        findings = lintp("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def park(self):
+                    with self._cond:
+                        self._cond.wait(timeout=None)
+        """)
+        assert rules_of(findings) == ["blocking-under-lock"]
+        assert "untimed" in findings[0].message
+
+    def test_negative_bounded_wait_in_locked_helper(self):
+        """Regression: a *_locked helper's bounded wait on its own
+        condition releases the caller's mutex — the caller-lock
+        sentinel must not turn it into a blocking-under-lock finding."""
+        findings = lintp("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def _drain_locked(self):
+                    self._cond.wait(timeout=0.5)
+        """)
+        assert findings == []
+
+    def test_suppression_applies(self):
+        findings = lintp("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def slow(self):
+                    with self._lock:
+                        # deliberate: fixture mirror of the transport
+                        # client's serialized exchange
+                        time.sleep(1.0)  # drlint: disable=blocking-under-lock
+        """)
+        assert findings == []
+
+
+# --------------------------------------------------------------- lock-order
+
+class TestLockOrder:
+    def test_positive_cross_module_cycle(self):
+        """Two classes in two files acquiring each other's locks in
+        opposite orders through typed attributes — the whole-program
+        graph closes the cycle no single-module pass could see."""
+        sup = """
+            import threading
+
+            class Supervisor:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._ladder = Ladder()
+
+                def sweep(self):
+                    with self._lock:
+                        self._ladder.bump()
+
+                def poke(self):
+                    with self._lock:
+                        pass
+        """
+        lad = """
+            import threading
+
+            class Ladder:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._sup = Supervisor()
+
+                def bump(self):
+                    with self._lock:
+                        pass
+
+                def backcall(self):
+                    with self._lock:
+                        self._sup.poke()
+        """
+        findings = lint_sources({
+            "prog/supervisor.py": textwrap.dedent(sup),
+            "prog/ladder.py": textwrap.dedent(lad),
+        })
+        assert rules_of(findings) == ["lock-order"]
+        msg = findings[0].message
+        assert "Supervisor._lock" in msg and "Ladder._lock" in msg
+        assert "potential deadlock" in msg
+
+    def test_positive_inconsistent_order_one_module(self):
+        findings = lint_sources({"prog/m.py": textwrap.dedent("""
+            import threading
+
+            class Both:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def ba(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)})
+        assert rules_of(findings) == ["lock-order"]
+        assert "Both._a" in findings[0].message
+        assert "Both._b" in findings[0].message
+
+    def test_positive_module_level_lock_cycle(self):
+        """Module-level locks (native.py's _lib_lock shape) are graph
+        nodes too — including edges through same-module function calls
+        and mixed class/module-lock cycles."""
+        findings = lint_sources({"prog/m.py": textwrap.dedent("""
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+
+            def grab_b():
+                with _b:
+                    pass
+
+
+            def ab():
+                with _a:
+                    grab_b()
+
+
+            def ba():
+                with _b:
+                    with _a:
+                        pass
+        """)})
+        assert rules_of(findings) == ["lock-order"]
+        assert "prog/m.py._a" in findings[0].message
+        assert "prog/m.py._b" in findings[0].message
+
+    def test_positive_class_and_module_lock_cycle(self):
+        findings = lint_sources({"prog/m.py": textwrap.dedent("""
+            import threading
+
+            _flag_lock = threading.Lock()
+
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def one(self):
+                    with self._lock:
+                        with _flag_lock:
+                            pass
+
+                def two(self):
+                    with _flag_lock:
+                        with self._lock:
+                            pass
+        """)})
+        assert rules_of(findings) == ["lock-order"]
+        assert "C._lock" in findings[0].message
+        assert "_flag_lock" in findings[0].message
+
+    def test_positive_acquire_try_finally_leg_closes_cycle(self):
+        """Regression: a cycle whose leg uses the explicit
+        acquire/try/finally idiom must still produce its edge."""
+        findings = lint_sources({"prog/m.py": textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._x = threading.Lock()
+                    self._y = threading.Lock()
+
+                def f(self):
+                    self._x.acquire()
+                    try:
+                        with self._y:
+                            pass
+                    finally:
+                        self._x.release()
+
+                def g(self):
+                    with self._y:
+                        with self._x:
+                            pass
+        """)})
+        assert rules_of(findings) == ["lock-order"]
+        assert "C._x" in findings[0].message and "C._y" in findings[0].message
+
+    def test_positive_inherited_condition_alias_cross_module(self):
+        """Regression: a subclass in another module inherits the base's
+        locks and Condition-over-lock aliases — an untimed wait on the
+        inherited condition is found, and a bounded wait under the
+        aliased mutex is NOT a blocking-under-lock false positive."""
+        base = """
+            import threading
+
+            class Base:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._ready = threading.Condition(self._lock)
+        """
+        sub = """
+            class Sub(Base):
+                def bad(self):
+                    with self._lock:
+                        self._ready.wait()
+
+                def fine(self):
+                    with self._lock:
+                        self._ready.wait(timeout=0.5)
+        """
+        findings = lint_sources({
+            "prog/base.py": textwrap.dedent(base),
+            "prog/sub.py": textwrap.dedent(sub),
+        })
+        assert rules_of(findings) == ["blocking-under-lock"]
+        assert "untimed self._ready.wait()" in findings[0].message
+        assert findings[0].context == "Sub.bad"
+
+    def test_negative_acquire_in_nested_def_is_not_held(self):
+        """Regression: an acquire inside a lambda/nested def runs later
+        (or never) — it must not poison the rest of the function."""
+        findings = lint_sources({"prog/m.py": textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def go(self, sock, cb):
+                    cb(lambda: self._lock.acquire())
+                    return sock.recv(4)
+        """)})
+        assert findings == []
+
+    def test_negative_try_acquire_is_not_an_edge(self):
+        """Regression: `.acquire(blocking=False)` is the deadlock-
+        AVOIDANCE idiom — a try-lock never waits and must not close a
+        reported cycle."""
+        findings = lint_sources({"prog/m.py": textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def ba_try(self):
+                    with self._b:
+                        got = self._a.acquire(blocking=False)
+                        if got:
+                            self._a.release()
+        """)})
+        assert findings == []
+
+    def test_negative_consistent_nesting_and_alias(self):
+        findings = lint_sources({"prog/m.py": textwrap.dedent("""
+            import threading
+
+            class Fine:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._cond = threading.Condition(self._a)
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def cond_over_lock(self):
+                    # Condition over self._a aliases to the same mutex:
+                    # no self-edge, no cycle.
+                    with self._cond:
+                        pass
+        """)})
+        assert findings == []
+
+
+# --------------------------------------------------------- protocol-contract
+
+PROTO_SRC = textwrap.dedent("""
+    OP_PUT = 1
+    OP_GET = 2
+    OP_PING = 3
+
+    ST_OK = 0
+    ST_BUSY = 1
+    ST_CLOSED = 2
+
+
+    def _send(conn, tag, payload=b""):
+        conn.write(bytes([tag]) + payload)
+
+
+    class Server:
+        def serve(self, conn, op, payload):
+            try:
+                if op == OP_PUT:
+                    ok = self.q.put(payload)
+                    _send(conn, ST_OK if ok else ST_BUSY)
+                elif op == OP_GET:
+                    _send(conn, ST_OK, self.w.blob())
+                elif op == OP_PING:
+                    _send(conn, ST_OK)
+                else:
+                    _send(conn, 99)
+            except RuntimeError:
+                _send(conn, ST_CLOSED)
+
+
+    class Client:
+        def _exchange(self, op, payload):
+            return 0, b""
+
+        def _call(self, op, payload=b""):
+            status, resp = self._exchange(op, payload)
+            if status != ST_OK:
+                raise RuntimeError("op failed")
+            return resp
+
+        def put(self, blob):
+            status, _ = self._exchange(OP_PUT, blob)
+            if status == ST_BUSY:
+                return False
+            if status == ST_CLOSED:
+                raise RuntimeError("closed")
+            return True
+
+        def get(self):
+            return self._call(OP_GET)
+
+        def ping(self):
+            return self._call(OP_PING)
+""")
+
+TRANSPORT = PKG / "runtime" / "transport.py"
+TRANSPORT_OPS = [
+    "OP_PUT_TRAJ", "OP_GET_WEIGHTS", "OP_QUEUE_SIZE", "OP_PING", "OP_ACT",
+    "OP_PUT_TRAJ_N", "OP_GET_WEIGHTS_SHARDED", "OP_REGISTER", "OP_HEARTBEAT",
+]
+
+
+class TestProtocolContract:
+    def test_negative_complete_fixture(self):
+        assert lint_sources({"proto/wire.py": PROTO_SRC}) == []
+
+    @pytest.mark.parametrize("arm,op", [
+        ("if op == OP_PUT:", "OP_PUT"),
+        ("elif op == OP_GET:", "OP_GET"),
+        ("elif op == OP_PING:", "OP_PING"),
+    ])
+    def test_deleted_dispatch_arm_detected(self, arm, op):
+        broken = PROTO_SRC.replace(arm, arm.replace(op, "(-77)"))
+        findings = lint_sources({"proto/wire.py": broken})
+        assert any(f.rule == "protocol-contract"
+                   and f"{op} has no server dispatch arm" in f.message
+                   for f in findings), findings
+
+    def test_deleted_sender_detected(self):
+        broken = PROTO_SRC.replace("self._exchange(OP_PUT, blob)",
+                                   "self._exchange(1, blob)")
+        findings = lint_sources({"proto/wire.py": broken})
+        assert any("OP_PUT has no client sender" in f.message
+                   for f in findings), findings
+
+    def test_unhandled_status_detected(self):
+        old_put = (
+            "    def put(self, blob):\n"
+            "        status, _ = self._exchange(OP_PUT, blob)\n"
+            "        if status == ST_BUSY:\n"
+            "            return False\n"
+            "        if status == ST_CLOSED:\n"
+            '            raise RuntimeError("closed")\n'
+            "        return True\n")
+        new_put = (
+            "    def put(self, blob):\n"
+            "        status, _ = self._exchange(OP_PUT, blob)\n"
+            "        return status == ST_OK\n")
+        broken = PROTO_SRC.replace(old_put, new_put)
+        assert broken != PROTO_SRC
+        findings = lint_sources({"proto/wire.py": broken})
+        assert rules_of(findings) == ["protocol-contract"]
+        assert "caller put() of OP_PUT" in findings[0].message
+        assert "ST_BUSY" in findings[0].message
+        assert "ST_CLOSED" in findings[0].message
+
+    def test_dropped_status_comparison_is_not_a_catch_all(self):
+        """Regression: computing `status != ST_OK` without raising on
+        it proves nothing — the caller still swallows every non-OK
+        status."""
+        old_put = (
+            "    def put(self, blob):\n"
+            "        status, _ = self._exchange(OP_PUT, blob)\n"
+            "        if status == ST_BUSY:\n"
+            "            return False\n"
+            "        if status == ST_CLOSED:\n"
+            '            raise RuntimeError("closed")\n'
+            "        return True\n")
+        new_put = (
+            "    def put(self, blob):\n"
+            "        status, _ = self._exchange(OP_PUT, blob)\n"
+            "        junk = status != ST_OK\n"
+            "        return None\n")
+        broken = PROTO_SRC.replace(old_put, new_put)
+        assert broken != PROTO_SRC
+        findings = lint_sources({"proto/wire.py": broken})
+        assert rules_of(findings) == ["protocol-contract"]
+        assert "caller put() of OP_PUT" in findings[0].message
+
+    def test_real_transport_covers_all_nine_ops(self):
+        """Acceptance: the live protocol has dispatch + sender coverage
+        for every opcode, proven by the pass's own model."""
+        from tools.drlint.rules import protocol_contract as pc
+
+        src = TRANSPORT.read_text()
+        mod = ModuleInfo(src, "distributed_reinforcement_learning_tpu/"
+                              "runtime/transport.py")
+        ops = pc._module_consts(mod, pc._OP_RE)
+        assert sorted(ops) == sorted(TRANSPORT_OPS)
+        server = pc._ServerModel(mod, ops)
+        assert sorted(server.dispatched) == sorted(TRANSPORT_OPS)
+        # Every op reaches ST_CLOSED through the shared queue-closed arm.
+        for op in TRANSPORT_OPS:
+            assert "ST_CLOSED" in server.dispatched[op], op
+        assert pc.check(Program([mod])) == []
+
+    @pytest.mark.parametrize("op", TRANSPORT_OPS)
+    def test_deleting_any_real_arm_detected(self, op):
+        """Acceptance: neutralize one opcode in a fixture copy of the
+        REAL transport module (every use except the definition) — the
+        pass must report the lost dispatch arm."""
+        import re as _re
+
+        from tools.drlint.rules import protocol_contract as pc
+
+        src = TRANSPORT.read_text()
+        broken = _re.sub(rf"\b{op}\b(?!\s*=)", "(-77)", src)
+        mod = ModuleInfo(broken, "proto/transport_copy.py")
+        findings = pc.check(Program([mod]))
+        assert any(f"{op} has no server dispatch arm" in f.message
+                   for f in findings), (op, findings)
+
+
+# ------------------------------------------------------------- knob-registry
+
+class TestKnobRegistry:
+    def test_positive_unregistered_knob(self):
+        findings = lint_sources({"fixture/mod.py": textwrap.dedent("""
+            import os
+
+            def gate():
+                return os.environ.get("DRL_NOT_A_REGISTERED_KNOB", "0")
+        """)})
+        assert rules_of(findings) == ["knob-registry"]
+        assert "DRL_NOT_A_REGISTERED_KNOB" in findings[0].message
+        assert "tools/drlint/knobs.py" in findings[0].message
+
+    def test_negative_registered_knob(self):
+        findings = lint_sources({"fixture/mod.py": textwrap.dedent("""
+            import os
+
+            def gate():
+                return os.environ.get("DRL_FLEET", "") != "0"
+        """)})
+        assert findings == []
+
+    def test_stale_registry_entry_detected(self):
+        """A linted module that IS a knob's registered owner but no
+        longer references it -> stale finding (the registry must shrink
+        with the code)."""
+        findings = lint_sources({
+            "distributed_reinforcement_learning_tpu/utils/profiling.py":
+                "def noop():\n    return 1\n"})
+        stale = [f for f in findings if "stale registry entry" in f.message]
+        assert {f.rule for f in stale} == {"knob-registry"}
+        assert any("DRL_PROFILE_DIR" in f.message for f in stale)
+
+    def test_registry_round_trips_against_tree(self):
+        """Every DRL_* literal in the tree is registered; every
+        registered knob is read somewhere (the ISSUE 12 acceptance)."""
+        unregistered, stale = knobs.round_trip()
+        assert unregistered == {}, unregistered
+        assert stale == [], stale
+
+    def test_registry_owners_are_accurate(self):
+        """The stale-entry leg of the pass keys on the owner module
+        actually reading its knob — so every registered owner must."""
+        for name, k in knobs.KNOBS.items():
+            owner = REPO / k.owner
+            assert owner.exists(), (name, k.owner)
+            assert f'"{name}"' in owner.read_text(), (name, k.owner)
+
+    def test_docs_table_is_generated_and_current(self):
+        text = (REPO / "docs" / "performance.md").read_text()
+        assert knobs.docs_drift(text) is None
+        # ... and a hand-edit of the table is drift.
+        assert "| `DRL_FLEET` |" in text
+        tampered = text.replace("| `DRL_FLEET` |", "| `DRL_FLEETX` |")
+        drift = knobs.docs_drift(tampered)
+        assert drift is not None and "drifted" in drift
+
+    def test_docs_drift_is_a_lint_failure(self, monkeypatch):
+        """The program pass turns docs drift into a finding against the
+        gate tree (fixture: point the pass at a tampered docs copy)."""
+        real = (REPO / "docs" / "performance.md").read_text()
+        import tempfile, os as _os
+
+        with tempfile.TemporaryDirectory() as td:
+            bad = _os.path.join(td, "performance.md")
+            with open(bad, "w") as f:
+                f.write(real.replace("| `DRL_FLEET` |", "| `DRL_FLEETX` |"))
+            monkeypatch.setattr(knobs, "DOCS_PATH", bad)
+            findings = lint_sources({
+                "distributed_reinforcement_learning_tpu/fixture.py":
+                    "def f():\n    return 0\n"})
+            assert any(f.rule == "knob-registry"
+                       and f.path == "docs/performance.md"
+                       for f in findings), findings
+
+    def test_registry_entry_validation(self):
+        with pytest.raises(ValueError, match="bad type"):
+            knobs.Knob("DRL_X", "banana", "0", "o.py", "doc")
+        with pytest.raises(ValueError, match="bad knob name"):
+            knobs.Knob("NOT_DRL", "flag", "0", "o.py", "doc")
+        with pytest.raises(ValueError, match="owner and doc"):
+            knobs.Knob("DRL_X", "flag", "0", "", "doc")
+        assert len(knobs.KNOBS) >= 60  # the tree's knob count at ISSUE 12
+
+
+# ------------------------------------------------- SARIF-lite JSON + changed
+
+class TestJsonSchema:
+    def test_cli_sarif_lite_document(self, tmp_path):
+        bad = tmp_path / "mod.py"
+        bad.write_text("import numpy as np\n\ndef f():\n    return np.random.rand()\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", "--json", "--no-baseline",
+             str(bad)],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 1, proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["schema"] == "drlint-json-v2"
+        assert set(out) == {"schema", "findings", "grandfathered",
+                            "stale_baseline_entries", "rules", "summary"}
+        (f,) = out["findings"]
+        # THE pinned record shape: exactly these six keys.
+        assert set(f) == {"rule", "file", "line", "context", "message",
+                          "fingerprint"}
+        assert f["rule"] == "nondeterminism"
+        assert f["file"].endswith("mod.py")
+        assert isinstance(f["line"], int) and f["line"] > 0
+        assert len(f["fingerprint"]) == 16
+        int(f["fingerprint"], 16)  # hex
+        assert set(out["summary"]) == {"findings", "baselined", "files",
+                                       "rules"}
+        assert len(out["rules"]) == 9
+
+    def test_fingerprint_stable_across_line_shifts(self):
+        src = "import numpy as np\n\ndef f():\n    return np.random.rand()\n"
+        (a,) = lint_source(src, "p/mod.py")
+        (b,) = lint_source("\n\n" + src, "p/mod.py")
+        assert a.line != b.line
+        assert a.fingerprint() == b.fingerprint()
+        # ...but the fingerprint distinguishes files and rules.
+        (c,) = lint_source(src, "p/other.py")
+        assert a.fingerprint() != c.fingerprint()
+
+    def test_text_mode_prints_summary_json_line(self, tmp_path):
+        good = tmp_path / "ok.py"
+        good.write_text("def f():\n    return 1\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", str(good)],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["drlint"]["findings"] == 0
+        assert summary["drlint"]["files"] == 1
+
+
+class TestChangedMode:
+    def _git(self, cwd, *args):
+        subprocess.run(["git", *args], cwd=cwd, check=True,
+                       capture_output=True, text=True)
+
+    def test_changed_mode_lints_diff_only(self, tmp_path):
+        import os as _os
+
+        env = dict(_os.environ, PYTHONPATH=str(REPO))
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "config", "user.email", "t@example.com")
+        self._git(tmp_path, "config", "user.name", "t")
+        clean = "def f():\n    return 1\n"
+        (tmp_path / "mod.py").write_text(clean)
+        (tmp_path / "other.py").write_text(
+            "import numpy as np\n\ndef g():\n    return np.random.rand()\n")
+        self._git(tmp_path, "add", ".")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        # Nothing changed: exit 0 without linting other.py's violation.
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", "--changed", "HEAD",
+             "--no-baseline"],
+            capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "no .py files changed" in proc.stderr
+        # Introduce a violation in mod.py only: --changed flags it.
+        (tmp_path / "mod.py").write_text(
+            "import numpy as np\n\ndef f():\n    return np.random.rand()\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", "--changed", "HEAD",
+             "--no-baseline"],
+            capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+        assert proc.returncode == 1, proc.stderr
+        assert "mod.py" in proc.stdout
+        assert "other.py" not in proc.stdout  # committed, unchanged
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["drlint"]["files"] == 1
+
+    def test_changed_json_empty_diff_keeps_schema(self, tmp_path):
+        """Regression: --changed --json must emit the SARIF-lite
+        document on the all-clean (no diff) case too."""
+        import os as _os
+
+        env = dict(_os.environ, PYTHONPATH=str(REPO))
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "config", "user.email", "t@example.com")
+        self._git(tmp_path, "config", "user.name", "t")
+        (tmp_path / "seed.py").write_text("def f():\n    return 1\n")
+        self._git(tmp_path, "add", ".")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", "--changed", "--json"],
+            capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["schema"] == "drlint-json-v2"
+        assert out["findings"] == []
+        assert out["summary"]["files"] == 0
+
+    def test_changed_mode_includes_untracked(self, tmp_path):
+        import os as _os
+
+        env = dict(_os.environ, PYTHONPATH=str(REPO))
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "config", "user.email", "t@example.com")
+        self._git(tmp_path, "config", "user.name", "t")
+        (tmp_path / "seed.py").write_text("def f():\n    return 1\n")
+        self._git(tmp_path, "add", ".")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        (tmp_path / "fresh.py").write_text(
+            "import numpy as np\n\ndef g():\n    return np.random.rand()\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", "--changed",
+             "--no-baseline"],
+            capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+        assert proc.returncode == 1, proc.stderr
+        assert "fresh.py" in proc.stdout
+
+
+class TestRuleRegistry:
+    def test_all_nine_rules_registered(self):
+        assert sorted(ALL_RULES) == sorted([
+            "jit-purity", "host-sync", "lock-discipline", "nondeterminism",
+            "dtype-pitfall", "blocking-under-lock",
+            "lock-order", "protocol-contract", "knob-registry",
+        ])
+
+    def test_partial_runs_do_not_misreport_stale_baseline(self, tmp_path):
+        """Regression: a baseline entry whose rule didn't run (or whose
+        file wasn't linted) is out of scope, not stale — `--rules`
+        subsets and `--changed` diffs must keep exiting 0."""
+        entry = {"rule": "nondeterminism", "path": "a/mod.py",
+                 "context": "f", "justification": "fixture: known rng use"}
+        path = tmp_path / "base.json"
+        path.write_text(json.dumps({"entries": [entry]}))
+        baseline = Baseline.load(str(path))
+        # Rule didn't run: not stale.
+        _, _, stale = baseline.split([], ran_rules={"lock-order"},
+                                     linted_paths={"a/mod.py"})
+        assert stale == []
+        # File wasn't linted: not stale.
+        _, _, stale = baseline.split([], ran_rules={"nondeterminism"},
+                                     linted_paths={"b/other.py"})
+        assert stale == []
+        # Both in scope and the finding is gone: NOW it's stale.
+        _, _, stale = baseline.split([], ran_rules={"nondeterminism"},
+                                     linted_paths={"a/mod.py"})
+        assert stale == [entry]
+        # Whole-tree gate semantics unchanged (None = everything ran).
+        _, _, stale = baseline.split([])
+        assert stale == [entry]
+
+    def test_changed_mode_validates_rules_before_early_exit(self, tmp_path):
+        """Regression: a typo'd --rules id must fail rc 2 even when the
+        diff is empty, not green-light the run."""
+        import os as _os
+
+        env = dict(_os.environ, PYTHONPATH=str(REPO))
+        subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", "--changed",
+             "--rules", "totally-bogus"],
+            capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+        assert proc.returncode == 2, (proc.stdout, proc.stderr)
+        assert "unknown rules" in proc.stderr
+
+    def test_rules_subset_selects_program_rules_only(self, tmp_path):
+        """Regression: `--rules <program-rule>` must not fall back to
+        running every per-module pass (the empty-dict-is-falsy bug)."""
+        bad = tmp_path / "mod.py"
+        bad.write_text(
+            "import numpy as np\n\ndef f():\n    return np.random.rand()\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint", "--rules", "lock-order",
+             "--no-baseline", str(bad)],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["drlint"] == {"findings": 0, "baselined": 0,
+                                     "files": 1, "rules": 1}

@@ -1,32 +1,52 @@
 """drlint: repo-native static analysis for the TPU RL stack.
 
-Five stdlib-`ast` passes encode the invariants the paper's architecture
+Nine stdlib-`ast` passes encode the invariants the paper's architecture
 depends on but nothing previously enforced (docs/static_analysis.md has
-the full catalog and workflow):
+the full catalog and workflow). Per-module passes:
 
-- ``jit-purity``       no host side effects inside traced (jit/pmap/
-                       shard_map/lax-control-flow) functions
-- ``host-sync``        no hidden device syncs inside the learner/actor
-                       step loops of ``runtime/``
-- ``lock-discipline``  attributes declared in a class's ``_GUARDED_BY``
-                       map are only touched under the matching lock
-- ``nondeterminism``   no module-level ``random``/``np.random`` RNG in
-                       library code (seeded generators are fine)
-- ``dtype-pitfall``    no dtype-less numpy constructors / ``np.float64``
-                       on device-bound paths (silently breaks bf16)
+- ``jit-purity``          no host side effects inside traced (jit/pmap/
+                          shard_map/lax-control-flow) functions
+- ``host-sync``           no hidden device syncs inside the learner/
+                          actor step loops of ``runtime/``
+- ``lock-discipline``     attributes declared in a class's
+                          ``_GUARDED_BY`` map are only touched under
+                          the matching lock
+- ``nondeterminism``      no module-level ``random``/``np.random`` RNG
+                          in library code (seeded generators are fine)
+- ``dtype-pitfall``       no dtype-less numpy constructors /
+                          ``np.float64`` on device-bound paths
+
+Whole-program passes (every linted file forms one Program):
+
+- ``blocking-under-lock`` no socket I/O, subprocess, long/unbounded
+                          sleeps, shm attach/unlink, or untimed
+                          condition waits while a mutex is held
+                          (inheritance-aware across modules)
+- ``lock-order``          global lock-acquisition graph; cycles
+                          (potential deadlocks) are findings
+- ``protocol-contract``   every ``OP_*`` has a server dispatch arm and
+                          a client sender; every reachable ``ST_*`` is
+                          handled (or typed-raised) by each caller
+- ``knob-registry``       every ``DRL_*`` literal names a registered
+                          knob (tools/drlint/knobs.py) and the
+                          docs/performance.md table matches the
+                          registry byte-for-byte
 
 Run ``python -m tools.drlint <paths>`` (see ``scripts/drlint.sh``), or
-use :func:`lint_paths` / :func:`lint_source` from tests. Pure stdlib:
-importing this package must never pull in jax/numpy — it has to run in
-a bare CI interpreter in well under a second.
+use :func:`lint_paths` / :func:`lint_source` / :func:`lint_sources`
+from tests. Pure stdlib: importing this package must never pull in
+jax/numpy — it has to run in a bare CI interpreter in well under a
+second.
 """
 
 from tools.drlint.core import (  # noqa: F401
     Baseline,
     BaselineError,
     Finding,
+    Program,
     lint_paths,
     lint_source,
+    lint_sources,
     write_baseline,
 )
-from tools.drlint.rules import RULES  # noqa: F401
+from tools.drlint.rules import ALL_RULES, PROGRAM_RULES, RULES  # noqa: F401

@@ -1,10 +1,22 @@
 """CLI: `python -m tools.drlint <paths>` (scripts/drlint.sh wraps this).
 
-Exit codes: 0 = clean (after baseline), 1 = non-baselined findings,
-2 = usage / parse / baseline-format error. The default baseline is
+Exit codes: 0 = clean (after baseline), 1 = non-baselined findings or
+stale baseline entries (scoped to what this run covered), 2 = usage /
+parse / baseline-format error. The default baseline is
 tools/drlint/baseline.json when it exists; `--no-baseline` ignores it,
 `--write-baseline` regenerates it from the current findings (still
 subject to the 10-entry cap — fix findings, don't freeze them).
+
+`--changed [BASE]` lints only the .py files `git diff --name-only
+BASE` (default HEAD) reports, plus untracked ones — the fast local
+iteration loop. The cross-module passes then see only that subset, so
+a whole-tree contract (a deleted dispatch arm's missing opcode) still
+needs the full run the tier-1 gate performs.
+
+Text mode always ends with one compact JSON summary line on stdout
+(`{"drlint": {...}}`) — the line scripts/drlint.sh and CI grep;
+`--json` emits the full SARIF-lite document instead (schema pinned in
+tests/test_drlint.py::TestJsonSchema).
 """
 
 from __future__ import annotations
@@ -12,12 +24,46 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
-from tools.drlint.core import Baseline, BaselineError, lint_paths, write_baseline
-from tools.drlint.rules import RULES
+from tools.drlint.core import (
+    Baseline,
+    BaselineError,
+    iter_py_files,
+    lint_paths,
+    repo_rel,
+    write_baseline,
+)
+from tools.drlint.rules import ALL_RULES, PROGRAM_RULES, RULES
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+JSON_SCHEMA = "drlint-json-v2"
+
+
+def changed_py_files(base: str) -> list[str]:
+    """Changed-vs-`base` plus untracked .py files, absolute paths,
+    resolved against the git toplevel of the CWD. NUL-separated git
+    output (`-z`) so names with spaces or non-ASCII bytes survive."""
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True, check=True
+                         ).stdout.strip()
+    names = subprocess.run(
+        ["git", "diff", "--name-only", "-z", base, "--"],
+        capture_output=True, text=True, check=True, cwd=top
+        ).stdout.split("\0")
+    names += subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "-z"],
+        capture_output=True, text=True, check=True, cwd=top
+        ).stdout.split("\0")
+    out = []
+    for n in names:
+        if n.endswith(".py"):
+            p = os.path.join(top, n)
+            if os.path.isfile(p):
+                out.append(p)
+    return sorted(set(out))
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -26,8 +72,13 @@ def main(argv: list[str] | None = None) -> int:
         description="Repo-native static analysis (see docs/static_analysis.md)")
     ap.add_argument("paths", nargs="*", default=[],
                     help="files or directories to lint")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="BASE",
+                    help="lint only .py files changed vs BASE (default "
+                         "HEAD) plus untracked ones; positional paths "
+                         "are ignored")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable output on stdout")
+                    help="machine-readable SARIF-lite output on stdout")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset of rule ids to run")
     ap.add_argument("--list-rules", action="store_true")
@@ -40,22 +91,50 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for name in RULES:
+        for name in ALL_RULES:
             print(name)
         return 0
-    if not args.paths:
-        ap.error("no paths given")
 
-    rules = RULES
+    # Rule selection is validated BEFORE any --changed early exit: a
+    # typo'd rule id must fail (rc 2) on a no-change run too, not
+    # green-light the CI job until the next diff arrives.
+    rules, program_rules = RULES, PROGRAM_RULES
     if args.rules:
         wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
-        unknown = [r for r in wanted if r not in RULES]
+        unknown = [r for r in wanted if r not in ALL_RULES]
         if unknown:
             ap.error(f"unknown rules: {', '.join(unknown)} "
-                     f"(have: {', '.join(RULES)})")
-        rules = {r: RULES[r] for r in wanted}
+                     f"(have: {', '.join(ALL_RULES)})")
+        rules = {r: RULES[r] for r in wanted if r in RULES}
+        program_rules = {r: PROGRAM_RULES[r] for r in wanted
+                         if r in PROGRAM_RULES}
 
-    findings, errors = lint_paths(args.paths, rules)
+    if args.changed is not None:
+        try:
+            paths = changed_py_files(args.changed)
+        except (subprocess.CalledProcessError, OSError) as e:
+            print(f"drlint: --changed needs a git checkout: {e}",
+                  file=sys.stderr)
+            return 2
+        if not paths:
+            # Fall through with an empty file set: the normal exit path
+            # emits the output contract (SARIF-lite document or summary
+            # line) from ONE place, all-clean case included.
+            print(f"drlint: no .py files changed vs {args.changed}",
+                  file=sys.stderr)
+    else:
+        paths = args.paths
+        if not paths:
+            ap.error("no paths given (or use --changed)")
+
+    try:
+        # Enumerate once: the flat file list feeds both lint_paths and
+        # the summary's file count (no second tree walk).
+        files = iter_py_files(paths)
+        findings, errors = lint_paths(files, rules, program_rules)
+    except FileNotFoundError as e:
+        print(f"drlint: error: no such path: {e}", file=sys.stderr)
+        return 2
     if errors:
         for e in errors:
             print(f"drlint: error: {e}", file=sys.stderr)
@@ -67,6 +146,11 @@ def main(argv: list[str] | None = None) -> int:
         baseline_path = None
 
     if args.write_baseline:
+        if args.changed is not None or args.rules:
+            # A baseline regenerated from a partial run would silently
+            # drop every out-of-scope entry; only full runs may write.
+            ap.error("--write-baseline needs a full run "
+                     "(drop --changed/--rules)")
         target = args.baseline or DEFAULT_BASELINE
         try:
             write_baseline(findings, target)
@@ -85,14 +169,25 @@ def main(argv: list[str] | None = None) -> int:
         except (BaselineError, OSError, json.JSONDecodeError) as e:
             print(f"drlint: bad baseline {baseline_path}: {e}", file=sys.stderr)
             return 2
-        findings, grandfathered, stale = baseline.split(findings)
+        # Stale detection scoped to what this run actually covered: on
+        # a --rules subset or --changed diff, entries for unlinted
+        # files / unrun rules are simply out of scope, not stale.
+        findings, grandfathered, stale = baseline.split(
+            findings,
+            ran_rules=set(rules) | set(program_rules),
+            linted_paths={repo_rel(f) for f in files})
 
+    summary = {"findings": len(findings), "baselined": len(grandfathered),
+               "files": len(files),
+               "rules": len(rules) + len(program_rules)}
     if args.as_json:
         print(json.dumps({
-            "findings": [f.__dict__ for f in findings],
-            "grandfathered": [f.__dict__ for f in grandfathered],
+            "schema": JSON_SCHEMA,
+            "findings": [f.to_json() for f in findings],
+            "grandfathered": [f.to_json() for f in grandfathered],
             "stale_baseline_entries": stale,
-            "rules": list(rules),
+            "rules": [*rules, *program_rules],
+            "summary": summary,
         }, indent=2))
     else:
         for f in findings:
@@ -101,10 +196,10 @@ def main(argv: list[str] | None = None) -> int:
             print(f"drlint: warning: stale baseline entry {e['rule']} @ "
                   f"{e['path']} ({e['context']}) — the finding is gone; "
                   f"remove the entry", file=sys.stderr)
-        summary = (f"drlint: {len(findings)} finding(s)"
-                   f" ({len(grandfathered)} baselined)")
-        print(summary, file=sys.stderr)
-    return 1 if findings else 0
+        print(f"drlint: {len(findings)} finding(s)"
+              f" ({len(grandfathered)} baselined)", file=sys.stderr)
+        print(json.dumps({"drlint": summary}))
+    return 1 if findings or stale else 0
 
 
 if __name__ == "__main__":

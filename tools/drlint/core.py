@@ -7,6 +7,7 @@ tier-1 and must cost milliseconds, not a jax import.
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import os
 import re
@@ -55,6 +56,23 @@ class Finding:
         grandfathered finding is matched by (rule, path, context)."""
         return (self.rule, self.path, self.context)
 
+    def fingerprint(self) -> str:
+        """Stable finding identity for CI diff annotation (the SARIF
+        partialFingerprints idea): line numbers churn with every edit,
+        so the hash covers (rule, path, context, message) only. Two
+        byte-identical findings in one context share a fingerprint —
+        that is the SARIF behavior too, and it is what makes the id
+        survive an unrelated edit three lines above."""
+        blob = "|".join((self.rule, self.path, self.context, self.message))
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def to_json(self) -> dict:
+        """The pinned SARIF-lite record (tests/test_drlint.py
+        TestJsonSchema): exactly these six keys, `file` repo-relative."""
+        return {"rule": self.rule, "file": self.path, "line": self.line,
+                "context": self.context, "message": self.message,
+                "fingerprint": self.fingerprint()}
+
     def render(self) -> str:
         where = f" (in {self.context})" if self.context else ""
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}{where}"
@@ -102,8 +120,15 @@ class Baseline:
         return ((e["rule"], e["path"], e["context"]) == f.key()
                 and e.get("match", "") in f.message)
 
-    def split(self, findings: list[Finding]) -> tuple[list[Finding], list[Finding], list[dict]]:
-        """-> (new, grandfathered, stale_entries)."""
+    def split(self, findings: list[Finding], ran_rules=None,
+              linted_paths=None) -> tuple[list[Finding], list[Finding], list[dict]]:
+        """-> (new, grandfathered, stale_entries).
+
+        An unhit entry is STALE only when this run could have produced
+        its finding: its rule among `ran_rules` and its path among
+        `linted_paths` (None = everything ran/was linted — the
+        whole-tree gate). Partial runs (`--rules` subsets, `--changed`)
+        must not misreport still-valid entries as stale."""
         new, old = [], []
         hit: set[int] = set()
         for f in findings:
@@ -114,7 +139,10 @@ class Baseline:
             else:
                 hit.add(idx)
                 old.append(f)
-        stale = [e for i, e in enumerate(self.entries) if i not in hit]
+        stale = [e for i, e in enumerate(self.entries)
+                 if i not in hit
+                 and (ran_rules is None or e["rule"] in ran_rules)
+                 and (linted_paths is None or e["path"] in linted_paths)]
         return new, old, stale
 
 
@@ -243,34 +271,98 @@ def iter_py_files(paths: list[str]) -> list[str]:
     return out
 
 
-def lint_source(src: str, path: str = "<string>",
-                rules: dict | None = None) -> list[Finding]:
-    """Lint one source blob; suppression comments applied, no baseline."""
-    from tools.drlint.rules import RULES
+class Program:
+    """The whole-program view the cross-module passes analyze: every
+    parsed module of one lint invocation, plus shared lookups. Built
+    once per `lint_paths`/`lint_sources` call — a pass must derive all
+    global facts (lock graphs, opcode tables, knob reads) from here,
+    never from re-reading the filesystem."""
 
-    mod = ModuleInfo(src, path)
+    def __init__(self, modules: list[ModuleInfo]):
+        self.modules = modules
+        self.by_path: dict[str, ModuleInfo] = {m.path: m for m in modules}
+        self._cache: dict[str, object] = {}  # cross-pass scratch
+
+    def module_for(self, f: Finding) -> ModuleInfo | None:
+        return self.by_path.get(f.path)
+
+
+def _run_module_rules(mod: ModuleInfo, rules: dict) -> list[Finding]:
     findings: list[Finding] = []
-    for name, check in (rules or RULES).items():
+    for name, check in rules.items():
         for f in check(mod):
             assert f.rule == name, (f.rule, name)
             if not mod.suppressed(f):
                 findings.append(f)
+    return findings
+
+
+def _run_program_rules(program: Program, program_rules: dict) -> list[Finding]:
+    findings: list[Finding] = []
+    for name, check in program_rules.items():
+        for f in check(program):
+            assert f.rule == name, (f.rule, name)
+            mod = program.module_for(f)
+            if mod is None or not mod.suppressed(f):
+                findings.append(f)
+    return findings
+
+
+def lint_source(src: str, path: str = "<string>",
+                rules: dict | None = None) -> list[Finding]:
+    """Lint one source blob with the per-module rules; suppression
+    comments applied, no baseline, no cross-module passes (those need a
+    Program — use `lint_sources` or `lint_paths`)."""
+    from tools.drlint.rules import RULES
+
+    mod = ModuleInfo(src, path)
+    findings = _run_module_rules(mod, RULES if rules is None else rules)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
 
-def lint_paths(paths: list[str], rules: dict | None = None
+def _lint_modules(mods: list[ModuleInfo], rules: dict | None,
+                  program_rules: dict | None) -> list[Finding]:
+    """The one lint tail both entry points share: per-module rules on
+    each module, then the cross-module passes over the whole set as one
+    Program, sorted."""
+    from tools.drlint.rules import PROGRAM_RULES, RULES
+
+    findings: list[Finding] = []
+    for mod in mods:
+        findings.extend(_run_module_rules(mod, RULES if rules is None else rules))
+    program = Program(mods)
+    findings.extend(_run_program_rules(
+        program, PROGRAM_RULES if program_rules is None else program_rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_sources(sources: dict[str, str], rules: dict | None = None,
+                 program_rules: dict | None = None) -> list[Finding]:
+    """Lint a {path: source} set as ONE program: per-module rules on
+    each file plus the cross-module passes over the whole set. The
+    fixture-side mirror of `lint_paths` (tests hand it small multi-file
+    programs without touching the filesystem)."""
+    return _lint_modules([ModuleInfo(src, path)
+                          for path, src in sources.items()],
+                         rules, program_rules)
+
+
+def lint_paths(paths: list[str], rules: dict | None = None,
+               program_rules: dict | None = None
                ) -> tuple[list[Finding], list[str]]:
     """Lint files/trees -> (findings, errors). Unparseable files are
     reported as errors, not silently skipped (a syntax error in a linted
-    module must fail the gate, not shrink its coverage)."""
-    findings: list[Finding] = []
+    module must fail the gate, not shrink its coverage). All given files
+    form ONE program for the cross-module passes."""
+    mods: list[ModuleInfo] = []
     errors: list[str] = []
     for path in iter_py_files(paths):
         try:
             with open(path, encoding="utf-8") as f:
                 src = f.read()
-            findings.extend(lint_source(src, repo_rel(path), rules))
+            mods.append(ModuleInfo(src, repo_rel(path)))
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             errors.append(f"{path}: {type(e).__name__}: {e}")
-    return findings, errors
+    return _lint_modules(mods, rules, program_rules), errors

@@ -1,4 +1,10 @@
-"""Rule registry: id -> check(ModuleInfo) -> list[Finding].
+"""Rule registries.
+
+`RULES`: id -> check(ModuleInfo) -> list[Finding] — per-module passes.
+`PROGRAM_RULES`: id -> check(Program) -> list[Finding] — whole-program
+passes (lock graphs, protocol contracts, registry round-trips) that
+need every linted module at once; `lint_paths`/`lint_sources` build the
+Program and run them after the per-module passes.
 
 Rule ids are the kebab-case names used in suppression comments
 (`# drlint: disable=<id>`) and baseline entries. Adding a rule = adding
@@ -6,11 +12,15 @@ a module here + a catalog section in docs/static_analysis.md + a
 positive/negative fixture pair in tests/test_drlint.py.
 """
 
+from tools.drlint.rules.blocking_under_lock import check as _blocking_under_lock
 from tools.drlint.rules.dtype_pitfall import check as _dtype_pitfall
 from tools.drlint.rules.host_sync import check as _host_sync
 from tools.drlint.rules.jit_purity import check as _jit_purity
+from tools.drlint.rules.knob_registry import check as _knob_registry
 from tools.drlint.rules.lock_discipline import check as _lock_discipline
+from tools.drlint.rules.lock_order import check as _lock_order
 from tools.drlint.rules.nondeterminism import check as _nondeterminism
+from tools.drlint.rules.protocol_contract import check as _protocol_contract
 
 RULES = {
     "jit-purity": _jit_purity,
@@ -19,3 +29,12 @@ RULES = {
     "nondeterminism": _nondeterminism,
     "dtype-pitfall": _dtype_pitfall,
 }
+
+PROGRAM_RULES = {
+    "blocking-under-lock": _blocking_under_lock,
+    "lock-order": _lock_order,
+    "protocol-contract": _protocol_contract,
+    "knob-registry": _knob_registry,
+}
+
+ALL_RULES = {**RULES, **PROGRAM_RULES}

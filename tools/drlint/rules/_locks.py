@@ -1,0 +1,334 @@
+"""Shared concurrency model for lock-order and blocking-under-lock.
+
+Derives, per module, the facts both passes key on:
+
+- **lock attributes** per class: every `self.X` assigned a
+  `threading.Lock/RLock/Condition/Semaphore`, every name appearing as a
+  value in the class's `_GUARDED_BY` map, and — because every bare
+  `with self.X:` in this codebase is a lock (locks are the only
+  attribute context managers the runtime uses) — any attribute used as
+  a bare `with` target. Conditions constructed OVER a lock
+  (`threading.Condition(self._lock)`) alias to that lock: they are the
+  same mutex, and treating them as two would fabricate ordering edges.
+- **module-level locks**: `_flag_lock = threading.Lock()` and friends,
+  acquired as `with _flag_lock:` from module functions.
+- **typed attributes** per class: `self.X = ClassName(...)` pins X to a
+  class the whole-program pass can resolve, so a call `self.X.m()`
+  under a held lock contributes the locks `ClassName.m` acquires to the
+  global acquisition graph. Name resolution is simple-name based and
+  program-scoped — the same deliberate coarseness as `_traced.py`.
+
+Everything is cached on `ModuleInfo._cache` so the two passes share one
+walk per module.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.drlint.core import ModuleInfo
+
+LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+CONDITION_CTORS = {"threading.Condition"}
+
+
+def walk_same_flow(node: ast.AST):
+    """ast.walk that stays in the CURRENT control flow: nested function
+    definitions and lambdas are not entered (their bodies run later —
+    or never — not at this point in the enclosing function), so an
+    `acquire()` inside a callback must not count as acquired here."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        stack.extend(c for c in ast.iter_child_nodes(n)
+                     if not isinstance(c, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef, ast.Lambda)))
+
+
+def is_blocking_acquire(call: ast.Call) -> bool:
+    """False for `.acquire(blocking=False)` — a try-lock never waits,
+    so it can neither hang under a lock nor close a deadlock cycle."""
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return False
+    return True
+
+
+class HeldWalker:
+    """THE held-lock statement walker both concurrency passes share —
+    one definition of what counts as lock-held code:
+
+    - a bare `with <lock>:` holds for its body;
+    - an explicit blocking `.acquire()` holds for the REST of its
+      statement list (the acquire/try/finally idiom: every statement
+      list — function bodies, `with`/`if`/`try`/loop bodies — gets the
+      same tracking), a bare `.release()` statement ends the hold
+      before it, and a release nested deeper (the `finally`) ends it
+      after its enclosing statement;
+    - nested function definitions run later, not under the lock (held
+      resets); lambdas run inline (the `wait_for(lambda: ...)` idiom)
+      and inherit it;
+    - acquire/release BOOKKEEPING never crosses into nested def/lambda
+      bodies (`walk_same_flow`) — a callback's acquire has not
+      happened at this point in the enclosing function.
+
+    Subclasses provide `lock_of(expr)` (held-set element for a
+    with-target / acquire-receiver, or None) and `handle_node(node,
+    held)` (leaf inspection: calls, waits); `handle_with_acquired` is
+    the with-acquisition hook lock-order's edge collection uses.
+    """
+
+    def lock_of(self, expr: ast.AST):
+        raise NotImplementedError
+
+    def handle_node(self, node: ast.AST, held: tuple) -> None:
+        pass
+
+    def handle_with_acquired(self, item_expr: ast.AST, lock,
+                             held_before: tuple) -> None:
+        pass
+
+    def _release_target(self, node: ast.AST):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "release":
+            return self.lock_of(node.func.value)
+        return None
+
+    def walk_body(self, body: list, held: tuple) -> None:
+        extra: list = []
+        for stmt in body:
+            if isinstance(stmt, ast.Expr):
+                released = self._release_target(stmt.value)
+                if released is not None and released in extra:
+                    extra.remove(released)
+            self.visit(stmt, held + tuple(extra))
+            for node in walk_same_flow(stmt):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "acquire" and \
+                        is_blocking_acquire(node):
+                    lock = self.lock_of(node.func.value)
+                    if lock is not None and lock not in extra:
+                        extra.append(lock)
+            for node in walk_same_flow(stmt):
+                released = self._release_target(node)
+                if released is not None and released in extra:
+                    extra.remove(released)
+
+    def visit(self, node: ast.AST, held: tuple) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                self.visit(item.context_expr, tuple(inner))
+                lock = self.lock_of(item.context_expr)
+                if lock is not None:
+                    self.handle_with_acquired(item.context_expr, lock,
+                                              tuple(inner))
+                    if lock not in inner:
+                        inner.append(lock)
+                if item.optional_vars is not None:
+                    self.visit(item.optional_vars, tuple(inner))
+            self.walk_body(node.body, tuple(inner))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.walk_body(node.body, ())
+            return
+        self.handle_node(node, held)
+        # Route every nested STATEMENT list (if/try/loop bodies) through
+        # walk_body so explicit acquires are tracked there too; other
+        # children (expressions, lambdas — which run inline and inherit
+        # `held`) recurse normally.
+        for _field, value in ast.iter_fields(node):
+            if isinstance(value, list):
+                if value and isinstance(value[0], ast.stmt):
+                    self.walk_body(value, held)
+                else:
+                    for child in value:
+                        if isinstance(child, ast.AST):
+                            self.visit(child, held)
+            elif isinstance(value, ast.AST):
+                self.visit(value, held)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _called_chain_tail(mod: ModuleInfo, call: ast.Call) -> str | None:
+    """Last dotted segment of a resolvable constructor chain, or the
+    bare callee name (`RetryLadder(...)`, `threading.Lock()` -> 'Lock'
+    with the full chain checked by the caller)."""
+    chain = mod.resolve_chain(call.func)
+    if chain is not None:
+        return chain
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+@dataclass
+class ClassModel:
+    """Concurrency-relevant facts of one class definition."""
+
+    name: str
+    node: ast.ClassDef
+    mod: ModuleInfo
+    bases: list[str] = field(default_factory=list)
+    lock_attrs: set[str] = field(default_factory=set)
+    cond_attrs: set[str] = field(default_factory=set)
+    # Condition-over-lock aliasing: attr -> canonical lock attr name.
+    alias: dict[str, str] = field(default_factory=dict)
+    # self.X = ClassName(...) -> {'X': 'ClassName'} (program-resolved).
+    typed_attrs: dict[str, str] = field(default_factory=dict)
+    methods: dict[str, ast.FunctionDef] = field(default_factory=dict)
+
+    def canon(self, attr: str) -> str:
+        return self.alias.get(attr, attr)
+
+
+def _guarded_by_values(cls: ast.ClassDef) -> set[str]:
+    out: set[str] = set()
+    for stmt in cls.body:
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            target = stmt.targets[0].id
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            target = stmt.target.id
+        if target != "_GUARDED_BY" or not isinstance(stmt.value, ast.Dict):
+            continue
+        for v in stmt.value.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                out.update(e.value for e in v.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, str))
+    return out
+
+
+def _build_class(mod: ModuleInfo, cls: ast.ClassDef) -> ClassModel:
+    model = ClassModel(name=cls.name, node=cls, mod=mod)
+    for base in cls.bases:
+        if isinstance(base, ast.Name):
+            model.bases.append(base.id)
+        elif isinstance(base, ast.Attribute):
+            model.bases.append(base.attr)
+    model.lock_attrs |= _guarded_by_values(cls)
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            model.methods[stmt.name] = stmt
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                chain = _called_chain_tail(mod, node.value)
+                if chain in LOCK_CTORS:
+                    model.lock_attrs.add(attr)
+                    if chain in CONDITION_CTORS:
+                        model.cond_attrs.add(attr)
+                        # Condition(self._lock): same mutex, alias it.
+                        if node.value.args:
+                            over = _self_attr(node.value.args[0])
+                            if over is not None:
+                                model.alias[attr] = over
+                                model.lock_attrs.add(over)
+                elif chain is not None:
+                    # self.X = ClassName(...) — keep the last segment;
+                    # capitalization is the class-vs-factory heuristic.
+                    last = chain.rsplit(".", 1)[-1]
+                    if last[:1].isupper():
+                        model.typed_attrs[attr] = last
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    model.lock_attrs.add(attr)
+    return model
+
+
+@dataclass
+class ModuleModel:
+    classes: dict[str, ClassModel]
+    module_locks: set[str]  # module-level lock variable names
+    functions: dict[str, ast.FunctionDef]  # module-level defs
+
+
+def module_model(mod: ModuleInfo) -> ModuleModel:
+    cached = mod._cache.get("lock_model")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    classes = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef):
+            classes[node.name] = _build_class(mod, node)
+    module_locks: set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _called_chain_tail(mod, node.value) in LOCK_CTORS:
+                module_locks.update(t.id for t in node.targets
+                                    if isinstance(t, ast.Name))
+    functions = {n.name: n for n in mod.tree.body
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    model = ModuleModel(classes=classes, module_locks=module_locks,
+                        functions=functions)
+    mod._cache["lock_model"] = model
+    return model
+
+
+def merged_class(program, cls: ClassModel,
+                 _seen: frozenset = frozenset()) -> ClassModel:
+    """Single-inheritance merge: fold program-resolvable base classes'
+    lock/typed/method maps under the subclass's (subclass wins). Needed
+    so `ContinuousInferenceServer` inherits `_batch_ready`'s aliasing
+    from `InferenceServer` instead of looking like a second mutex."""
+    if not cls.bases or cls.name in _seen:
+        return cls
+    classes = program_classes(program)
+    merged = ClassModel(name=cls.name, node=cls.node, mod=cls.mod,
+                        bases=list(cls.bases))
+    for base_name in cls.bases:
+        base = classes.get(base_name)
+        if base is None or base.name == cls.name:
+            continue
+        base = merged_class(program, base, _seen | {cls.name})
+        merged.lock_attrs |= base.lock_attrs
+        merged.cond_attrs |= base.cond_attrs
+        merged.alias.update(base.alias)
+        merged.typed_attrs.update(base.typed_attrs)
+        merged.methods.update(base.methods)
+    merged.lock_attrs |= cls.lock_attrs
+    merged.cond_attrs |= cls.cond_attrs
+    merged.alias.update(cls.alias)
+    merged.typed_attrs.update(cls.typed_attrs)
+    merged.methods.update(cls.methods)
+    return merged
+
+
+def program_classes(program) -> dict[str, ClassModel]:
+    """Simple-name -> ClassModel across the program (first definition
+    wins on a name collision — the same coarseness `_traced.py` accepts
+    for method names)."""
+    cached = program._cache.get("classes")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    out: dict[str, ClassModel] = {}
+    for mod in program.modules:
+        for name, cls in module_model(mod).classes.items():
+            out.setdefault(name, cls)
+    program._cache["classes"] = out
+    return out

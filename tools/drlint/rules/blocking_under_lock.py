@@ -1,0 +1,271 @@
+"""blocking-under-lock: no unbounded waits while a mutex is held.
+
+The PR 9 heartbeat hang, generalized: `HeartbeatLoop`'s exchange thread
+sat in a lock-held socket recv with a 300 s timeout, so `stop()` —
+queued behind that lock — blocked a shutdown for minutes. Any blocking
+call under a lock turns every OTHER user of that lock into a hostage of
+the slowest peer, which in a distributed runtime means a dead learner
+wedges actor shutdown paths. The pass flags, while a lock is lexically
+held (a bare `with self.X:` / `with module_lock:`, a blocking
+`self.X.acquire()`, or anywhere inside a `*_locked` method — the
+caller-holds-the-lock contract):
+
+- socket I/O: `socket.create_connection`, and `.connect/.accept/
+  .recv/.recv_into/.recvfrom/.sendall/.sendmsg` method calls;
+- `subprocess.*` / `os.system` calls;
+- `time.sleep(x)` with `x` >= SLEEP_THRESHOLD_S (or non-constant: the
+  bound is not provable);
+- shared-memory attach/unlink (`SharedMemory(...)`, `.unlink()`) —
+  kernel-arbitrated operations with unbounded tail latency;
+- calls to same-module functions / same-class methods that themselves
+  block, transitively — the real PR 9 shape was one hop removed.
+
+Independent of any held lock, it also flags **untimed condition
+waits**: `self.<cond>.wait()` with no timeout and `wait_for(pred)`
+without one. `Condition.wait` releases its own mutex, so it is not
+"blocking under" THAT lock — but an untimed wait parks the thread
+forever if the notify is lost (a peer died mid-publish), and every
+such site in this codebase has a `_stop`/`_closed` predicate it should
+be re-checking on a bounded cadence. Holding a SECOND lock across a
+condition wait is flagged as blocking-under-lock proper.
+
+Deliberately-held designs (the transport client serializes its whole
+request/reply exchange under `_lock` and documents `abort()` as the
+out-of-band escape) carry inline suppressions with the justifying
+comment — same contract as host-sync's deliberate syncs.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Finding, ModuleInfo, Program
+from tools.drlint.rules._locks import (
+    ClassModel,
+    HeldWalker,
+    _self_attr,
+    merged_class,
+    module_model,
+)
+
+RULE = "blocking-under-lock"
+
+SLEEP_THRESHOLD_S = 0.05
+
+_SOCKET_METHODS = {"connect", "accept", "recv", "recv_into", "recvfrom",
+                   "sendall", "sendmsg"}
+_WAIT_METHODS = {"wait", "wait_for"}
+
+# The sentinel "some lock" held throughout *_locked methods.
+_CALLER_LOCK = "<caller lock>"
+
+
+def _chain(mod: ModuleInfo, node: ast.AST) -> str | None:
+    return mod.resolve_chain(node)
+
+
+def _classify_call(mod: ModuleInfo, call: ast.Call) -> str | None:
+    """-> human description of a DIRECT blocking operation, or None."""
+    chain = _chain(mod, call.func) or ""
+    if chain == "socket.create_connection":
+        return "socket.create_connection"
+    if chain.startswith("subprocess.") or chain == "os.system":
+        return chain
+    if chain == "time.sleep":
+        arg = call.args[0] if call.args else None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
+            if arg.value < SLEEP_THRESHOLD_S:
+                return None
+            return f"time.sleep({arg.value:g})"
+        return "time.sleep(<non-constant>)"
+    last = chain.rsplit(".", 1)[-1] if chain else None
+    if last == "SharedMemory" or (
+            isinstance(call.func, ast.Name) and call.func.id == "SharedMemory"):
+        return "shared-memory attach (SharedMemory(...))"
+    if isinstance(call.func, ast.Attribute):
+        meth = call.func.attr
+        if meth in _SOCKET_METHODS:
+            return f"socket .{meth}()"
+        if meth == "unlink" and _self_attr(call.func.value) is not None:
+            # Attribute-held shm handles only; Path.unlink is cheap and
+            # pathlib chains are usually locals, not self state.
+            return "shared-memory .unlink()"
+    return None
+
+
+def _call_target(call: ast.Call) -> str | None:
+    """Same-module callee name: `f(...)` or `self.m(...)`/`cls.m(...)`."""
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute) and \
+            isinstance(call.func.value, ast.Name) and \
+            call.func.value.id in ("self", "cls"):
+        return call.func.attr
+    return None
+
+
+def _blocking_functions(mod: ModuleInfo) -> dict[str, str]:
+    """name -> description of (transitively) blocking functions/methods
+    in this module. Name-keyed and intentionally coarse, like
+    _traced.py: two classes sharing a method name both get marked."""
+    cached = mod._cache.get("blocking_fns")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    out: dict[str, str] = {}
+    for name, fn in defs.items():
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                why = _classify_call(mod, sub)
+                if why is not None:
+                    out.setdefault(name, why)
+                    break
+    # Transitive closure over same-module calls by name.
+    while True:
+        grew = False
+        for name, fn in defs.items():
+            if name in out:
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    callee = _call_target(sub)
+                    if callee in out and callee != name:
+                        out[name] = f"{callee}() -> {out[callee]}"
+                        grew = True
+                        break
+        if not grew:
+            break
+    mod._cache["blocking_fns"] = out
+    return out
+
+
+def _lock_name(mod: ModuleInfo, model, cls: ClassModel | None,
+               expr: ast.AST) -> str | None:
+    """Held-lock name for a with/acquire target, or None if not a lock:
+    `self.X` (any bare attribute used as a lock — see _locks.py) or a
+    module-level lock variable."""
+    attr = _self_attr(expr)
+    if attr is not None and cls is not None and attr in cls.lock_attrs:
+        return f"self.{attr}"
+    if isinstance(expr, ast.Name) and expr.id in model.module_locks:
+        return expr.id
+    return None
+
+
+class _Walker(HeldWalker):
+    """Finding emission over the shared held-lock walk (_locks.HeldWalker
+    owns with-scoping, acquire/release tracking and nested-def rules)."""
+
+    def __init__(self, mod: ModuleInfo, model, cls: ClassModel | None,
+                 out: list[Finding]):
+        self.mod = mod
+        self.model = model
+        self.cls = cls
+        self.out = out
+        self.blocking_fns = _blocking_functions(mod)
+
+    def lock_of(self, expr: ast.AST) -> str | None:
+        return _lock_name(self.mod, self.model, self.cls, expr)
+
+    def handle_node(self, node: ast.AST, held: tuple) -> None:
+        if isinstance(node, ast.Call):
+            self._check_call(node, held)
+
+    def _flag(self, node: ast.AST, what: str, held: tuple[str, ...]) -> None:
+        locks = ", ".join(held)
+        self.out.append(self.mod.finding(
+            RULE, node, f"{what} while holding {locks}"))
+
+    def _check_wait(self, call: ast.Call, held: tuple[str, ...]) -> bool:
+        """Condition wait handling -> True if the call was a wait (the
+        caller then skips normal classification)."""
+        if not isinstance(call.func, ast.Attribute) or \
+                call.func.attr not in _WAIT_METHODS:
+            return False
+        attr = _self_attr(call.func.value)
+        if attr is None or self.cls is None or \
+                attr not in self.cls.lock_attrs:
+            return False
+        meth = call.func.attr
+        # An explicit literal None (positional or keyword) is provably
+        # untimed — only a real bound (or a variable, which may carry
+        # one) counts.
+        timeout_idx = 1 if meth == "wait_for" else 0
+        bounds = list(call.args[timeout_idx:timeout_idx + 1]) + [
+            kw.value for kw in call.keywords if kw.arg == "timeout"]
+        has_timeout = any(
+            not (isinstance(b, ast.Constant) and b.value is None)
+            for b in bounds)
+        if not has_timeout:
+            self.out.append(self.mod.finding(
+                RULE, call,
+                f"untimed self.{attr}.{meth}() — a lost notify parks this "
+                f"thread forever; pass a timeout and re-check the "
+                f"predicate"))
+        # Condition.wait releases ITS mutex (and aliases) only — any
+        # other held lock stays held for the whole wait. The *_locked
+        # caller-lock sentinel also drops out: the caller's (unknown)
+        # lock is most plausibly the waited condition's own mutex, and
+        # flagging that would ban the documented refactor of a wait
+        # loop into a _locked helper.
+        group = {attr, self.cls.canon(attr)}
+        group |= {a for a, root in self.cls.alias.items()
+                  if root in group}
+        still = tuple(h for h in held
+                      if (h.startswith("self.") and h[5:] not in group
+                          or not h.startswith("self."))
+                      and h != _CALLER_LOCK)
+        if still:
+            self._flag(call, f"self.{attr}.{meth}() waits", still)
+        return True
+
+    def _check_call(self, call: ast.Call, held: tuple[str, ...]) -> None:
+        if self._check_wait(call, held):
+            return
+        if not held:
+            return
+        why = _classify_call(self.mod, call)
+        if why is not None:
+            self._flag(call, why, held)
+            return
+        callee = _call_target(call)
+        if callee is not None and callee in self.blocking_fns:
+            # Don't re-flag the helper from inside itself via recursion.
+            self._flag(call, f"call to {callee}() which blocks "
+                             f"({self.blocking_fns[callee]})", held)
+
+
+def _check_module(mod: ModuleInfo, program: Program) -> list[Finding]:
+    model = module_model(mod)
+    out: list[Finding] = []
+    # Class methods (including *_locked caller-holds contracts). The
+    # inheritance-MERGED view supplies base-class lock attrs and
+    # Condition-over-lock aliases (ContinuousInferenceServer inherits
+    # `_batch_ready` aliased to InferenceServer's `_lock` — see
+    # _locks.merged_class); only the class's OWN method bodies are
+    # walked here, the base's are walked in its defining module.
+    for cls_model in model.classes.values():
+        merged = merged_class(program, cls_model)
+        walker = _Walker(mod, model, merged, out)
+        for name, method in cls_model.methods.items():
+            held: tuple[str, ...] = ()
+            if name.endswith("_locked"):
+                held = (_CALLER_LOCK,)
+            walker.walk_body(method.body, held)
+    # Module-level functions against module-level locks.
+    walker = _Walker(mod, model, None, out)
+    for fn in model.functions.values():
+        walker.walk_body(fn.body, ())
+    return out
+
+
+def check(program: Program) -> list[Finding]:
+    """Whole-program so subclasses see base-class lock models across
+    modules; each finding still anchors in the module that contains it."""
+    out: list[Finding] = []
+    for mod in program.modules:
+        out.extend(_check_module(mod, program))
+    return out

@@ -1,0 +1,89 @@
+"""knob-registry: every DRL_* gate registered, documented, and alive.
+
+The repo steers ~60 behavior gates through `DRL_*` environment
+variables; PRs keep adding them, and an unregistered gate is invisible
+to the docs, the launcher, and the next session. The contract, with
+`tools/drlint/knobs.py` as the single source of truth:
+
+- any `DRL_*` string literal in linted source must name a registered
+  knob (reads, `os.environ` exports to children, and monkeypatches all
+  couple to the knob's contract equally) — typos in gate names fail
+  lint instead of silently disabling a fast path;
+- the `docs/performance.md` knob table must be byte-identical to the
+  registry-generated block (`python -m tools.drlint.knobs --write`
+  regenerates) — docs drift is a lint failure, reported once per run
+  anchored at the registry module;
+- a registered knob whose owner module is part of the linted program
+  but is never referenced there is STALE — the registry must shrink
+  with the code it describes.
+
+The docs-drift leg is skipped when docs/performance.md does not exist
+next to the linted tree (fixture programs in tmp dirs).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tools.drlint.core import Finding, Program
+
+RULE = "knob-registry"
+
+
+def check(program: Program) -> list[Finding]:
+    # Lazy: importing the registry at rules-package import time would
+    # pre-load tools.drlint.knobs into sys.modules and make the
+    # documented `python -m tools.drlint.knobs` CLI warn about (and
+    # re-execute) its own module.
+    from tools.drlint import knobs
+
+    findings: list[Finding] = []
+    referenced: dict[str, bool] = {}
+    owner_mods: set[str] = set()
+    for mod in program.modules:
+        if mod.path in knobs.SCAN_EXCLUDE:
+            # The registry's own entries (and the linter test suite's
+            # fake fixture names) are not knob references — counting
+            # them would make every registered knob look "referenced"
+            # whenever knobs.py is in the lint set, hiding stale
+            # entries. Same exclusion set as knobs.scan_tree.
+            continue
+        owner_mods.add(mod.path)
+        # One scanner definition for the whole linter (knobs.knob_nodes)
+        # so this pass and the `knobs --check` round-trip can never
+        # disagree about what counts as a knob reference.
+        for name, node in knobs.knob_nodes(mod.tree):
+            referenced[name] = True
+            if name not in knobs.KNOBS:
+                findings.append(mod.finding(
+                    RULE, node,
+                    f"unregistered knob {name}: add it to "
+                    f"tools/drlint/knobs.py (type/default/owner/doc) and "
+                    f"regenerate the docs table, or fix the typo"))
+    # Stale entries: the owner module is in this program but nothing in
+    # the program references the knob any more. Owners outside the
+    # linted set (scripts/tests gates) are judged by the knobs CLI
+    # round-trip, not here.
+    for name, knob in knobs.KNOBS.items():
+        if knob.owner in owner_mods and name not in referenced:
+            owner = program.by_path[knob.owner]
+            findings.append(owner.finding(
+                RULE, owner.tree,
+                f"stale registry entry {name}: owner {knob.owner} is "
+                f"linted but nothing references the knob — remove it "
+                f"from tools/drlint/knobs.py and the docs table"))
+    # Docs drift: one finding per run, only when the real docs file
+    # exists (the gate tree; fixture programs in tmp dirs skip it).
+    if os.path.exists(knobs.DOCS_PATH) and any(
+            m.path.startswith("distributed_reinforcement_learning_tpu/")
+            for m in program.modules):
+        try:
+            with open(knobs.DOCS_PATH, encoding="utf-8") as f:
+                drift = knobs.docs_drift(f.read())
+        except OSError as e:
+            drift = f"cannot read docs/performance.md: {e}"
+        if drift:
+            findings.append(Finding(
+                rule=RULE, path="docs/performance.md", line=1,
+                message=drift, context=""))
+    return findings

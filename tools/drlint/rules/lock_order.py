@@ -1,0 +1,329 @@
+"""lock-order: whole-program lock-acquisition graph, cycles flagged.
+
+Deadlock needs four coupon-collector ingredients and three are ambient
+in this runtime (mutual exclusion, hold-and-wait, no preemption) — the
+only one a linter can police is CIRCULAR WAIT. This pass builds one
+global directed graph over every lock in the program:
+
+- **nodes**: `(ClassName, lock_attr)` for instance locks (discovered
+  from `threading.*` constructor assignments, `_GUARDED_BY` values and
+  bare `with self.X:` targets — see rules/_locks.py; Conditions
+  constructed over a lock alias to it) and `(module, name)` for
+  module-level locks;
+- **edges**: lock A -> lock B whenever B is acquired while A is held —
+  lexically nested `with` blocks, a blocking `.acquire()` under a held
+  lock, or a CALL made under A to a function that (transitively)
+  acquires B. Calls are resolved within a class (`self.m()`), through
+  typed attributes (`self._ladder = RetryLadder(...)` makes
+  `self._ladder.try_acquire()` resolve to `RetryLadder.try_acquire`,
+  across modules), and to same-module functions for module locks.
+
+Any cycle in that graph — including the 2-cycle of two locks taken in
+both orders from different call paths — is a potential deadlock and is
+reported once per strongly-connected component, with the acquisition
+sites that close it. A lock nested under itself is NOT reported here
+(re-entrancy is a per-class concern the runtime's RLock-free style
+already avoids lexically).
+
+The analysis is name-coarse on purpose (same contract as _traced.py):
+two classes sharing a name merge, untyped attribute calls contribute
+nothing. That trades recall for a zero-noise gate — an edge only
+exists when the pass can PROVE both acquisitions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Finding, ModuleInfo, Program
+from tools.drlint.rules._locks import (
+    ClassModel,
+    HeldWalker,
+    _self_attr,
+    is_blocking_acquire,
+    merged_class,
+    module_model,
+    program_classes,
+)
+
+RULE = "lock-order"
+
+LockNode = tuple[str, str]  # (owner: class or module path, lock name)
+
+
+def _fmt(node: LockNode) -> str:
+    return f"{node[0]}.{node[1]}"
+
+
+class _Analysis:
+    def __init__(self, program: Program):
+        self.program = program
+        self.classes = program_classes(program)
+        self.merged: dict[str, ClassModel] = {}
+        self._locks_memo: dict[tuple[str, str], frozenset[LockNode]] = {}
+        # (src, dst) -> (mod, ast node, human site description)
+        self.edges: dict[tuple[LockNode, LockNode], tuple] = {}
+
+    def model(self, name: str) -> ClassModel | None:
+        cls = self.classes.get(name)
+        if cls is None:
+            return None
+        if name not in self.merged:
+            self.merged[name] = merged_class(self.program, cls)
+        return self.merged[name]
+
+    # -- transitive acquired-lock sets -----------------------------------
+
+    def method_locks(self, cls_name: str, meth: str,
+                     _stack: frozenset = frozenset()) -> frozenset[LockNode]:
+        """Every lock `ClassName.meth` may acquire, transitively through
+        same-class and typed-attribute calls.
+
+        Only TOP-LEVEL results are memoized: a set computed inside a
+        non-empty recursion stack may be truncated by the cycle guard
+        (a mutually-recursive callee's back-edge contributes nothing),
+        and caching that under-approximation would make cycle detection
+        depend on which edge site happened to ask first. The top-level
+        result is a sound fixpoint for its own root — anything
+        reachable through a truncated back-edge is also reachable from
+        the root directly."""
+        key = (cls_name, meth)
+        if key in self._locks_memo:
+            return self._locks_memo[key]
+        if key in _stack:
+            return frozenset()
+        cls = self.model(cls_name)
+        if cls is None or meth not in cls.methods:
+            return frozenset()
+        out: set[LockNode] = set()
+        for node in ast.walk(cls.methods[meth]):
+            out |= self._locks_of_node(cls.mod, cls, node, _stack | {key})
+        result = frozenset(out)
+        if not _stack:
+            self._locks_memo[key] = result
+        return result
+
+    def function_locks(self, mod: ModuleInfo, fn_name: str,
+                       _stack: frozenset = frozenset()) -> frozenset[LockNode]:
+        """Every lock a MODULE-LEVEL function may acquire: module locks
+        plus transitive same-module function calls (memoization policy
+        mirrors method_locks)."""
+        key = (mod.path, fn_name)
+        if key in self._locks_memo:
+            return self._locks_memo[key]
+        if key in _stack:
+            return frozenset()
+        fn = module_model(mod).functions.get(fn_name)
+        if fn is None:
+            return frozenset()
+        out: set[LockNode] = set()
+        for node in ast.walk(fn):
+            out |= self._locks_of_node(mod, None, node, _stack | {key})
+        result = frozenset(out)
+        if not _stack:
+            self._locks_memo[key] = result
+        return result
+
+    def _acquired_node(self, mod: ModuleInfo, cls: ClassModel | None,
+                       expr: ast.AST) -> LockNode | None:
+        """Lock node a with-target / acquire-receiver names: an
+        instance lock of `cls`, or a module-level lock of `mod`."""
+        if cls is not None:
+            attr = _self_attr(expr)
+            if attr is not None and attr in cls.lock_attrs:
+                return (cls.name, cls.canon(attr))
+        if isinstance(expr, ast.Name) and \
+                expr.id in module_model(mod).module_locks:
+            return (mod.path, expr.id)
+        return None
+
+    def _callee_locks(self, mod: ModuleInfo, cls: ClassModel | None,
+                      call: ast.Call, stack: frozenset) -> frozenset[LockNode]:
+        """Transitive lock set of a resolvable callee: a same-class /
+        typed-attribute method, or a same-module function by bare name."""
+        if cls is not None:
+            callee = self._resolve_call(cls, call)
+            if callee is not None:
+                return self.method_locks(*callee, _stack=stack)
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in module_model(mod).functions:
+            return self.function_locks(mod, call.func.id, _stack=stack)
+        return frozenset()
+
+    def _locks_of_node(self, mod: ModuleInfo, cls: ClassModel | None,
+                       node: ast.AST, stack: frozenset) -> set[LockNode]:
+        out: set[LockNode] = set()
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                lock = self._acquired_node(mod, cls, item.context_expr)
+                if lock is not None:
+                    out.add(lock)
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "acquire" and is_blocking_acquire(node):
+                lock = self._acquired_node(mod, cls, node.func.value)
+                if lock is not None:
+                    out.add(lock)
+            out |= self._callee_locks(mod, cls, node, stack)
+        return out
+
+    def _resolve_call(self, cls: ClassModel,
+                      call: ast.Call) -> tuple[str, str] | None:
+        """-> (class_name, method) for `self.m()` and typed `self.x.m()`
+        calls; None for anything the program can't pin down."""
+        fn = call.func
+        if not isinstance(fn, ast.Attribute):
+            return None
+        if isinstance(fn.value, ast.Name) and fn.value.id in ("self", "cls"):
+            if fn.attr in cls.methods:
+                return (cls.name, fn.attr)
+            return None
+        attr = _self_attr(fn.value)
+        if attr is not None:
+            target = cls.typed_attrs.get(attr)
+            if target is not None and target in self.classes:
+                return (target, fn.attr)
+        return None
+
+    # -- edge collection --------------------------------------------------
+
+    def _add_edges(self, mod: ModuleInfo, site: ast.AST,
+                   held: tuple[LockNode, ...], acquired) -> None:
+        for dst in (acquired if isinstance(acquired, (set, frozenset))
+                    else (acquired,)):
+            for src in held:
+                if src != dst and (src, dst) not in self.edges:
+                    # No line numbers in the site string: it feeds the
+                    # finding MESSAGE, and Finding.fingerprint() hashes
+                    # the message — the id must survive line shifts.
+                    # The finding's own `line` field carries the number.
+                    where = (f"{mod.path} in "
+                             f"{mod.context_of(site) or '<module>'}")
+                    self.edges[(src, dst)] = (mod, site, where)
+
+    def walk_class(self, cls: ClassModel) -> None:
+        walker = _EdgeWalker(self, cls.mod, cls)
+        for meth in (m for m in cls.node.body
+                     if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))):
+            walker.walk_body(meth.body, ())
+
+    def walk_module_functions(self, mod: ModuleInfo) -> None:
+        """Module-level functions acquire module locks (native.py's
+        _lib_lock, codec.py's _flag_lock) — their nestings are edges of
+        the same global graph."""
+        walker = _EdgeWalker(self, mod, None)
+        for fn in module_model(mod).functions.values():
+            walker.walk_body(fn.body, ())
+
+
+class _EdgeWalker(HeldWalker):
+    """Edge collection over the shared held-lock walk (_locks.HeldWalker
+    owns with-scoping, explicit acquire/release tracking in EVERY
+    statement list, and the nested-def/lambda rules)."""
+
+    def __init__(self, analysis: _Analysis, mod: ModuleInfo,
+                 cls: ClassModel | None):
+        self.analysis = analysis
+        self.mod = mod
+        self.cls = cls
+
+    def lock_of(self, expr: ast.AST) -> LockNode | None:
+        return self.analysis._acquired_node(self.mod, self.cls, expr)
+
+    def handle_with_acquired(self, item_expr: ast.AST, lock: LockNode,
+                             held_before: tuple) -> None:
+        self.analysis._add_edges(self.mod, item_expr, held_before, lock)
+
+    def handle_node(self, node: ast.AST, held: tuple) -> None:
+        if not (isinstance(node, ast.Call) and held):
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire" and is_blocking_acquire(node):
+            lock = self.lock_of(node.func.value)
+            if lock is not None:
+                self.analysis._add_edges(self.mod, node, held, lock)
+        locks = self.analysis._callee_locks(self.mod, self.cls, node,
+                                            frozenset())
+        if locks:
+            self.analysis._add_edges(self.mod, node, held, locks)
+
+
+def _sccs(nodes, edges) -> list[list[LockNode]]:
+    """Tarjan strongly-connected components (iterative)."""
+    adj: dict[LockNode, list[LockNode]] = {n: [] for n in nodes}
+    for (src, dst) in edges:
+        adj.setdefault(src, []).append(dst)
+        adj.setdefault(dst, [])
+    index: dict[LockNode, int] = {}
+    low: dict[LockNode, int] = {}
+    on_stack: set[LockNode] = set()
+    stack: list[LockNode] = []
+    out: list[list[LockNode]] = []
+    counter = [0]
+
+    for root in list(adj):
+        if root in index:
+            continue
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    out.append(comp)
+    return out
+
+
+def check(program: Program) -> list[Finding]:
+    analysis = _Analysis(program)
+    for mod in program.modules:
+        analysis.walk_module_functions(mod)
+        for cls in module_model(mod).classes.values():
+            # Use the inheritance-merged view for attr/typed resolution
+            # while walking the class's OWN method bodies.
+            merged = analysis.model(cls.name) or cls
+            analysis.walk_class(merged if merged.node is cls.node else cls)
+    edges = analysis.edges
+    nodes = {n for e in edges for n in e}
+    findings: list[Finding] = []
+    for comp in _sccs(nodes, edges):
+        comp_set = set(comp)
+        cyc_edges = [(e, edges[e]) for e in edges
+                     if e[0] in comp_set and e[1] in comp_set]
+        cyc_edges.sort(key=lambda item: item[1][2])
+        order = " ; ".join(f"{_fmt(src)} -> {_fmt(dst)} at {where}"
+                           for (src, dst), (_m, _n, where) in cyc_edges)
+        mod, site, _where = cyc_edges[0][1]
+        findings.append(mod.finding(
+            RULE, site,
+            f"lock-order cycle between {', '.join(sorted(map(_fmt, comp)))} "
+            f"(potential deadlock): {order}"))
+    return findings

@@ -1,0 +1,267 @@
+"""protocol-contract: every opcode dispatched, sent, and status-handled.
+
+The transport protocol (runtime/transport.py) is the only contract the
+actor/learner planes share, and it is enforced by nothing but
+convention: an `OP_*` without a server dispatch arm answers ST_ERROR
+and looks like a dead learner; an `ST_*` a caller never considered
+turns a retryable condition (ST_BUSY) into a latched demotion. This
+pass parses the protocol straight out of the source:
+
+- **anchor module(s)**: any module defining >= 2 module-level integer
+  `OP_*` constants (plus its `ST_*` constants).
+- **server dispatch**: a function comparing a variable against OP_*
+  names (`op == OP_X`, `op in (OP_X, OP_Y)`) is a dispatcher; each arm
+  contributes the `ST_*` names its body can send to that op's
+  reachable-status set, and `except` handlers in the dispatcher add
+  their statuses to EVERY dispatched op (the shared queue-closed arm).
+  An OP_* no dispatcher tests for -> finding.
+- **client senders**: calls passing an OP_* constant to `_exchange` (or
+  to a forwarder — a function that passes its own parameter on to
+  `_exchange`, like `_call`/`_fleet_call`), in ANY program module. An
+  OP_* nothing sends -> finding (dead protocol surface).
+- **status handling**: for each op, each function that sends it (or
+  the forwarder that handles its reply) must handle every reachable
+  `ST_*`: mention the status by name, or carry a catch-all (a
+  `status != ST_OK` raise, or an unconditional `raise` after the
+  status checks — the typed-error contract). A reachable status a
+  caller neither names nor catch-alls -> finding.
+
+The pass is syntactic and anchored on the OP_*/ST_* naming convention;
+a protocol module that renames those prefixes opts out wholesale.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.drlint.core import Finding, ModuleInfo, Program
+
+RULE = "protocol-contract"
+
+_OP_RE = re.compile(r"^OP_[A-Z0-9_]+$")
+_ST_RE = re.compile(r"^ST_[A-Z0-9_]+$")
+
+
+def _module_consts(mod: ModuleInfo, pattern: re.Pattern) -> dict[str, ast.Assign]:
+    """name -> defining Assign node for module-level int constants."""
+    out: dict[str, ast.Assign] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                pattern.match(node.targets[0].id) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, int):
+            out[node.targets[0].id] = node
+    return out
+
+
+def _names_in(node: ast.AST, pattern: re.Pattern) -> set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and pattern.match(n.id)}
+
+
+def _ops_in_test(test: ast.AST, ops: dict[str, int]) -> set[str]:
+    """OP_* names an if/elif test dispatches on (Eq or In compares)."""
+    out: set[str] = set()
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not any(isinstance(o, (ast.Eq, ast.In)) for o in node.ops):
+            continue
+        for cand in (node.left, *node.comparators):
+            out |= {n for n in _names_in(cand, _OP_RE) if n in ops}
+    return out
+
+
+class _ServerModel:
+    """Dispatch arms of one anchor module: op -> reachable ST set."""
+
+    def __init__(self, mod: ModuleInfo, ops: dict[str, int]):
+        self.dispatched: dict[str, set[str]] = {}
+        self.dispatch_fns: list[ast.AST] = []
+        for fn in (n for n in ast.walk(mod.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+            arms: dict[str, set[str]] = {}
+            handler_sts: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.If):
+                    tested = _ops_in_test(node.test, ops)
+                    if tested:
+                        sts = _names_in(ast.Module(body=node.body,
+                                                   type_ignores=[]), _ST_RE)
+                        for op in tested:
+                            arms.setdefault(op, set()).update(sts)
+                elif isinstance(node, ast.ExceptHandler):
+                    # Only handlers OUTSIDE every dispatch arm apply to
+                    # all ops (the shared queue-closed ST_CLOSED arm);
+                    # an except inside one arm (OP_ACT's retryable
+                    # mapping) was already collected with that arm's
+                    # body and must not leak to the other opcodes.
+                    cur = mod.parents.get(node)
+                    arm_local = False
+                    while cur is not None and cur is not fn:
+                        if isinstance(cur, ast.If) and \
+                                _ops_in_test(cur.test, ops):
+                            arm_local = True
+                            break
+                        cur = mod.parents.get(cur)
+                    if not arm_local:
+                        handler_sts |= _names_in(node, _ST_RE)
+            # A dispatcher tests >= 2 ops; single-op comparisons happen
+            # client-side too and must not count as serving.
+            if len(arms) >= 2:
+                self.dispatch_fns.append(fn)
+                for op, sts in arms.items():
+                    self.dispatched.setdefault(op, set()).update(
+                        sts | handler_sts)
+
+
+def _param_names(fn) -> list[str]:
+    args = fn.args
+    return [a.arg for a in (*args.posonlyargs, *args.args)]
+
+
+def _first_arg_name(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return None
+
+
+def _callee_name(call: ast.Call) -> str | None:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _find_forwarders(program: Program) -> dict[str, tuple]:
+    """Functions that forward a parameter as the op argument to
+    `_exchange` (transitively): `_call`, `_fleet_call`. They are where
+    the reply's statuses get handled for the ops routed through them.
+    -> name: (module, fn node)."""
+    fns: dict[str, tuple] = {}
+    for mod in program.modules:
+        for fn in (n for n in ast.walk(mod.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+            fns.setdefault(fn.name, (mod, fn))
+    forwarders: dict[str, tuple] = {}
+    targets = {"_exchange"}
+    while True:
+        grew = False
+        for name, (mod, fn) in fns.items():
+            if name in forwarders or name == "_exchange":
+                continue
+            params = set(_param_names(fn))
+            for call in (n for n in ast.walk(fn) if isinstance(n, ast.Call)):
+                callee = _callee_name(call)
+                if callee in targets:
+                    arg = _first_arg_name(call)
+                    if arg in params:
+                        forwarders[name] = (mod, fn)
+                        targets.add(name)
+                        grew = True
+                        break
+        if not grew:
+            break
+    return forwarders
+
+
+def _catch_all(fn: ast.AST, parents: dict) -> bool:
+    """True when the function's reply handling ends in a typed raise
+    that covers unnamed statuses: an `if status != ST_OK:` branch that
+    RAISES (the comparison alone proves nothing — a caller may compute
+    and drop it), or a `raise` not conditioned on a specific non-OK
+    ST_* name."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.If) and \
+                any(isinstance(c, ast.Compare)
+                    and any(isinstance(o, ast.NotEq) for o in c.ops)
+                    and "ST_OK" in _names_in(c, _ST_RE)
+                    for c in ast.walk(node.test)) and \
+                any(isinstance(n, ast.Raise)
+                    for b in node.body for n in ast.walk(b)):
+            return True
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Raise):
+            continue
+        cur = parents.get(node)
+        conditioned = False
+        while cur is not None and cur is not fn:
+            if isinstance(cur, ast.If):
+                sts = _names_in(cur.test, _ST_RE) - {"ST_OK"}
+                if sts:
+                    conditioned = True
+                    break
+            cur = parents.get(cur)
+        if not conditioned:
+            return True
+    return False
+
+
+def check(program: Program) -> list[Finding]:
+    findings: list[Finding] = []
+    anchors = [(mod, ops) for mod in program.modules
+               if len(ops := _module_consts(mod, _OP_RE)) >= 2]
+    if not anchors:
+        return findings
+    forwarders = _find_forwarders(program)
+    sender_fn_names = {"_exchange"} | set(forwarders)
+
+    for anchor, ops in anchors:
+        sts = _module_consts(anchor, _ST_RE)
+        server = _ServerModel(anchor, ops)
+
+        # op -> [(handler mod, handler fn)] sender sites, program-wide.
+        senders: dict[str, list] = {op: [] for op in ops}
+        for mod in program.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _callee_name(node)
+                if callee not in sender_fn_names:
+                    continue
+                arg = _first_arg_name(node)
+                if arg is None or arg not in ops:
+                    continue
+                # Reply handling happens in the forwarder when one is
+                # the callee, else in the function containing the call.
+                if callee in forwarders:
+                    handler_mod, handler = forwarders[callee]
+                else:
+                    cur = mod.parents.get(node)
+                    while cur is not None and not isinstance(
+                            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        cur = mod.parents.get(cur)
+                    handler_mod, handler = mod, cur
+                senders[arg].append((handler_mod, handler))
+
+        for op in sorted(ops):
+            op_node = ops[op]
+            if op not in server.dispatched:
+                findings.append(anchor.finding(
+                    RULE, op_node,
+                    f"{op} has no server dispatch arm (requests answer "
+                    f"the unknown-op ST_ERROR)"))
+            if not senders[op]:
+                findings.append(anchor.finding(
+                    RULE, op_node,
+                    f"{op} has no client sender (dead protocol surface "
+                    f"or a sender the pass cannot resolve)"))
+            reachable = {s for s in server.dispatched.get(op, set())
+                         if s in sts and s != "ST_OK"}
+            seen_handlers = set()
+            for handler_mod, handler in senders[op]:
+                if handler is None or id(handler) in seen_handlers:
+                    continue
+                seen_handlers.add(id(handler))
+                named = _names_in(handler, _ST_RE)
+                missing = sorted(reachable - named)
+                if missing and not _catch_all(handler, handler_mod.parents):
+                    findings.append(handler_mod.finding(
+                        RULE, handler,
+                        f"caller {handler.name}() of {op} handles neither "
+                        f"{'/'.join(missing)} nor a catch-all non-ST_OK "
+                        f"raise"))
+    return findings
