@@ -23,3 +23,22 @@ Layout (mirrors the layer map in SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("DRL_SANITIZE", "") == "1":
+    # Runtime concurrency sanitizer (tools/drlint/rt, docs/
+    # static_analysis.md "Runtime sanitizer"): must install BEFORE any
+    # submodule body runs so every threading ctor site in the package
+    # hands out instrumented locks. Zero overhead when the gate is off
+    # — this block is the only thing the unsanitized import pays.
+    try:
+        from tools.drlint.rt import install as _drlint_rt_install
+    except ImportError:
+        import sys as _sys
+
+        print("drlint-rt: DRL_SANITIZE=1 but tools.drlint is not "
+              "importable (run from the repo root); sanitizer disabled",
+              file=_sys.stderr)
+    else:
+        _drlint_rt_install()

@@ -293,6 +293,11 @@ class NativeTrajectoryQueue:
         "_pool_sig": "_scratch_lock",
         "_pool_idx": "_scratch_lock",
     }
+    _NOT_GUARDED = {
+        "_item_cap": "monotonic int hint racily grown by producers and "
+                     "consumers; a lost update costs one stride-regrow "
+                     "retry on a later pop, never correctness",
+    }
 
     def __init__(self, capacity: int):
         self._q = NativeByteQueue(capacity)

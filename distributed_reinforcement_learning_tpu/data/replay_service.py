@@ -405,6 +405,10 @@ class ShardedReplayService:
         "_healthy": ("_lock", "_work"),
         "updates_dropped": ("_lock", "_work"),
     }
+    _NOT_GUARDED = {
+        "shards": "fixed fan-out list assigned once in __init__ and never "
+                  "rebound; each ReplayShard synchronizes itself",
+    }
 
     def __init__(self, num_shards: int, capacity: int,
                  mode: str = "transition", scorer: str = "max",

@@ -98,6 +98,29 @@ class _Window:
 
 
 class Telemetry:
+    # Concurrency map (tools/drlint lock-discipline): the instrument
+    # maps are shared between every hot-path caller and the flush
+    # thread; the identity/config fields are written by configure()/
+    # close() around the threaded phase, with `enabled` read lock-free
+    # on hot paths as a deliberate no-op fast check.
+    _GUARDED_BY = {
+        "_counters": "_lock",
+        "_gauges": "_lock",
+        "_providers": "_lock",
+    }
+    _NOT_GUARDED = {
+        "enabled": "flipped by configure()/close() around the threaded "
+                   "phase; hot-path reads are deliberately lock-free "
+                   "no-op checks (stale False costs one dropped sample)",
+        "trace": "bound in configure() before the flush thread starts; "
+                 "close() is the only other writer",
+        "role": "configure()-once identity string",
+        "rank": "configure()-once identity int",
+        "_file": "opened in configure() before the flush thread starts; "
+                 "closed only after the flush thread joins",
+        "_thread": "start/stop lifecycle handle, controlling thread only",
+    }
+
     def __init__(self):
         self.enabled = False
         self.trace: TraceEmitter | None = None

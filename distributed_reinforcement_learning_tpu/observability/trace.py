@@ -39,6 +39,17 @@ DEFAULT_MAX_EVENTS = 100_000
 class TraceEmitter:
     """Buffered Chrome-trace writer for one process's host spans."""
 
+    # Concurrency map (tools/drlint lock-discipline): every span
+    # emitter shares the buffer with the telemetry flush thread; all
+    # five fields only move under `_lock` (emit/flush/close).
+    _GUARDED_BY = {
+        "dropped": "_lock",
+        "_pending": "_lock",
+        "_written": "_lock",
+        "_file": "_lock",
+        "_closed": "_lock",
+    }
+
     def __init__(
         self,
         path: str,

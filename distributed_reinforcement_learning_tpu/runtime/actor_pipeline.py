@@ -222,6 +222,12 @@ class UnrollPublisher:
         "_closed": "_cond",
         "_error": "_cond",
     }
+    _NOT_GUARDED = {
+        "_thread": "start()/drain() lifecycle handle, controlling actor "
+                   "thread only",
+        "stuck": "written by drain() and read by the same controlling "
+                 "actor thread's health checks",
+    }
 
     _JOIN_S = 10.0  # drain()'s worker-join deadline
 

@@ -335,6 +335,10 @@ class FleetSupervisor:
         "_counters": "_lock",
         "_watched": "_lock",
     }
+    _NOT_GUARDED = {
+        "_sweeper": "start()/stop() lifecycle handle, controlling "
+                    "thread only",
+    }
 
     SUSPECT_AFTER = 3.0   # x heartbeat_s without a beat -> suspect
     DEAD_AFTER = 10.0     # x heartbeat_s without a beat -> dead (evicted)
@@ -531,6 +535,15 @@ class HeartbeatLoop:
     _GUARDED_BY = {
         "_surfaces": "_lock",
         "stats": "_lock",
+    }
+    _NOT_GUARDED = {
+        "_thread": "start()/stop() lifecycle handle, controlling thread "
+                   "only",
+        "_client": "rebound only by the loop thread; stop() takes one "
+                   "racy snapshot purely to abort() — the documented "
+                   "lock-free shutdown escape",
+        "_fleet_unsupported": "loop-thread-only degradation latch",
+        "_unavailable_streak": "loop-thread-only retry counter",
     }
 
     def __init__(self, host: str, port: int, role: str, rank: int,

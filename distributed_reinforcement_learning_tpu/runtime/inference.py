@@ -134,6 +134,15 @@ class InferenceServer:
         "_stop": ("_lock", "_batch_ready"),
         "_admission_rejects": ("_lock", "_batch_ready"),
     }
+    _NOT_GUARDED = {
+        "_rng": "batcher-thread-only act state (see map comment above)",
+        "_device_params": "batcher-thread-only device cache",
+        "_cached_version": "batcher-thread-only device-cache version",
+        "batches_run": "batcher-thread-only counter; racy external "
+                       "reads are monitoring-only",
+        "rows_served": "batcher-thread-only counter; racy external "
+                       "reads are monitoring-only",
+    }
 
     def __init__(
         self,

@@ -550,6 +550,10 @@ class RingDrainer(_LockedStatsMixin):
     # `_threads` is written once in start() before the threads exist,
     # then only read.
     _GUARDED_BY = {"stats": "_stats_lock", "_dropped": "_stats_lock"}
+    _NOT_GUARDED = {
+        "_threads": "written once in start() before the drain threads "
+                    "exist, then only read (see map comment above)",
+    }
 
     def __init__(self, rings: list[ShmRing], queue):
         self.rings = rings

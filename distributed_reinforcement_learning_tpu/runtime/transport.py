@@ -374,6 +374,11 @@ class TransportServer(_LockedStatsMixin):
         "_enc_cache": "_enc_lock",
         "_encoding": "_enc_lock",
     }
+    _NOT_GUARDED = {
+        "_sock": "bound in start() before the accept thread spawns; "
+                 "stop() closes it cross-thread ON PURPOSE to break "
+                 "the accept loop out of its timed accept()",
+    }
 
     def __init__(self, queue, weights, host: str = "0.0.0.0", port: int = 8000,
                  inference=None, fleet=None):
@@ -855,8 +860,10 @@ class TransportClient(_LockedStatsMixin):
         # are one atomic conversation, and a second caller interleaving
         # frames would corrupt the protocol. Watchdog/shutdown paths
         # that must not queue behind a wedged exchange use the
-        # lock-free abort() instead (see its docstring).
-        with self._lock:
+        # lock-free abort() instead (see its docstring). The rt-hold
+        # suppression is the same design seen by the runtime sanitizer:
+        # an exchange lawfully holds `_lock` for a full socket timeout.
+        with self._lock:  # drlint: disable=rt-hold
             if self._sock is None:  # a prior failed reconnect left us down
                 self._connect_locked()  # drlint: disable=blocking-under-lock
             try:
@@ -1175,6 +1182,12 @@ class ShardedRemoteWeights(_LockedStatsMixin):
         "_plain": "_stats_lock",
         "_reprobe": "_stats_lock",
     }
+    _NOT_GUARDED = {
+        "_blobs": "actor-loop-thread-only shard cache (same "
+                  "single-thread contract as BoardWeights' cache)",
+        "_metas": "actor-loop-thread-only manifest-entry cache",
+        "_cache_version": "actor-loop-thread-only cache version",
+    }
 
     telemetry_prefix = "wshard"
     surface_name = "wshard"  # fleet heartbeat registration label
@@ -1418,6 +1431,12 @@ class RemoteActService(_LockedStatsMixin):
         "_pending": "_sel_lock",
         "_dead": "_sel_lock",
         "_rr": "_sel_lock",
+    }
+    _NOT_GUARDED = {
+        "_endpoints": "immutable after construction (see map comment); "
+                      "each client serializes itself via its own _lock",
+        "_ladders": "fixed list assigned once in __init__; RetryLadder "
+                    "instances carry their own lock",
     }
 
     def __init__(self, endpoints: list[TransportClient],

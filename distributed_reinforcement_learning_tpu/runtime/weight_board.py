@@ -834,6 +834,11 @@ class BoardWeights(_LockedStatsMixin, ShmReattachMixin):
 
     _GUARDED_BY = {"stats": "_stats_lock", "_board": "_lock",
                    "_closed": "_lock", "_stale": "_lock"}
+    _NOT_GUARDED = {
+        "_retries_seen": "actor-thread-only seqlock-retry watermark "
+                         "(the board object itself is actor-thread-"
+                         "only; see class docstring)",
+    }
 
     telemetry_prefix = "board"
     surface_name = "board"  # fleet heartbeat registration label

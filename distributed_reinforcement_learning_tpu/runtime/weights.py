@@ -117,6 +117,10 @@ class WeightStore:
         "_closed": ("_async_lock", "_cond"),
         "_worker": ("_async_lock", "_cond"),
     }
+    _NOT_GUARDED = {
+        "_copy_fn": "learn-thread-only jitted-snapshot cache (the "
+                    "publish_async caller; see map comment above)",
+    }
 
     def __init__(self, sharded: bool | None = None,
                  quant: str | None = None):

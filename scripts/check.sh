@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# The pre-commit entry point (README "Pre-commit checks"): static lint
+# over the changed files, a bounded runtime-sanitizer smoke, and the
+# tier-1 pointer. Fast by design — the full gates (whole-tree lint,
+# scripts/sanitize.sh over all nine suites, tier-1) stay with CI.
+#
+#   scripts/check.sh             # lint vs HEAD + sanitize smoke
+#   scripts/check.sh BASE        # lint vs another git base ref
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== drlint --changed (${1:-HEAD}) =="
+python -m tools.drlint --changed "${1:-HEAD}"
+
+echo "== sanitize smoke (test_shm_ring under DRL_SANITIZE=1) =="
+ART="$(mktemp "${TMPDIR:-/tmp}/drl_check_sanitize.XXXXXX.jsonl")"
+rm -f "$ART"
+env JAX_PLATFORMS=cpu DRL_SANITIZE=1 DRL_SANITIZE_OUT="$ART" \
+  python -m pytest tests/test_shm_ring.py -q -m 'not slow' \
+  -p no:cacheprovider
+python - "$ART" <<'EOF'
+import json, sys
+findings = [json.loads(l) for l in open(sys.argv[1])
+            if l.strip() and '"finding"' in l]
+findings = [r for r in findings if r.get("kind") == "finding"]
+for r in findings:
+    print(f"  {r['rule']}: {r['file']}:{r['line']}: {r['message']}")
+if findings:
+    sys.exit(f"sanitize smoke: {len(findings)} runtime finding(s)")
+print("sanitize smoke: 0 findings")
+EOF
+rm -f "$ART"
+
+echo "== tier-1 =="
+echo "not run here (minutes); the gate is:"
+echo "  JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'"
+echo "full sanitizer pass: scripts/sanitize.sh (nine suites + reconcile)"

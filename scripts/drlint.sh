@@ -1,13 +1,13 @@
 #!/usr/bin/env bash
 # Local drlint one-liner (docs/static_analysis.md). Defaults to the
 # library package; pass paths/flags to override, e.g.:
-#   scripts/drlint.sh                          # lint the shipped tree (9 passes)
+#   scripts/drlint.sh                          # lint the shipped tree (10 passes)
 #   scripts/drlint.sh --changed                # only files changed vs HEAD
 #   scripts/drlint.sh --json runtime/foo.py    # one file, SARIF-lite JSON
 # Exit: 0 clean (after baseline), non-zero on any non-baselined finding
 # or stale baseline entry (1) / usage/parse error (2). Text mode always
 # ends with the compact JSON summary line on stdout:
-#   {"drlint": {"findings": N, "baselined": M, "files": K, "rules": 9}}
+#   {"drlint": {"findings": N, "baselined": M, "files": K, "rules": 10}}
 set -euo pipefail
 cd "$(dirname "$0")/.."
 if [ "$#" -eq 0 ]; then

@@ -741,12 +741,67 @@ def build_report(tdir: str, merge: bool = True) -> str:
     if not any_stale:
         out("  (no staleness gauges — actors may not have pulled weights)")
 
+    # Runtime sanitizer (tools/drlint/rt): a chaos/bench run executed
+    # under DRL_SANITIZE=1 leaves a sanitize*.jsonl artifact next to
+    # the telemetry; render findings-by-rule and the hottest hold-time
+    # sites so a sanitized run reads with the same tooling as a plain
+    # one. Section only appears when an artifact exists.
+    san_lines = sanitizer_section(tdir)
+    if san_lines:
+        out("")
+        out("-- Sanitizer (drlint-rt) --")
+        lines.extend(san_lines)
+
     if merge:
         out("")
         merged = os.path.join(tdir, "trace-merged.json")
         n = merge_traces(tdir, merged)
         out(f"merged trace: {merged} ({n} spans; open in ui.perfetto.dev)")
     return "\n".join(lines)
+
+
+def sanitizer_artifacts(tdir: str) -> list[str]:
+    """sanitize*.jsonl next to the telemetry: in the telemetry dir
+    itself or the run dir above it."""
+    dirs = [tdir, os.path.dirname(os.path.abspath(tdir))]
+    out: list[str] = []
+    for d in dirs:
+        out.extend(sorted(glob.glob(os.path.join(d, "sanitize*.jsonl"))))
+    return sorted(set(out))
+
+
+def sanitizer_section(tdir: str, top: int = 5) -> list[str]:
+    paths = sanitizer_artifacts(tdir)
+    if not paths:
+        return []
+    from tools.drlint.rt.reconcile import Artifact
+
+    art = Artifact.load_many(paths)
+    lines: list[str] = []
+    lines.append(f"  artifact{'s' if len(paths) > 1 else ''}: "
+                 f"{', '.join(paths)} ({len(art.pids)} sanitized "
+                 f"process(es))")
+    by_rule: dict[str, int] = {}
+    for r in art.findings:
+        by_rule[r.get("rule", "?")] = by_rule.get(r.get("rule", "?"), 0) + 1
+    if by_rule:
+        for rule, n in sorted(by_rule.items()):
+            lines.append(f"  findings [{rule}]: {n}")
+    else:
+        lines.append("  findings: 0")
+    lines.append(f"  observed: {len(art.edges)} lock edges, "
+                 f"{len(art.accesses)} guarded attrs exercised")
+    holds = sorted(art.holds.items(),
+                   key=lambda kv: kv[1]["max_ms"], reverse=True)[:top]
+    if holds:
+        lines.append(f"  top hold-time sites (by max):")
+        for site, h in holds:
+            mean = h["total_ms"] / max(h["count"], 1)
+            lines.append(f"    {site:<58} {h['count']:>7}x  "
+                         f"mean {mean:>8.2f}ms  max {h['max_ms']:>9.1f}ms")
+    lines.append("  reconcile: python -m tools.drlint --reconcile "
+                 f"{paths[0]}")
+    return lines
 
 
 def main(argv: list[str] | None = None) -> int:

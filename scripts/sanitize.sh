@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Runtime concurrency sanitizer driver (docs/static_analysis.md
+# "Runtime sanitizer"). Runs the nine concurrency suites under
+# DRL_SANITIZE=1 so every package lock/_GUARDED_BY attr/blocking call
+# is checked live, then reconciles the JSONL artifact against the
+# static lock model:
+#
+#   scripts/sanitize.sh              # nine suites + reconcile
+#   scripts/sanitize.sh OUT_DIR      # keep the artifact in OUT_DIR
+#
+# Exit nonzero when any suite fails, any runtime finding was recorded
+# (rt-lock-order / rt-guardedby / rt-blocking / rt-hold), or reconcile
+# flags a stale _GUARDED_BY annotation / lock-graph model gap that is
+# not waived in tools/drlint/rt/waivers.py. The committed expectation
+# is ZERO on a clean tree.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT_DIR="${1:-$(mktemp -d "${TMPDIR:-/tmp}/drl_sanitize.XXXXXX")}"
+mkdir -p "$OUT_DIR"
+ART="$OUT_DIR/sanitize.jsonl"
+rm -f "$ART"
+
+SUITES=(
+  tests/test_transport.py
+  tests/test_shm_ring.py
+  tests/test_weights.py
+  tests/test_weight_sharding.py
+  tests/test_replay_service.py
+  tests/test_fleet.py
+  tests/test_serving.py
+  tests/test_inference.py
+  tests/test_actor_pipeline.py
+)
+
+env JAX_PLATFORMS=cpu DRL_SANITIZE=1 DRL_SANITIZE_OUT="$ART" \
+  python -m pytest "${SUITES[@]}" -q -m 'not slow' -p no:cacheprovider
+
+python -m tools.drlint --reconcile "$ART"
+echo "sanitize: clean — artifact at $ART"

@@ -1,12 +1,13 @@
 """drlint (tools/drlint): per-pass fixtures + the tier-1 tree gate.
 
-Each of the nine passes gets at least one positive fixture (violation
+Each of the ten passes gets at least one positive fixture (violation
 detected with the right rule id and line) and one negative fixture
 (idiomatic code passes), plus suppression-comment and baseline
 round-trip coverage — ISSUE 2's test contract, extended by ISSUE 12 to
 the whole-program passes (lock-order, blocking-under-lock,
 protocol-contract, knob-registry), the SARIF-lite JSON schema, and the
-`--changed` CLI mode. The final test IS the gate: the shipped package
+`--changed` CLI mode, and by ISSUE 13 with guardedby-completeness (the
+runtime-sanitizer acceptance lives in tests/test_sanitize.py). The final test IS the gate: the shipped package
 must lint clean against the committed baseline, forever. Everything
 here is pure-stdlib analysis of source strings — no jax import, so the
 whole module runs in a few seconds on one CPU core.
@@ -292,6 +293,124 @@ class TestLockDiscipline:
         assert findings == []
 
 
+# ------------------------------------------------ guardedby-completeness
+
+COMPLETENESS_SRC = """
+    import threading
+
+    class Worker:
+        _GUARDED_BY = {"jobs": "_lock"}
+
+        def __init__(self, name):
+            self._lock = threading.Lock()
+            self.jobs = []          # declared: fine
+            self.results = []       # mutable container, undeclared
+            self.name = name        # immutable run-once config: exempt
+            self.phase = 0          # rebound in run(): undeclared
+
+        def run(self):
+            self.phase = 1
+"""
+
+
+class TestGuardedByCompleteness:
+    def test_positive_undeclared_mutable_and_rebound(self):
+        findings = lint(COMPLETENESS_SRC)
+        assert rules_of(findings) == ["guardedby-completeness"] * 2
+        assert "self.results" in findings[0].message
+        assert "mutable container" in findings[0].message
+        assert "self.phase" in findings[1].message
+        assert "rebound outside __init__" in findings[1].message
+
+    def test_negative_declared_waived_and_lockless(self):
+        findings = lint("""
+            import threading
+
+            class Covered:
+                _GUARDED_BY = {"jobs": "_lock"}
+                _NOT_GUARDED = {
+                    "phase": "rebound only by the owning thread's "
+                             "run loop",
+                }
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.jobs = []
+                    self.phase = 0
+
+                def run(self):
+                    self.phase = 1
+
+            class NoLocks:  # constructs no lock: out of scope
+                def __init__(self):
+                    self.stuff = []
+
+                def mutate(self):
+                    self.stuff = []
+        """)
+        assert findings == []
+
+    def test_waiver_hygiene(self):
+        findings = lint("""
+            import threading
+
+            class W:
+                _GUARDED_BY = {"jobs": "_lock"}
+                _NOT_GUARDED = {
+                    "jobs": "this one is also guarded (conflict)",
+                    "ghost": "matches no attribute of the class",
+                    "items": "ok",
+                }
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.jobs = []
+                    self.items = []
+        """)
+        msgs = [f.message for f in findings]
+        assert any("also in _GUARDED_BY" in m for m in msgs), msgs
+        assert any("'ghost'" in m and "no instance attribute" in m
+                   for m in msgs), msgs
+        assert any("real justification" in m for m in msgs), msgs
+
+    def test_malformed_not_guarded_is_a_finding(self):
+        findings = lint("""
+            import threading
+
+            class W:
+                _NOT_GUARDED = ["just", "names"]
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []
+        """)
+        assert any("must be a literal" in f.message for f in findings)
+
+    def test_tuple_of_pairs_form_parses(self):
+        findings = lint("""
+            import threading
+
+            class W:
+                _NOT_GUARDED = (
+                    ("items", "written once before the thread starts"),
+                )
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []
+        """)
+        assert findings == []
+
+    def test_suppression_applies(self):
+        src = COMPLETENESS_SRC.replace(
+            "self.results = []       # mutable container, undeclared",
+            "self.results = []  # drlint: disable=guardedby-completeness"
+        ).replace(
+            "self.phase = 0          # rebound in run(): undeclared",
+            "self.phase = 0  # drlint: disable=guardedby-completeness")
+        assert lint(src) == []
+
+
 # ------------------------------------------------------------ nondeterminism
 
 class TestNondeterminism:
@@ -558,6 +677,9 @@ class TestCliAndTreeGate:
             #                              + HeartbeatLoop
             "runtime/actor_pipeline.py": 2,  # UnrollPublisher +
             #                                  ActorPipeline (doc form)
+            "observability/metrics.py": 1,  # Telemetry (ISSUE 13
+            #                                 completeness pass)
+            "observability/trace.py": 1,    # TraceEmitter (ditto)
         }
         for rel, want in expected.items():
             src = (PKG / rel).read_text()
@@ -584,6 +706,10 @@ class TestBlockingUnderLock:
                 return buf
 
             class Client:
+                _NOT_GUARDED = {
+                    "_sock": "exchange lock serializes all socket use",
+                }
+
                 def __init__(self):
                     self._lock = threading.Lock()
                     self._sock = None
@@ -615,6 +741,8 @@ class TestBlockingUnderLock:
             import threading
 
             class Batcher:
+                _GUARDED_BY = {"_pending": ("_lock", "_ready")}
+
                 def __init__(self):
                     self._lock = threading.Lock()
                     self._ready = threading.Condition(self._lock)
@@ -645,6 +773,8 @@ class TestBlockingUnderLock:
             from multiprocessing.shared_memory import SharedMemory
 
             class Seg:
+                _GUARDED_BY = {"_shm": "_lock"}
+
                 def __init__(self):
                     self._lock = threading.Lock()
                     self._shm = None
@@ -1330,7 +1460,7 @@ class TestJsonSchema:
         int(f["fingerprint"], 16)  # hex
         assert set(out["summary"]) == {"findings", "baselined", "files",
                                        "rules"}
-        assert len(out["rules"]) == 9
+        assert len(out["rules"]) == 10
 
     def test_fingerprint_stable_across_line_shifts(self):
         src = "import numpy as np\n\ndef f():\n    return np.random.rand()\n"
@@ -1434,9 +1564,10 @@ class TestChangedMode:
 
 
 class TestRuleRegistry:
-    def test_all_nine_rules_registered(self):
+    def test_all_ten_rules_registered(self):
         assert sorted(ALL_RULES) == sorted([
-            "jit-purity", "host-sync", "lock-discipline", "nondeterminism",
+            "jit-purity", "host-sync", "lock-discipline",
+            "guardedby-completeness", "nondeterminism",
             "dtype-pitfall", "blocking-under-lock",
             "lock-order", "protocol-contract", "knob-registry",
         ])

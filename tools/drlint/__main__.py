@@ -79,6 +79,12 @@ def main(argv: list[str] | None = None) -> int:
                          "are ignored")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable SARIF-lite output on stdout")
+    ap.add_argument("--reconcile", default=None, metavar="ARTIFACT",
+                    help="diff a drlint-rt sanitizer artifact (JSONL) "
+                         "against the static lock model of PATHS "
+                         "(default: the package); exit 1 on stale "
+                         "annotations, model gaps, or recorded runtime "
+                         "findings")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset of rule ids to run")
     ap.add_argument("--list-rules", action="store_true")
@@ -94,6 +100,16 @@ def main(argv: list[str] | None = None) -> int:
         for name in ALL_RULES:
             print(name)
         return 0
+
+    if args.reconcile is not None:
+        from tools.drlint.rt import reconcile as _reconcile
+
+        if not os.path.isfile(args.reconcile):
+            print(f"drlint: --reconcile: no such artifact: "
+                  f"{args.reconcile}", file=sys.stderr)
+            return 2
+        return _reconcile.main(args.reconcile, args.paths or None,
+                               as_json=args.as_json)
 
     # Rule selection is validated BEFORE any --changed early exit: a
     # typo'd rule id must fail (rc 2) on a no-change run too, not

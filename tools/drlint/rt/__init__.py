@@ -1,0 +1,67 @@
+"""drlint-rt — runtime concurrency sanitizer (gated by ``DRL_SANITIZE=1``).
+
+The dynamic counterpart of drlint's static concurrency passes. The
+static model (53 ``_GUARDED_BY`` entries, the whole-program lock-order
+graph, the blocking-under-lock catalog) is checked at lint time but
+never *observed*: a wrong or incomplete annotation passes lint while
+hiding a real race. Under the gate, this package instruments the live
+process with three checkers and an evidence stream:
+
+1. **Lock-order enforcement** (``rt-lock-order``) — instrumented
+   Lock/RLock/Condition factories maintain per-thread held-sets,
+   record every observed acquisition edge, and flag an edge that
+   closes a cycle in the observed graph (both stack traces in the
+   finding) or contradicts a static lock-order model supplied via
+   ``DRL_SANITIZE_MODEL``.
+2. **GuardedBy enforcement** (``rt-guardedby``) — ``_GUARDED_BY``
+   attrs become descriptors that verify the declared lock is actually
+   held by the accessing thread (honoring the ``*_locked``
+   caller-holds convention and Condition-over-lock aliasing, the same
+   escapes as the static pass).
+3. **Blocking-under-lock watchdog** (``rt-blocking`` / ``rt-hold``) —
+   socket/subprocess/shm/long-sleep calls under a held sanitized lock
+   are findings; every lock release feeds a per-site hold-time
+   histogram, with holds past ``DRL_SANITIZE_HOLD_MS`` flagged.
+
+Findings and first-seen edges/accesses stream to the JSONL artifact
+named by ``DRL_SANITIZE_OUT`` (fingerprints reuse drlint's SARIF-lite
+scheme); ``python -m tools.drlint --reconcile <artifact>`` then diffs
+the OBSERVED behavior against the static model — a never-exercised
+``_GUARDED_BY`` entry is a stale annotation, an observed edge missing
+from the static graph is a model gap.
+
+Zero overhead when the gate is off: ``install()`` is only ever called
+from the package's ``__init__`` under ``DRL_SANITIZE=1``; nothing is
+patched otherwise. ``install()`` must run before the package's
+submodules execute their lock constructions — the package ``__init__``
+seam guarantees that for normal imports.
+"""
+
+from __future__ import annotations
+
+_installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def install(out_path: str | None = None):
+    """Activate the sanitizer: patch the threading ctors, register the
+    GuardedBy import hook (+ retrofit), install the blocking-call
+    hooks. Idempotent; returns the process Sanitizer."""
+    global _installed
+    from tools.drlint.rt import blocking, guards, locks, sanitizer
+
+    san = sanitizer.activate(out_path=out_path)
+    if not _installed:
+        _installed = True
+        locks.install_lock_factories()
+        guards.install_guard_hook()
+        blocking.install_blocking_hooks()
+    return san
+
+
+def get_sanitizer():
+    from tools.drlint.rt import sanitizer
+    return sanitizer.get()

@@ -1,0 +1,100 @@
+"""Blocking-call hooks: the dynamic half of blocking-under-lock.
+
+The static pass flags blocking calls it can lexically place under a
+lock; this module catches the ones it can't — any socket/subprocess/
+shared-memory/long-sleep operation executed while the CURRENT THREAD
+holds a sanitized lock, regardless of how many call hops separate the
+``with`` from the syscall. The hook set mirrors the static
+classifier's vocabulary (rules/blocking_under_lock.py
+``_classify_call``) exactly, so a suppression that silences one
+silences the other:
+
+- ``socket.create_connection`` and the socket method set
+  connect/accept/recv/recv_into/recvfrom/sendall/sendmsg (wrapped on
+  the Python ``socket.socket`` class, shadowing the inherited C
+  implementations);
+- ``subprocess.Popen`` construction (``run``/``call``/``check_output``
+  all route through it) and ``os.system``;
+- ``time.sleep`` at or above the static SLEEP_THRESHOLD_S;
+- ``multiprocessing.shared_memory.SharedMemory`` attach and
+  ``.unlink()``.
+
+Every wrapper is a no-op fast path when the thread holds nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import socket
+import subprocess
+import time
+
+from multiprocessing import shared_memory
+
+import threading as _threading
+
+from tools.drlint.rt import sanitizer as _san_mod
+
+_SOCKET_METHODS = ("connect", "accept", "recv", "recv_into", "recvfrom",
+                   "sendall", "sendmsg")
+
+_installed = False
+
+# Re-entrancy guard: socket.create_connection internally calls
+# sock.connect() — one blocking call must yield ONE finding, reported
+# at the outermost wrapped entry point.
+_tl = _threading.local()
+
+
+def _wrap(orig, what: str):
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        if getattr(_tl, "depth", 0):
+            return orig(*args, **kwargs)
+        san = _san_mod.get()
+        if san is not None and san.held():
+            san.on_blocking_call(what)
+        _tl.depth = 1
+        try:
+            return orig(*args, **kwargs)
+        finally:
+            _tl.depth = 0
+    wrapper.__wrapped_by_drlint_rt__ = True
+    return wrapper
+
+
+def _wrap_sleep(orig):
+    @functools.wraps(orig)
+    def wrapper(secs):
+        san = _san_mod.get()
+        if not getattr(_tl, "depth", 0) and san is not None and \
+                san.held() and secs >= _san_mod.SLEEP_THRESHOLD_S:
+            san.on_blocking_call(f"time.sleep({secs:g})")
+        return orig(secs)
+    wrapper.__wrapped_by_drlint_rt__ = True
+    return wrapper
+
+
+def install_blocking_hooks() -> None:
+    global _installed
+    if _installed:
+        return
+    _installed = True
+
+    socket.create_connection = _wrap(socket.create_connection,
+                                     "socket.create_connection")
+    for meth in _SOCKET_METHODS:
+        orig = getattr(socket.socket, meth)
+        setattr(socket.socket, meth, _wrap(orig, f"socket .{meth}()"))
+
+    subprocess.Popen.__init__ = _wrap(subprocess.Popen.__init__,
+                                      "subprocess.Popen(...)")
+    os.system = _wrap(os.system, "os.system")
+    time.sleep = _wrap_sleep(time.sleep)
+
+    shared_memory.SharedMemory.__init__ = _wrap(
+        shared_memory.SharedMemory.__init__,
+        "shared-memory attach (SharedMemory(...))")
+    shared_memory.SharedMemory.unlink = _wrap(
+        shared_memory.SharedMemory.unlink, "shared-memory .unlink()")

@@ -1,0 +1,199 @@
+"""Runtime GuardedBy enforcement: `_GUARDED_BY` attrs become data
+descriptors that verify the declared lock is held by the accessing
+thread.
+
+The static lock-discipline pass checks the LEXICAL form (a touch
+inside `with self._lock:`); this module checks the TRUTH — on every
+read and write of an annotated attribute, is one of the declared locks
+actually owned by the current thread right now? That closes both gaps
+the static pass documents: accesses through other names (a module
+function touching `server.stats`) and accesses whose lock the AST
+could not resolve.
+
+Mechanics: for every class carrying a class-level ``_GUARDED_BY`` dict,
+each annotated attribute is replaced by a :class:`GuardedAttr` data
+descriptor. The value itself still lives in the instance ``__dict__``
+under the same name (data descriptors take precedence for both get and
+set, so the descriptor stays in control while ``vars(obj)`` keeps
+working for pickling/copy/repr). The check resolves the declared lock
+names against the instance — Condition-over-lock aliasing falls out
+naturally, because a declared Condition's ``_lock`` IS the shared
+sanitized mutex. The ``*_locked`` caller-holds convention needs no
+special case on the happy path (the caller really does hold the lock);
+the violation path exempts ``__init__``/``__del__`` frames, ``*_locked``
+methods reached without the lock, and accesses whose nearest repo
+frame is outside the package (tests poking internals are out of scope,
+exactly like the static pass).
+
+Classes are wrapped at import time by a ``sys.meta_path`` hook
+installed under the gate, so no runtime module changes hands-on; a
+retrofit pass covers anything imported before install.
+"""
+
+from __future__ import annotations
+
+import importlib.abc
+import importlib.machinery
+import inspect
+import sys
+
+from tools.drlint.rt import sanitizer as _san_mod
+
+_PKG = "distributed_reinforcement_learning_tpu"
+
+_MISSING = object()
+
+
+class GuardedAttr:
+    """Data descriptor enforcing + observing one _GUARDED_BY entry.
+
+    ``claims`` lists EVERY class in the instrumented class's MRO whose
+    own ``_GUARDED_BY`` declares this attr: a subclass that re-declares
+    an inherited entry (ContinuousInferenceServer over InferenceServer)
+    shadows the base's descriptor, and an exercised access must credit
+    both annotations or reconcile would misreport the base's as stale."""
+
+    __slots__ = ("attr", "locks", "cls_name", "claims", "default")
+
+    def __init__(self, attr: str, locks: tuple[str, ...], cls_name: str,
+                 claims: tuple[str, ...] = (), default=_MISSING):
+        self.attr = attr
+        self.locks = locks
+        self.cls_name = cls_name
+        self.claims = claims or (cls_name,)
+        self.default = default
+
+    def _check(self, obj, write: bool) -> None:
+        san = _san_mod.get()
+        if san is None:
+            return
+        d = obj.__dict__
+        found_lock = False
+        for ln in self.locks:
+            lk = d.get(ln)
+            if lk is None:
+                continue
+            inner = getattr(lk, "_lock", None)  # Condition -> its mutex
+            if inner is not None:
+                lk = inner
+            ident = getattr(lk, "owner_ident", _MISSING)
+            if ident is _MISSING:
+                continue  # un-sanitized lock: cannot prove either way
+            found_lock = True
+            if ident == _san_mod.threading.get_ident():
+                for claim in self.claims:
+                    san.on_guarded_ok(claim, self.attr)
+                return
+        if not found_lock:
+            return  # locks not constructed yet (mid-__init__) or foreign
+        san.on_guarded_violation(obj, self.cls_name, self.attr,
+                                 self.locks, write)
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        val = obj.__dict__.get(self.attr, _MISSING)
+        if val is _MISSING:
+            if self.default is _MISSING:
+                raise AttributeError(
+                    f"{type(obj).__name__!r} object has no attribute "
+                    f"{self.attr!r}")
+            val = self.default
+        self._check(obj, write=False)
+        return val
+
+    def __set__(self, obj, value):
+        self._check(obj, write=True)
+        obj.__dict__[self.attr] = value
+
+    def __delete__(self, obj):
+        self._check(obj, write=True)
+        try:
+            del obj.__dict__[self.attr]
+        except KeyError:
+            raise AttributeError(self.attr) from None
+
+
+def instrument_class(cls: type) -> bool:
+    """Wrap one class's own _GUARDED_BY attrs; True if instrumented."""
+    guards = cls.__dict__.get("_GUARDED_BY")
+    if not isinstance(guards, dict):
+        return False
+    if "__slots__" in cls.__dict__:
+        print(f"drlint-rt: cannot guard __slots__ class {cls.__name__}",
+              file=sys.stderr)
+        return False
+    for attr, locks in guards.items():
+        if not isinstance(attr, str):
+            continue
+        lock_names = (locks,) if isinstance(locks, str) else tuple(locks)
+        default = cls.__dict__.get(attr, _MISSING)
+        if isinstance(default, GuardedAttr):
+            continue  # already instrumented
+        claims = tuple(
+            base.__name__ for base in cls.__mro__
+            if isinstance(vars(base).get("_GUARDED_BY"), dict)
+            and attr in vars(base)["_GUARDED_BY"])
+        setattr(cls, attr,
+                GuardedAttr(attr, lock_names, cls.__name__, claims, default))
+    return True
+
+
+def instrument_module(module) -> int:
+    n = 0
+    mod_name = getattr(module, "__name__", "")
+    for obj in list(vars(module).values()):
+        if inspect.isclass(obj) and obj.__module__ == mod_name:
+            if instrument_class(obj):
+                n += 1
+    return n
+
+
+class _GuardLoader(importlib.abc.Loader):
+    """Delegating loader: exec the real module, then wrap its classes."""
+
+    def __init__(self, orig):
+        self._orig = orig
+
+    def create_module(self, spec):
+        return self._orig.create_module(spec)
+
+    def exec_module(self, module):
+        self._orig.exec_module(module)
+        instrument_module(module)
+
+    def __getattr__(self, name):  # get_source/is_package/... for tooling
+        return getattr(self._orig, name)
+
+
+class _GuardFinder(importlib.abc.MetaPathFinder):
+    """Routes package submodule imports through _GuardLoader."""
+
+    def find_spec(self, fullname, path, target=None):
+        if fullname != _PKG and not fullname.startswith(_PKG + "."):
+            return None
+        spec = importlib.machinery.PathFinder.find_spec(fullname, path)
+        if spec is None or spec.loader is None:
+            return None
+        if isinstance(spec.loader, _GuardLoader):
+            return None
+        spec.loader = _GuardLoader(spec.loader)
+        return spec
+
+
+_FINDER: _GuardFinder | None = None
+
+
+def install_guard_hook() -> None:
+    global _FINDER
+    if _FINDER is not None:
+        return
+    _FINDER = _GuardFinder()
+    sys.meta_path.insert(0, _FINDER)
+    # Retrofit anything already imported (install() runs at package
+    # __init__ time, so normally only the package root itself exists —
+    # but a lazy install via tests must still cover the tree).
+    for name, module in list(sys.modules.items()):
+        if module is not None and \
+                (name == _PKG or name.startswith(_PKG + ".")):
+            instrument_module(module)

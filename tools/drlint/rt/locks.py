@@ -1,0 +1,223 @@
+"""Instrumented Lock/RLock/Condition factories + the threading patch.
+
+``install_lock_factories()`` replaces ``threading.Lock``, ``threading.
+RLock`` and ``threading.Condition`` with factories that return
+sanitized primitives **only for locks constructed from repo code** —
+the factory inspects the creating frame once and hands foreign callers
+(stdlib ``queue``, jax, third-party threads) the real primitive, so
+the sanitizer's blast radius is exactly the package + tests + tools
+tree the static passes lint. Locks created BEFORE install (imports
+that ran pre-gate) stay untouched; the gate installs at package-import
+time, before any package module body runs, so every package lock is
+covered.
+
+SanLock/SanRLock mirror the real primitives' protocol exactly —
+``acquire(blocking, timeout)``, ``release``, ``locked``, context
+manager, plus the ``_is_owned``/``_release_save``/``_acquire_restore``
+trio ``threading.Condition`` duck-types against — and additionally
+carry the metadata sanitizer.py keys on: creation site, defining
+class, a weakref to the owning instance (for lazy (Class, attr)
+naming), and the current owner thread. SanCondition subclasses the
+real Condition so ``isinstance`` and subclass users keep working; it
+only swaps the implicit lock for a sanitized one when the creator is
+repo code.
+
+Like the real primitives, sanitized locks refuse to pickle.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import weakref
+
+from tools.drlint.core import repo_rel
+from tools.drlint.rt import sanitizer as _san_mod
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+
+def _creation_info():
+    """(is_repo, site 'repo-rel:line', defining class name or None,
+    weakref-to-self or None, creating module name) for the frame that
+    called a factory."""
+    f = sys._getframe(2)
+    while f is not None and _san_mod._is_rt_frame(f.f_code.co_filename):
+        f = f.f_back
+    if f is None:
+        return False, "<unknown>", None, None, None
+    path = f.f_code.co_filename
+    if not _san_mod._in_repo(path):
+        return False, "", None, None, None
+    site = f"{repo_rel(path)}:{f.f_lineno}"
+    cls = _san_mod._defining_class(f)
+    ref = None
+    obj = f.f_locals.get("self")
+    if obj is not None and cls is not None:
+        try:
+            ref = weakref.ref(obj)
+        except TypeError:
+            ref = None
+    return True, site, cls, ref, f.f_globals.get("__name__")
+
+
+class _SanBase:
+    """Shared metadata + protocol surface of the sanitized primitives."""
+
+    def __init__(self, site: str, owner_cls, owner_ref, module):
+        self.site = site
+        self.owner_cls = owner_cls
+        self.owner_ref = owner_ref
+        self.module = module
+        self.name = None  # resolved lazily by sanitizer.lock_name
+        self.owner_ident = None
+        self._hold_t0 = None
+        self._hold_site = None
+
+    def __reduce__(self):
+        raise TypeError(f"cannot pickle {type(self).__name__} object")
+
+    def __enter__(self):
+        self.acquire()
+        return True
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class SanLock(_SanBase):
+    """Sanitized non-reentrant mutex (the `threading.Lock` shape)."""
+
+    def __init__(self, site, owner_cls, owner_ref, module):
+        super().__init__(site, owner_cls, owner_ref, module)
+        self._lk = _REAL_LOCK()
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._lk.acquire(blocking, timeout)
+        if ok:
+            san = _san_mod.get()
+            if san is not None:
+                san.on_acquired(self)
+        return ok
+
+    def release(self):
+        san = _san_mod.get()
+        if san is not None:
+            san.on_released(self)
+        self._lk.release()
+
+    def locked(self):
+        return self._lk.locked()
+
+    def _at_fork_reinit(self):
+        self._lk._at_fork_reinit()
+        self.owner_ident = None
+
+    # Condition duck-typing: with these three, Condition.wait routes its
+    # release/reacquire through the sanitizer (so held-sets and hold
+    # times stay exact across a wait) and _is_owned is precise instead
+    # of the stock try-acquire heuristic.
+    def _is_owned(self):
+        return self.owner_ident == threading.get_ident()
+
+    def _release_save(self):
+        self.release()
+
+    def _acquire_restore(self, _state):
+        self.acquire()
+
+    def __repr__(self):
+        state = "locked" if self._lk.locked() else "unlocked"
+        return f"<SanLock {state} site={self.site}>"
+
+
+class SanRLock(_SanBase):
+    """Sanitized reentrant mutex. Tracks its own owner/count (the real
+    RLock does not expose them) and reports only the OUTERMOST
+    acquire/release to the sanitizer — re-entry is not an edge."""
+
+    def __init__(self, site, owner_cls, owner_ref, module):
+        super().__init__(site, owner_cls, owner_ref, module)
+        self._lk = _REAL_RLOCK()
+        self._count = 0
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._lk.acquire(blocking, timeout)
+        if ok:
+            self._count += 1
+            if self._count == 1:
+                san = _san_mod.get()
+                if san is not None:
+                    san.on_acquired(self)
+        return ok
+
+    def release(self):
+        if self._count == 1:
+            san = _san_mod.get()
+            if san is not None:
+                san.on_released(self)
+        self._count -= 1
+        self._lk.release()
+
+    def _is_owned(self):
+        return self.owner_ident == threading.get_ident() and self._count > 0
+
+    def _release_save(self):
+        count = self._count
+        self._count = 0
+        san = _san_mod.get()
+        if san is not None:
+            san.on_released(self)
+        state = self._lk._release_save()
+        return (state, count)
+
+    def _acquire_restore(self, state):
+        inner, count = state
+        self._lk._acquire_restore(inner)
+        self._count = count
+        san = _san_mod.get()
+        if san is not None:
+            san.on_acquired(self)
+
+    def __repr__(self):
+        return f"<SanRLock count={self._count} site={self.site}>"
+
+
+class SanCondition(_REAL_CONDITION):
+    """threading.Condition that sanitizes its implicit lock when the
+    creator is repo code. A Condition over an EXPLICIT lock needs no
+    help — the passed lock is already sanitized (or deliberately real),
+    and the stock Condition duck-types against SanLock's
+    _is_owned/_release_save/_acquire_restore."""
+
+    def __init__(self, lock=None):
+        if lock is None:
+            is_repo, site, owner_cls, owner_ref, module = _creation_info()
+            if is_repo:
+                lock = SanRLock(site, owner_cls, owner_ref, module)
+        super().__init__(lock)
+
+
+def _lock_factory():
+    is_repo, site, owner_cls, owner_ref, module = _creation_info()
+    if not is_repo:
+        return _REAL_LOCK()
+    return SanLock(site, owner_cls, owner_ref, module)
+
+
+def _rlock_factory():
+    is_repo, site, owner_cls, owner_ref, module = _creation_info()
+    if not is_repo:
+        return _REAL_RLOCK()
+    return SanRLock(site, owner_cls, owner_ref, module)
+
+
+def install_lock_factories() -> None:
+    if threading.Lock is _lock_factory:  # idempotent
+        return
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = SanCondition

@@ -1,0 +1,370 @@
+"""Static↔dynamic reconciliation: diff a sanitizer artifact against the
+static lock model (`python -m tools.drlint --reconcile <artifact>`).
+
+The static model makes claims; the sanitized suites produce evidence;
+this module closes the loop in both directions:
+
+- **stale-annotation** — a committed ``_GUARDED_BY`` entry that no
+  sanitized run ever exercised (no ``access`` record of that
+  (class, attr) with the lock held): either dead annotation, dead
+  code, or a suite gap. Waivable in ``tools/drlint/rt/waivers.py``
+  with a justification.
+- **model-gap** — an acquisition edge the runtime OBSERVED between two
+  statically-known locks that the static lock-order graph cannot
+  prove: the whole-program pass's resolution has a blind spot there
+  (untyped attribute call, dynamic dispatch), which is exactly where
+  an inversion could hide from lint. Waivable with justification.
+- **rt finding replay** — every distinct runtime finding recorded in
+  the artifact is surfaced again (deduped by fingerprint, with a
+  count), so `--reconcile` is a one-stop gate for a sanitized run.
+- **waiver hygiene** — a waiver whose subject was actually observed
+  (or that names an unknown entry), or whose justification is shorter
+  than 10 chars, is itself a finding: the list can only shrink.
+
+Node naming must agree between the two sides for any of this to work.
+The runtime names a lock by the class that DEFINES the ``__init__``
+constructing it; static edges are named by the class whose method body
+was walked (which may be a subclass using an inherited lock). Both
+sides are therefore normalized through ``_definer`` — the deepest
+class in the inheritance chain whose OWN body assigns the attribute a
+``threading`` constructor — before comparison.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+
+from tools.drlint.core import Finding, ModuleInfo, Program, iter_py_files, repo_rel
+from tools.drlint.rules._locks import LOCK_CTORS, _called_chain_tail, program_classes
+from tools.drlint.rules.lock_discipline import _class_guards
+from tools.drlint.rules.lock_order import build_analysis
+
+_PKG = "distributed_reinforcement_learning_tpu"
+
+STALE_RULE = "stale-annotation"
+GAP_RULE = "model-gap"
+WAIVER_RULE = "waiver-hygiene"
+
+Node = tuple[str, str]
+
+
+@dataclass
+class Artifact:
+    findings: list[dict] = field(default_factory=list)
+    # fingerprint -> total occurrences (the sanitizer writes each
+    # finding once plus a finding_count record for hot-path repeats).
+    finding_counts: dict[str, int] = field(default_factory=dict)
+    edges: list[dict] = field(default_factory=list)
+    accesses: set[tuple[str, str]] = field(default_factory=set)
+    holds: dict[str, dict] = field(default_factory=dict)
+    pids: set[int] = field(default_factory=set)
+
+    @classmethod
+    def load(cls, path: str) -> "Artifact":
+        return cls.load_many([path])
+
+    @classmethod
+    def load_many(cls, paths: list[str]) -> "Artifact":
+        """Stream any number of artifact files into ONE merged view —
+        the single definition of the JSONL reading contract (torn final
+        lines of SIGKILLed processes are skipped), shared with
+        obs_report's Sanitizer section."""
+        art = cls()
+        for path in paths:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        r = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn final line of a SIGKILLed process
+                    if isinstance(r, dict):
+                        art.consume(r)
+        return art
+
+    def consume(self, r: dict) -> None:
+        kind = r.get("kind")
+        if "pid" in r:
+            self.pids.add(r["pid"])
+        if kind == "finding":
+            self.findings.append(r)
+            fp = r.get("fingerprint", "?")
+            self.finding_counts[fp] = self.finding_counts.get(fp, 0) + 1
+        elif kind == "finding_count":
+            fp = r.get("fingerprint", "?")
+            # Repeats beyond the first within ONE process: add n-1 on
+            # top of the finding record already counted.
+            self.finding_counts[fp] = self.finding_counts.get(fp, 0) + \
+                max(int(r.get("count", 1)) - 1, 0)
+        elif kind == "edge":
+            self.edges.append(r)
+        elif kind == "access":
+            self.accesses.add((r.get("cls", ""), r.get("attr", "")))
+        elif kind == "hold":
+            h = self.holds.setdefault(
+                r.get("site", "?"),
+                {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+            h["count"] += r.get("count", 0)
+            h["total_ms"] += r.get("total_ms", 0.0)
+            h["max_ms"] = max(h["max_ms"], r.get("max_ms", 0.0))
+
+
+def build_program(paths: list[str] | None = None) -> Program:
+    if paths is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        paths = [os.path.join(root, _PKG)]
+    mods = []
+    for fp in iter_py_files(paths):
+        try:
+            with open(fp, encoding="utf-8") as f:
+                mods.append(ModuleInfo(f.read(), repo_rel(fp)))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+    return Program(mods)
+
+
+def static_guards(program: Program) -> dict[tuple[str, str], tuple[ModuleInfo, ast.ClassDef]]:
+    """(ClassName, attr) -> (module, class node) for every _GUARDED_BY
+    entry in the program — the claims the artifact must substantiate."""
+    out: dict[tuple[str, str], tuple[ModuleInfo, ast.ClassDef]] = {}
+    for mod in program.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            guards = _class_guards(node)
+            if not guards:
+                continue
+            for attr in guards:
+                out.setdefault((node.name, attr), (mod, node))
+    return out
+
+
+def _ctor_assigns(mod: ModuleInfo, cls_node: ast.ClassDef) -> set[str]:
+    """Attrs this class's OWN body assigns a threading ctor."""
+    out: set[str] = set()
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _called_chain_tail(mod, node.value) in LOCK_CTORS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        out.add(tgt.attr)
+    return out
+
+
+class _Normalizer:
+    """Maps any (owner, name) node to its canonical defining-class form
+    so runtime and static edge names compare equal."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self.classes = program_classes(program)
+        self._ctor_memo: dict[str, set[str]] = {}
+        self.module_paths = {m.path for m in program.modules}
+
+    def _own_ctors(self, cls_name: str) -> set[str]:
+        if cls_name not in self._ctor_memo:
+            cls = self.classes.get(cls_name)
+            self._ctor_memo[cls_name] = (
+                _ctor_assigns(cls.mod, cls.node) if cls is not None else set())
+        return self._ctor_memo[cls_name]
+
+    def definer(self, cls_name: str, attr: str,
+                _seen: frozenset = frozenset()) -> str:
+        """Deepest ancestor whose own body constructs `attr`; falls back
+        to `cls_name` when nothing in the chain provably does."""
+        if cls_name in _seen:
+            return cls_name
+        if attr in self._own_ctors(cls_name):
+            return cls_name
+        cls = self.classes.get(cls_name)
+        if cls is not None:
+            for base in cls.bases:
+                if base in self.classes and base != cls_name:
+                    hit = self.definer(base, attr, _seen | {cls_name})
+                    if attr in self._own_ctors(hit):
+                        return hit
+        return cls_name
+
+    def canon(self, node: Node) -> Node:
+        owner, name = node
+        if owner in self.classes:
+            cls = self.classes[owner]
+            name = cls.alias.get(name, name)
+            return (self.definer(owner, name), name)
+        return (owner, name)
+
+    def known(self, node: Node) -> bool:
+        """Is this node's owner part of the static program? Fixture and
+        test locks (owner = a tmp path or an unlinted class) are out of
+        reconciliation scope."""
+        owner, _name = node
+        return owner in self.classes or owner in self.module_paths
+
+
+def reconcile(artifact: Artifact, program: Program,
+              guarded_waivers: dict | None = None,
+              edge_waivers: dict | None = None) -> list[Finding]:
+    """The full diff -> drlint Findings (renderable/JSON-able like any
+    static pass's)."""
+    if guarded_waivers is None or edge_waivers is None:
+        from tools.drlint.rt import waivers as _w
+        guarded_waivers = _w.GUARDED_WAIVERS if guarded_waivers is None \
+            else guarded_waivers
+        edge_waivers = _w.EDGE_WAIVERS if edge_waivers is None \
+            else edge_waivers
+    # Always copy: entries are consumed (pop) below, and a caller-owned
+    # dict — including the module-level waiver maps — must survive a
+    # second reconcile() in the same process.
+    guarded_waivers = dict(guarded_waivers)
+    edge_waivers = dict(edge_waivers)
+    findings: list[Finding] = []
+    norm = _Normalizer(program)
+
+    # 0. Waiver justifications validated up front (before entries are
+    #    consumed below) — the lint-baseline contract, same bar.
+    for subj, why in [*guarded_waivers.items(), *edge_waivers.items()]:
+        if not isinstance(why, str) or len(why.strip()) < 10:
+            findings.append(Finding(
+                rule=WAIVER_RULE, path="tools/drlint/rt/waivers.py", line=1,
+                message=f"waiver {subj} needs a real justification, "
+                        f"not {why!r}", context=""))
+
+    # 1. Runtime findings, deduped by fingerprint.
+    by_fp: dict[str, dict] = {}
+    for r in artifact.findings:
+        by_fp.setdefault(r.get("fingerprint", "?"), r)
+    for fp, r in sorted(by_fp.items()):
+        n = max(artifact.finding_counts.get(fp, 1), 1)
+        times = f" ({n}x)" if n > 1 else ""
+        findings.append(Finding(
+            rule=r.get("rule", "rt"), path=r.get("file", "?"),
+            line=int(r.get("line", 0)),
+            message=f"{r.get('message', '')}{times}",
+            context=r.get("context", "")))
+
+    # 2. Stale _GUARDED_BY annotations: claimed but never observed.
+    claims = static_guards(program)
+    observed = set(artifact.accesses)
+    for (cls_name, attr), (mod, cls_node) in sorted(claims.items()):
+        if (cls_name, attr) in observed:
+            continue
+        waiver = guarded_waivers.pop((cls_name, attr), None)
+        if waiver is not None:
+            continue
+        findings.append(mod.finding(
+            STALE_RULE, cls_node,
+            f"_GUARDED_BY entry {cls_name}.{attr} was never exercised by "
+            f"the sanitized run (no access with its lock held): dead "
+            f"annotation, dead code, or a suite gap — fix or waive in "
+            f"tools/drlint/rt/waivers.py"))
+
+    # 3. Model gaps: observed edges the static graph cannot prove.
+    analysis = build_analysis(program)
+    static_edge_set = {(norm.canon(src), norm.canon(dst))
+                       for (src, dst) in analysis.edges}
+    seen_gaps: set[tuple[Node, Node]] = set()
+    observed_edges: set[tuple[Node, Node]] = set()
+    for e in artifact.edges:
+        src, dst = e.get("src"), e.get("dst")
+        if not src or not dst:
+            continue  # unresolved runtime name: nothing to compare
+        key = (norm.canon((src[0], src[1])), norm.canon((dst[0], dst[1])))
+        if not (norm.known(key[0]) and norm.known(key[1])):
+            continue  # fixture/test locks are out of scope
+        observed_edges.add(key)
+        if key in static_edge_set or key in seen_gaps:
+            continue
+        if edge_waivers.pop(key, None) is not None:
+            seen_gaps.add(key)
+            continue
+        seen_gaps.add(key)
+        mod = program.by_path.get(key[0][0])
+        path = mod.path if mod is not None else \
+            (norm.classes[key[0][0]].mod.path
+             if key[0][0] in norm.classes else "?")
+        line = (norm.classes[key[0][0]].node.lineno
+                if key[0][0] in norm.classes else 1)
+        findings.append(Finding(
+            rule=GAP_RULE, path=path, line=line,
+            message=(
+                f"observed acquisition edge "
+                f"{key[0][0]}.{key[0][1]} -> {key[1][0]}.{key[1][1]} "
+                f"(at {e.get('src_site', '?')} -> {e.get('dst_site', '?')}) "
+                f"is absent from the static lock-order graph: the static "
+                f"model has a resolution gap here — add typing the pass "
+                f"can follow, restructure, or waive in "
+                f"tools/drlint/rt/waivers.py"),
+            context=""))
+
+    # 4. Waiver hygiene: what's left in the dicts was never needed; an
+    #    entry consumed above but whose subject WAS observed is stale too.
+    for (cls_name, attr), why in sorted(guarded_waivers.items()):
+        status = ("was exercised by this run"
+                  if (cls_name, attr) in observed else
+                  "names no committed _GUARDED_BY entry"
+                  if (cls_name, attr) not in claims else None)
+        if status is None:
+            continue  # valid but unexercised claim path can't happen: popped
+        findings.append(Finding(
+            rule=WAIVER_RULE, path="tools/drlint/rt/waivers.py", line=1,
+            message=f"guarded waiver ({cls_name}, {attr}) {status} — "
+                    f"remove it", context=""))
+    for key, why in sorted(edge_waivers.items()):
+        if key in observed_edges and key in static_edge_set:
+            findings.append(Finding(
+                rule=WAIVER_RULE, path="tools/drlint/rt/waivers.py", line=1,
+                message=f"edge waiver {key} is provable statically — "
+                        f"remove it", context=""))
+        elif not (norm.known(norm.canon(tuple(key[0])))
+                  and norm.known(norm.canon(tuple(key[1])))):
+            # Same unknown-entry hygiene the guarded waivers get: a
+            # renamed class must not leave its edge waiver rotting
+            # while the edge resurfaces as a model gap under the new
+            # name.
+            findings.append(Finding(
+                rule=WAIVER_RULE, path="tools/drlint/rt/waivers.py", line=1,
+                message=f"edge waiver {key} names no statically-known "
+                        f"lock owner — remove or update it", context=""))
+    findings.sort(key=lambda f: (f.rule, f.path, f.line, f.message))
+    return findings
+
+
+def main(artifact_path: str, paths: list[str] | None,
+         as_json: bool = False) -> int:
+    art = Artifact.load(artifact_path)
+    program = build_program(paths if paths else None)
+    findings = reconcile(art, program)
+    claims = static_guards(program)
+    exercised = sum(1 for key in claims if key in art.accesses)
+    summary = {
+        "findings": len(findings),
+        "rt_findings": len({r.get("fingerprint") for r in art.findings}),
+        "guarded_total": len(claims),
+        "guarded_exercised": exercised,
+        "edges_observed": len(art.edges),
+        "processes": len(art.pids),
+    }
+    if as_json:
+        print(json.dumps({
+            "schema": "drlint-reconcile-v1",
+            "findings": [f.to_json() for f in findings],
+            "summary": summary,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        import sys
+        print(f"drlint --reconcile: {len(findings)} finding(s); "
+              f"{exercised}/{len(claims)} _GUARDED_BY entries exercised "
+              f"across {len(art.pids)} sanitized process(es)",
+              file=sys.stderr)
+        print(json.dumps({"drlint-reconcile": summary}))
+    return 1 if findings else 0

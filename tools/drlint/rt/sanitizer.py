@@ -1,0 +1,544 @@
+"""drlint-rt core: shared state, artifact writer, naming, suppressions.
+
+The runtime half of drlint's concurrency model. The static passes
+(rules/lock_order.py, rules/lock_discipline.py, rules/
+blocking_under_lock.py) PROVE properties of the code they can resolve;
+this module OBSERVES the same properties in a live process and streams
+what it sees to a JSONL artifact:
+
+- ``finding`` records — violations, named with runtime rule ids that
+  mirror the static catalog (``rt-lock-order``, ``rt-guardedby``,
+  ``rt-blocking``, ``rt-hold``) and carrying the same SARIF-lite
+  fingerprint scheme (sha256(rule|file|context|message)[:16]) as
+  ``core.Finding`` so CI diffing treats both alike;
+- ``edge`` records — every first-seen lock-acquisition edge (lock B
+  acquired while A held), the raw material ``--reconcile`` diffs
+  against the static lock-order graph;
+- ``access`` records — every first-seen guarded-attribute access made
+  WITH its declared lock held, proving the ``_GUARDED_BY`` entry is
+  exercised (a committed entry with no access record is a
+  stale-annotation finding at reconcile time);
+- ``hold`` records — per-acquisition-site hold-time histograms,
+  flushed at exit, rendered by obs_report's Sanitizer section.
+
+Inline ``# drlint: disable=<rule>`` suppressions are honored at
+runtime with the SAME file/line semantics as the static passes: a
+would-be finding whose stack crosses a suppressed line (for the
+matching static rule id or the rt- id) is dropped. That keeps the two
+halves of the contract symmetric — a deliberately-held design
+suppressed statically (the transport client's serialized exchange)
+does not re-fire dynamically.
+
+Everything here uses PRE-PATCH threading primitives (the state lock is
+a raw ``_thread`` lock captured at import) so the sanitizer can never
+trip over its own instrumentation.
+"""
+
+from __future__ import annotations
+
+import _thread
+import atexit
+import hashlib
+import json
+import os
+import re
+import sys
+import threading
+import time
+import traceback
+
+from tools.drlint.core import _REPO_ROOT, repo_rel
+
+_RT_DIR = os.path.dirname(os.path.abspath(__file__))
+
+SLEEP_THRESHOLD_S = 0.05  # same bar as the static blocking-under-lock
+
+# Runtime rule id -> static rule ids whose suppression comments also
+# silence it (the symmetric-contract table above).
+SUPPRESSION_ALIASES = {
+    "rt-lock-order": ("lock-order",),
+    "rt-guardedby": ("lock-discipline",),
+    "rt-blocking": ("blocking-under-lock",),
+    "rt-hold": ("blocking-under-lock",),
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*drlint:\s*disable=([a-zA-Z0-9_,\- ]+)")
+
+
+def _hold_threshold_ms() -> float:
+    raw = os.environ.get("DRL_SANITIZE_HOLD_MS", "")
+    try:
+        return float(raw) if raw else 1000.0
+    except ValueError:
+        return 1000.0
+
+
+_SCOPE: tuple[str, ...] | None = None
+
+
+def _scope_dirs() -> tuple[str, ...]:
+    """Extra in-scope directories (DRL_SANITIZE_SCOPE, colon-separated):
+    the planted-bug fixture scripts live in pytest tmp dirs, outside the
+    repo, and opt in through this. Read once per process."""
+    global _SCOPE
+    if _SCOPE is None:
+        raw = os.environ.get("DRL_SANITIZE_SCOPE", "")
+        _SCOPE = tuple(os.path.abspath(p) for p in raw.split(":") if p)
+    return _SCOPE
+
+
+def _in_repo(path: str) -> bool:
+    if path.startswith(_REPO_ROOT + os.sep):
+        return True
+    return any(path.startswith(d + os.sep) or path == d
+               for d in _scope_dirs())
+
+
+def _is_rt_frame(path: str) -> bool:
+    return path.startswith(_RT_DIR + os.sep) or path == __file__
+
+
+def fingerprint(rule: str, path: str, context: str, message: str) -> str:
+    """core.Finding.fingerprint, byte-identical scheme."""
+    blob = "|".join((rule, path, context, message))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class _SuppressionCache:
+    """Per-file `# drlint: disable=` maps, scanned lazily (the runtime
+    cannot afford core.ModuleInfo's full parse per finding)."""
+
+    def __init__(self):
+        self._files: dict[str, dict[int, set[str]]] = {}
+
+    def _scan(self, path: str) -> dict[int, set[str]]:
+        out: dict[int, set[str]] = {}
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return out
+        for i, line in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            target = i + 1 if line.lstrip().startswith("#") else i
+            out.setdefault(target, set()).update(rules)
+        return out
+
+    def suppressed(self, path: str, line: int, rule: str) -> bool:
+        if path not in self._files:
+            self._files[path] = self._scan(path)
+        rules = self._files[path].get(line, ())
+        if not rules:
+            return False
+        wanted = {rule, "all", *SUPPRESSION_ALIASES.get(rule, ())}
+        return bool(wanted & set(rules))
+
+
+def _stack_frames(skip_rt: bool = True, limit: int = 25) -> list[tuple[str, int, str]]:
+    """(abs file, line, function) outermost-last, rt/threading frames
+    dropped."""
+    out: list[tuple[str, int, str]] = []
+    f = sys._getframe(1)
+    while f is not None and len(out) < limit:
+        path = f.f_code.co_filename
+        if not (skip_rt and (_is_rt_frame(path) or path.endswith("threading.py"))):
+            out.append((path, f.f_lineno, f.f_code.co_name))
+        f = f.f_back
+    return out
+
+
+def _render_stack(frames: list[tuple[str, int, str]]) -> list[str]:
+    return [f"{repo_rel(p)}:{ln} in {fn}" for p, ln, fn in frames]
+
+
+def _defining_class(frame) -> str | None:
+    """Name of the class that DEFINES the function executing in `frame`
+    (not the instance's concrete type): matches how the static model
+    attributes a lock to the class whose __init__ textually creates it,
+    so runtime names line up with static (ClassName, attr) nodes even
+    for subclass instances."""
+    obj = frame.f_locals.get("self")
+    if obj is None:
+        obj = frame.f_locals.get("cls")
+    if obj is None:
+        return None
+    klass = obj if isinstance(obj, type) else type(obj)
+    code = frame.f_code
+    for base in getattr(klass, "__mro__", (klass,)):
+        fn = vars(base).get(code.co_name)
+        fn = getattr(fn, "__func__", fn)  # classmethod/staticmethod
+        if getattr(fn, "__code__", None) is code:
+            return base.__name__
+    return klass.__name__
+
+
+class Sanitizer:
+    """Process-global sanitizer state. One instance per process, built
+    by rt.install(); every hook (locks, guards, blocking) funnels here."""
+
+    def __init__(self, out_path: str | None = None):
+        self.out_path = out_path if out_path is not None else \
+            os.environ.get("DRL_SANITIZE_OUT") or None
+        self.hold_ms = _hold_threshold_ms()
+        self._state = _thread.allocate_lock()  # raw: never instrumented
+        self._tl = threading.local()
+        self._suppr = _SuppressionCache()
+        # Observed acquisition graph over live lock OBJECTS (identity,
+        # not names: two locks of different instances taken in both
+        # orders is not a deadlock). Strong refs keep ids stable.
+        self._adj: dict[int, set[int]] = {}
+        self._edge_meta: dict[tuple[int, int], dict] = {}
+        self._locks_by_id: dict[int, object] = {}
+        # Static lock-order edges to contradict (optional, loaded from
+        # DRL_SANITIZE_MODEL — a JSON {"edges": [[[own,name],[own,name]], ..]}).
+        self._static_edges: set[tuple] = set()
+        self._load_static_model()
+        self._seen_accesses: set[tuple[str, str]] = set()
+        self._holds: dict[str, dict] = {}  # site -> histogram
+        # First-seen-by-fingerprint dedup: a violation on a hot path
+        # (an unguarded attr read in a drain loop) must not turn the
+        # artifact into GBs of identical records — repeats only bump a
+        # counter, flushed at exit as finding_count records.
+        self._finding_counts: dict[str, int] = {}
+        self.findings = 0
+        self._wrote_meta = False
+        atexit.register(self._flush_counts)
+        atexit.register(self._flush_holds)
+
+    # -- artifact ---------------------------------------------------------
+
+    def _load_static_model(self) -> None:
+        path = os.environ.get("DRL_SANITIZE_MODEL", "")
+        if not path:
+            return
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            for src, dst in doc.get("edges", []):
+                self._static_edges.add((tuple(src), tuple(dst)))
+        except (OSError, ValueError):
+            pass
+
+    def _emit(self, record: dict) -> None:
+        """One JSONL line, O_APPEND single-write so concurrent sanitized
+        processes (the two-process suites) interleave whole lines."""
+        if self.out_path is None:
+            return
+        record.setdefault("pid", os.getpid())
+        line = json.dumps(record, sort_keys=True) + "\n"
+        try:
+            if not self._wrote_meta:
+                self._wrote_meta = True
+                meta = json.dumps({"kind": "meta", "pid": os.getpid(),
+                                   "argv": sys.argv[:4],
+                                   "hold_ms": self.hold_ms,
+                                   "t": time.time()}) + "\n"
+                line = meta + line
+            with open(self.out_path, "a", encoding="utf-8") as f:
+                f.write(line)
+        except OSError:
+            pass
+
+    def finding(self, rule: str, message: str,
+                frames: list[tuple[str, int, str]],
+                stack2: list[str] | None = None,
+                detail: str | None = None) -> None:
+        """Record one violation. `frames` is the capture from the
+        violation site; the innermost REPO frame anchors file/line/
+        context. Suppression comments on ANY repo frame's active line
+        (for this rule or its static alias) drop the finding — the
+        PR 11 transport-exchange design must not re-fire at runtime."""
+        repo_frames = [fr for fr in frames if _in_repo(fr[0])]
+        for path, line, _fn in repo_frames:
+            if self._suppr.suppressed(path, line, rule):
+                return
+        anchor = repo_frames[0] if repo_frames else (frames[0] if frames
+                                                     else ("<unknown>", 0, ""))
+        path = repo_rel(anchor[0])
+        fp = fingerprint(rule, path, anchor[2], message)
+        with self._state:
+            self.findings += 1
+            count = self._finding_counts.get(fp, 0) + 1
+            self._finding_counts[fp] = count
+        if count > 1:
+            return  # first-seen only; repeats flush as finding_count
+        record = {
+            "kind": "finding", "rule": rule, "file": path,
+            "line": anchor[1], "context": anchor[2], "message": message,
+            "fingerprint": fp,
+            "stack": _render_stack(frames),
+            "tid": threading.get_ident(), "t": time.time(),
+        }
+        if stack2:
+            record["stack2"] = stack2
+        if detail:
+            record["detail"] = detail
+        self._emit(record)
+        print(f"drlint-rt: [{rule}] {path}:{anchor[1]}: {message}"
+              f"{' [' + detail + ']' if detail else ''}",
+              file=sys.stderr)
+
+    # -- held-set ---------------------------------------------------------
+
+    def held(self) -> list:
+        """This thread's held SanLock stack (innermost last)."""
+        try:
+            return self._tl.stack
+        except AttributeError:
+            self._tl.stack = []
+            return self._tl.stack
+
+    def on_acquired(self, lock) -> None:
+        held = self.held()
+        now = time.monotonic()
+        site = self._acquire_site()
+        lock._hold_t0 = now
+        lock._hold_site = site
+        lock.owner_ident = threading.get_ident()
+        for h in held:
+            self._record_edge(h, lock)
+        held.append(lock)
+
+    def on_released(self, lock) -> None:
+        held = self.held()
+        try:
+            held.remove(lock)
+        except ValueError:
+            pass  # released by a thread that never saw the acquire
+        lock.owner_ident = None
+        t0 = getattr(lock, "_hold_t0", None)
+        site = getattr(lock, "_hold_site", None)
+        if t0 is None or site is None:
+            return
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        with self._state:
+            h = self._holds.setdefault(
+                site, {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+            h["count"] += 1
+            h["total_ms"] += dt_ms
+            h["max_ms"] = max(h["max_ms"], dt_ms)
+        if dt_ms >= self.hold_ms:
+            frames = _stack_frames()
+            # The measured duration goes in `detail`, NOT the message:
+            # the fingerprint hashes the message, and a per-occurrence
+            # millisecond value would defeat the first-seen dedup (one
+            # slow site per loop iteration = one record per iteration).
+            # The per-site max/mean live in the hold histogram anyway.
+            self.finding(
+                "rt-hold",
+                f"lock {self.lock_label(lock)} held past the "
+                f"{self.hold_ms:.0f} ms threshold at {site}", frames,
+                detail=f"{dt_ms:.0f} ms")
+
+    def _acquire_site(self) -> str:
+        """repo-relative file:line of the innermost non-rt caller frame
+        — the acquisition site the hold histogram keys on."""
+        f = sys._getframe(2)
+        while f is not None:
+            path = f.f_code.co_filename
+            if not _is_rt_frame(path) and not path.endswith("threading.py"):
+                return f"{repo_rel(path)}:{f.f_lineno}"
+            f = f.f_back
+        return "<unknown>"
+
+    # -- edges + cycles ---------------------------------------------------
+
+    def _record_edge(self, src, dst) -> None:
+        if src is dst:
+            return
+        key = (id(src), id(dst))
+        if key in self._edge_meta:
+            return
+        frames = _stack_frames()
+        stack = _render_stack(frames)
+        with self._state:
+            if key in self._edge_meta:
+                return
+            self._locks_by_id[id(src)] = src
+            self._locks_by_id[id(dst)] = dst
+            self._adj.setdefault(id(src), set()).add(id(dst))
+            self._edge_meta[key] = {"stack": stack}
+            cycle_path = self._find_path(id(dst), id(src))
+        src_name = self.lock_name(src)
+        dst_name = self.lock_name(dst)
+        self._emit({"kind": "edge",
+                    "src": list(src_name) if src_name else None,
+                    "dst": list(dst_name) if dst_name else None,
+                    "src_site": getattr(src, "site", "?"),
+                    "dst_site": getattr(dst, "site", "?"),
+                    "stack": stack})
+        if cycle_path is not None:
+            other = self._edge_meta.get((cycle_path[0], cycle_path[1]),
+                                        {}).get("stack", [])
+            self.finding(
+                "rt-lock-order",
+                f"lock-order cycle closed: {self.lock_label(dst)} acquired "
+                f"while holding {self.lock_label(src)}, but the reverse "
+                f"order was already observed (potential deadlock)",
+                frames, stack2=other)
+        elif self._static_edges and src_name and dst_name and \
+                (dst_name, src_name) in self._static_edges:
+            self.finding(
+                "rt-lock-order",
+                f"observed order {self.lock_label(src)} -> "
+                f"{self.lock_label(dst)} contradicts the static lock_order "
+                f"graph edge {dst_name} -> {src_name}", frames)
+
+    def _find_path(self, start: int, goal: int) -> list[int] | None:
+        """DFS in the observed graph (state lock held by caller)."""
+        if start == goal:
+            return [start]
+        seen = {start}
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in self._adj.get(node, ()):
+                if nxt == goal:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- lock naming ------------------------------------------------------
+
+    def lock_name(self, lock) -> tuple[str, str] | None:
+        """Static-model node name for a runtime lock: (ClassName, attr)
+        for instance locks — ClassName being the DEFINING class of the
+        ctor frame, matching _locks.ClassModel — or (repo-relative
+        module path, var) for module-level locks. Resolved lazily by
+        scanning the owner's attributes for the lock object (or a
+        Condition wrapping it); None until the assignment is findable."""
+        cached = getattr(lock, "name", None)
+        if cached is not None:
+            return cached
+        owner_cls = getattr(lock, "owner_cls", None)
+        owner_ref = getattr(lock, "owner_ref", None)
+        owner = owner_ref() if owner_ref is not None else None
+        if owner is not None and owner_cls:
+            attr = self._scan_for(owner, lock)
+            if attr is not None:
+                lock.name = (owner_cls, attr)
+                return lock.name
+            return None
+        mod_name = getattr(lock, "module", None)
+        if mod_name:
+            mod = sys.modules.get(mod_name)
+            if mod is not None:
+                attr = self._scan_for(mod, lock)
+                if attr is not None:
+                    # site is already "repo-rel-path:line" (locks.py) —
+                    # strip the line, keep the path verbatim.
+                    lock.name = (getattr(lock, "site", "?")
+                                 .rsplit(":", 1)[0], attr)
+                    return lock.name
+        return None
+
+    @staticmethod
+    def _scan_for(owner, lock) -> str | None:
+        try:
+            items = list(vars(owner).items())
+        except TypeError:
+            return None
+        indirect = None
+        for k, v in items:
+            if v is lock:
+                return k
+            # A Condition over this lock: prefer the mutex's own attr
+            # name (the static canon), fall back to the condition's.
+            if getattr(v, "_lock", None) is lock and indirect is None:
+                indirect = k
+        return indirect
+
+    def lock_label(self, lock) -> str:
+        name = self.lock_name(lock)
+        if name is not None:
+            return f"{name[0]}.{name[1]}"
+        return f"<lock @ {getattr(lock, 'site', '?')}>"
+
+    # -- guarded accesses -------------------------------------------------
+
+    def on_guarded_ok(self, cls_name: str, attr: str) -> None:
+        key = (cls_name, attr)
+        if key in self._seen_accesses:
+            return
+        with self._state:
+            if key in self._seen_accesses:
+                return
+            self._seen_accesses.add(key)
+        self._emit({"kind": "access", "cls": cls_name, "attr": attr})
+
+    def on_guarded_violation(self, obj, cls_name: str, attr: str,
+                             locks: tuple[str, ...], write: bool) -> None:
+        """Called only on the slow path (no declared lock held). Runtime
+        exemptions mirror the static lock-discipline escapes: __init__/
+        __del__ of the instance itself, *_locked caller-holds methods,
+        and accesses whose nearest repo frame is OUTSIDE the package
+        (tests poking internals are out of scope, like Java's
+        @GuardedBy)."""
+        frames = _stack_frames()
+        pkg_root = os.path.join(_REPO_ROOT,
+                                "distributed_reinforcement_learning_tpu")
+        for path, _line, fn in frames:
+            if not _in_repo(path):
+                continue
+            if fn.endswith("_locked") or fn in ("__init__", "__del__"):
+                return
+            if not path.startswith(pkg_root + os.sep) and \
+                    not any(path.startswith(d + os.sep)
+                            for d in _scope_dirs()):
+                return  # nearest repo frame is test/tool code: out of scope
+            break
+        else:
+            return
+        kind = "write to" if write else "read of"
+        self.finding(
+            "rt-guardedby",
+            f"{kind} {cls_name}.{attr} without holding "
+            f"{'/'.join(locks)} (declared in _GUARDED_BY)", frames)
+
+    # -- blocking calls ---------------------------------------------------
+
+    def on_blocking_call(self, what: str) -> None:
+        held = self.held()
+        if not held:
+            return
+        frames = _stack_frames()
+        labels = ", ".join(self.lock_label(h) for h in held)
+        self.finding("rt-blocking",
+                     f"{what} while holding {labels}", frames)
+
+    # -- hold histogram flush ---------------------------------------------
+
+    def _flush_counts(self) -> None:
+        with self._state:
+            repeats = {fp: n for fp, n in self._finding_counts.items()
+                       if n > 1}
+        for fp, n in repeats.items():
+            self._emit({"kind": "finding_count", "fingerprint": fp,
+                        "count": n})
+
+    def _flush_holds(self) -> None:
+        with self._state:
+            holds = {site: dict(h) for site, h in self._holds.items()}
+        for site, h in holds.items():
+            self._emit({"kind": "hold", "site": site, "count": h["count"],
+                        "total_ms": round(h["total_ms"], 3),
+                        "max_ms": round(h["max_ms"], 3)})
+
+
+_INSTANCE: Sanitizer | None = None
+
+
+def get() -> Sanitizer | None:
+    return _INSTANCE
+
+
+def activate(out_path: str | None = None) -> Sanitizer:
+    global _INSTANCE
+    if _INSTANCE is None:
+        _INSTANCE = Sanitizer(out_path=out_path)
+    return _INSTANCE

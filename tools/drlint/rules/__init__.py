@@ -14,6 +14,7 @@ positive/negative fixture pair in tests/test_drlint.py.
 
 from tools.drlint.rules.blocking_under_lock import check as _blocking_under_lock
 from tools.drlint.rules.dtype_pitfall import check as _dtype_pitfall
+from tools.drlint.rules.guardedby_completeness import check as _guardedby_completeness
 from tools.drlint.rules.host_sync import check as _host_sync
 from tools.drlint.rules.jit_purity import check as _jit_purity
 from tools.drlint.rules.knob_registry import check as _knob_registry
@@ -26,6 +27,7 @@ RULES = {
     "jit-purity": _jit_purity,
     "host-sync": _host_sync,
     "lock-discipline": _lock_discipline,
+    "guardedby-completeness": _guardedby_completeness,
     "nondeterminism": _nondeterminism,
     "dtype-pitfall": _dtype_pitfall,
 }

@@ -1,0 +1,213 @@
+"""guardedby-completeness: lock-owning classes declare ALL shared state.
+
+The lock-discipline pass enforces the `_GUARDED_BY` entries a class
+HAS; nothing enforced that the map is COMPLETE. A class that owns a
+lock is self-declaring "my instances are touched by multiple threads"
+— and every mutable attribute it initializes is then shared state that
+either needs a lock (add it to `_GUARDED_BY`) or a conscious decision
+that it doesn't (declare it in a `_NOT_GUARDED` waiver map with a
+justification). This pass closes the annotate-or-waive loop so a new
+field added to a threaded class can never silently skip the
+concurrency contract; the runtime sanitizer then verifies the
+`_GUARDED_BY` side is real (docs/static_analysis.md "Runtime
+sanitizer").
+
+Trigger: any class whose OWN body assigns a `threading.Lock/RLock/
+Condition/Semaphore` to `self.<x>` (lock construction is the static
+proxy for "touched by multiple threads"; classes that merely receive
+shared objects are out of scope, like lock-discipline's
+other-name accesses).
+
+Flagged: an instance attribute assigned in `__init__` that is
+
+- rebound in any other method (torn read/lost update risk), or
+- initialized to a mutable container (list/dict/set displays or
+  comprehensions, or a call to list/dict/set/deque/defaultdict/
+  OrderedDict/Counter/bytearray),
+
+and appears in neither `_GUARDED_BY` nor `_NOT_GUARDED`. Lock
+attributes themselves, Conditions, and immutable run-once config
+(ints, strings, tuples, param objects) are exempt.
+
+`_NOT_GUARDED` is a class-level dict `{"attr": "justification", ...}`
+(a tuple of `(attr, justification)` pairs also parses). Justifications
+under 10 chars, and entries for attrs that no longer exist or are now
+in `_GUARDED_BY`, are findings — the waiver map can only shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Finding, ModuleInfo
+from tools.drlint.rules._locks import _called_chain_tail, LOCK_CTORS
+
+RULE = "guardedby-completeness"
+
+_MUTABLE_CALLS = {"list", "dict", "set", "deque", "defaultdict",
+                  "OrderedDict", "Counter", "bytearray"}
+
+
+def _self_attr_targets(node: ast.AST) -> list[str]:
+    """Attr names a statement assigns on self (tuple unpacking too)."""
+    out: list[str] = []
+    targets: list[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets = [node.target]
+    for tgt in targets:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            targets.extend(tgt.elts)
+        elif isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            out.append(tgt.attr)
+    return out
+
+
+def _is_mutable_init(value: ast.AST | None) -> bool:
+    if value is None:
+        return False
+    if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        name = None
+        if isinstance(value.func, ast.Name):
+            name = value.func.id
+        elif isinstance(value.func, ast.Attribute):
+            name = value.func.attr
+        return name in _MUTABLE_CALLS
+    return False
+
+
+def _literal_str_map(value: ast.AST) -> dict[str, str] | None:
+    """Parse `_NOT_GUARDED`: a {"attr": "why"} dict or a tuple/list of
+    ("attr", "why") pairs. None if the shape is unrecognizable."""
+    if isinstance(value, ast.Dict):
+        out = {}
+        for k, v in zip(value.keys, value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                return None
+            out[k.value] = v.value
+        return out
+    if isinstance(value, (ast.Tuple, ast.List)):
+        out = {}
+        for elt in value.elts:
+            if not (isinstance(elt, (ast.Tuple, ast.List))
+                    and len(elt.elts) == 2
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in elt.elts)):
+                return None
+            out[elt.elts[0].value] = elt.elts[1].value
+        return out
+    return None
+
+
+def _class_level_assign(cls: ast.ClassDef, name: str) -> ast.AST | None:
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == name:
+            return stmt
+        if isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name) and stmt.target.id == name:
+            return stmt
+    return None
+
+
+def _guarded_keys(cls: ast.ClassDef) -> set[str]:
+    stmt = _class_level_assign(cls, "_GUARDED_BY")
+    value = getattr(stmt, "value", None)
+    if not isinstance(value, ast.Dict):
+        return set()
+    return {k.value for k in value.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+
+
+def _check_class(mod: ModuleInfo, cls: ast.ClassDef,
+                 out: list[Finding]) -> None:
+    # Trigger + exempt set: everything lock-shaped this class's own
+    # body constructs or aliases.
+    lock_attrs: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _called_chain_tail(mod, node.value) in LOCK_CTORS:
+                for attr in _self_attr_targets(node):
+                    lock_attrs.add(attr)
+    if not lock_attrs:
+        return
+
+    guarded = _guarded_keys(cls)
+    ng_stmt = _class_level_assign(cls, "_NOT_GUARDED")
+    waived: dict[str, str] = {}
+    if ng_stmt is not None:
+        parsed = _literal_str_map(ng_stmt.value)
+        if parsed is None:
+            out.append(mod.finding(
+                RULE, ng_stmt,
+                "_NOT_GUARDED must be a literal {'attr': 'justification'} "
+                "dict (or tuple of (attr, justification) pairs)"))
+        else:
+            waived = parsed
+
+    methods = {m.name: m for m in cls.body
+               if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    init = methods.get("__init__")
+
+    init_attrs: dict[str, ast.AST] = {}
+    if init is not None:
+        for node in ast.walk(init):
+            for attr in _self_attr_targets(node):
+                init_attrs.setdefault(attr, node)
+
+    rebound: set[str] = set()
+    for name, meth in methods.items():
+        if name == "__init__":
+            continue
+        for node in ast.walk(meth):
+            rebound.update(_self_attr_targets(node))
+
+    for attr, node in sorted(init_attrs.items()):
+        if attr in lock_attrs or attr in guarded or attr in waived:
+            continue
+        value = getattr(node, "value", None)
+        if attr not in rebound and not _is_mutable_init(value):
+            continue  # immutable run-once config
+        why = ("rebound outside __init__" if attr in rebound
+               else "initialized to a mutable container")
+        out.append(mod.finding(
+            RULE, node,
+            f"self.{attr} in lock-owning class {cls.name} ({why}) is in "
+            f"neither _GUARDED_BY nor _NOT_GUARDED — declare its lock or "
+            f"waive it with a justification"))
+
+    # Waiver hygiene, mirroring the baseline contract.
+    if ng_stmt is not None:
+        for attr, why in sorted(waived.items()):
+            if attr in guarded:
+                out.append(mod.finding(
+                    RULE, ng_stmt,
+                    f"_NOT_GUARDED entry {attr!r} is also in _GUARDED_BY — "
+                    f"pick one"))
+            elif attr not in init_attrs and attr not in rebound:
+                out.append(mod.finding(
+                    RULE, ng_stmt,
+                    f"_NOT_GUARDED entry {attr!r} matches no instance "
+                    f"attribute of {cls.name} — remove it"))
+            if len(why.strip()) < 10:
+                out.append(mod.finding(
+                    RULE, ng_stmt,
+                    f"_NOT_GUARDED entry {attr!r} needs a real "
+                    f"justification, not {why!r}"))
+
+
+def check(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            _check_class(mod, node, findings)
+    return findings

@@ -302,7 +302,11 @@ def _sccs(nodes, edges) -> list[list[LockNode]]:
     return out
 
 
-def check(program: Program) -> list[Finding]:
+def build_analysis(program: Program) -> _Analysis:
+    """The populated whole-program analysis (nodes + edges). Shared by
+    `check` and the runtime reconciler (`tools/drlint/rt/reconcile.py`),
+    which diffs OBSERVED acquisition edges against `analysis.edges` —
+    one edge prover for both halves of the contract."""
     analysis = _Analysis(program)
     for mod in program.modules:
         analysis.walk_module_functions(mod)
@@ -311,6 +315,11 @@ def check(program: Program) -> list[Finding]:
             # while walking the class's OWN method bodies.
             merged = analysis.model(cls.name) or cls
             analysis.walk_class(merged if merged.node is cls.node else cls)
+    return analysis
+
+
+def check(program: Program) -> list[Finding]:
+    analysis = build_analysis(program)
     edges = analysis.edges
     nodes = {n for e in edges for n in e}
     findings: list[Finding] = []
