@@ -74,7 +74,7 @@ _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
                  "watchdog", "chunk_regressions", "transport_verdict",
                  "codec_verdict", "weights_verdict", "weights_shard_verdict",
                  "replay_verdict", "inference_verdict", "chaos_verdict",
-                 "actor_pipeline_verdict")
+                 "actor_pipeline_verdict", "learner_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -2267,6 +2267,303 @@ def bench_replay_compare(n_unrolls: int = 192, unrolls_per_put: int = 8,
     return out
 
 
+# Children for bench_learner_compare: one learner SEAT of the tier
+# (runtime/learner_tier.py — real collective, real transport server,
+# real sharded-replay ingest) and one duration-mode PUT feeder. The
+# seat child is the production ApexLearner + LearnerTier wiring, so the
+# A/B prices exactly what `launch_local_cluster --learners N` deploys.
+_LEARNER_SEAT_CHILD = r"""
+import json, sys, time
+
+import numpy as np
+
+# Collective endpoint up FIRST (cheap, before the seconds of jax/agent
+# init): peers' startup barriers probe it.
+(host, port, rank, seats, sync, peers, window_s, steps, obs_dim) = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    sys.argv[5], sys.argv[6], float(sys.argv[7]), int(sys.argv[8]),
+    int(sys.argv[9]))
+tier = None
+if seats > 1:
+    from distributed_reinforcement_learning_tpu.runtime.learner_tier import (
+        LearnerTier)
+
+    tier = LearnerTier(rank, peers.split(","), sync=sync).start()
+
+import jax
+from collections import namedtuple
+
+from distributed_reinforcement_learning_tpu.agents.apex import ApexAgent, ApexConfig
+from distributed_reinforcement_learning_tpu.data import codec
+from distributed_reinforcement_learning_tpu.data.fifo import blob_ingest
+from distributed_reinforcement_learning_tpu.data.replay_service import (
+    ShardedReplayService)
+from distributed_reinforcement_learning_tpu.runtime import apex_runner
+from distributed_reinforcement_learning_tpu.runtime.replay_shard import (
+    ReplayIngestFifo)
+from distributed_reinforcement_learning_tpu.runtime.transport import (
+    TransportServer, _make_queue)
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+agent = ApexAgent(ApexConfig(obs_shape=(obs_dim,), num_actions=2))
+queue = _make_queue(64)
+svc = ShardedReplayService(2, 16384, mode="transition", scorer="max",
+                           seed=rank)
+ingest_q = ReplayIngestFifo(svc, queue)
+weights = WeightStore()
+learner = apex_runner.ApexLearner(
+    agent, queue, weights, batch_size=32, replay_capacity=16384,
+    rng=jax.random.PRNGKey(0), replay_service=svc)
+if tier is not None:
+    tier.attach(learner)
+
+# Warm + compile OUTSIDE the timed window; the first tier-wrapped train
+# is a collective round, so the startup barrier runs first.
+cls = namedtuple("ApexBatch", ["state", "next_state", "previous_action",
+                               "action", "reward", "done"])
+rng = np.random.RandomState(rank)
+prepare, put = blob_ingest(ingest_q)
+for _ in range(12):
+    put(prepare(bytes(codec.encode(cls(
+        state=rng.rand(steps, obs_dim).astype(np.float32),
+        next_state=rng.rand(steps, obs_dim).astype(np.float32),
+        previous_action=rng.randint(0, 2, steps).astype(np.int32),
+        action=rng.randint(0, 2, steps).astype(np.int32),
+        reward=rng.randn(steps).astype(np.float32),
+        done=rng.rand(steps) < 0.1)))))
+while learner.ingest_many(timeout=0.0):
+    pass
+if tier is not None:
+    assert tier.await_peers(120.0), "tier startup barrier failed"
+assert learner.train() is not None
+server = TransportServer(ingest_q, weights, host=host, port=port).start()
+print("SEAT_READY", flush=True)
+
+base = svc.ingested_blobs()
+while svc.ingested_blobs() == base:
+    time.sleep(0.001)
+t0 = time.perf_counter()
+f0 = svc.ingested_blobs()
+steps0 = learner.train_steps
+deadline = t0 + window_s
+while time.perf_counter() < deadline:
+    # Bounded drain (see the seat-drill child): the collective couples
+    # train cadences, and an unbounded drain under a saturating feeder
+    # starves this seat's rounds and stalls the peer.
+    drained = False
+    for _ in range(8):
+        if not learner.ingest_many(timeout=0.002):
+            break
+        drained = True
+    if learner.train() is None and not drained:
+        time.sleep(0.001)
+elapsed = time.perf_counter() - t0
+frames = (svc.ingested_blobs() - f0) * steps
+out = {"rank": rank, "frames": frames, "elapsed": round(elapsed, 3),
+       "frames_per_s": round(frames / elapsed, 1),
+       "train_steps_in_window": learner.train_steps - steps0,
+       "tier_stats": tier.snapshot_stats() if tier is not None else None,
+       "coll_stats": (tier.collective.snapshot_stats()
+                      if tier is not None else None)}
+print("SEAT_RESULT=" + json.dumps(out), flush=True)
+learner.close()
+server.stop()
+queue.close()
+svc.close()
+if tier is not None:
+    tier.close()
+"""
+
+# Duration-mode feeder: PUTs identical unrolls (put_trajectories,
+# accepted counts honored) until the window closes.
+_LEARNER_PUT_CHILD = r"""
+import sys, time
+from collections import namedtuple
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.runtime.transport import TransportClient
+
+host, port, secs, upp, steps, obs_dim = (
+    sys.argv[1], int(sys.argv[2]), float(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]))
+ApexBatch = namedtuple("ApexBatch", ["state", "next_state", "previous_action",
+                                     "action", "reward", "done"])
+rng = np.random.RandomState(0)
+trees = []
+for _ in range(upp):
+    trees.append(ApexBatch(
+        state=rng.rand(steps, obs_dim).astype(np.float32),
+        next_state=rng.rand(steps, obs_dim).astype(np.float32),
+        previous_action=rng.randint(0, 2, steps).astype(np.int32),
+        action=rng.randint(0, 2, steps).astype(np.int32),
+        reward=rng.randn(steps).astype(np.float32),
+        done=(rng.rand(steps) < 0.1)))
+client = TransportClient(host, port, busy_timeout=120.0)
+sent = 0
+deadline = time.monotonic() + secs
+while time.monotonic() < deadline:
+    sent += client.put_trajectories(trees)
+client.close()
+print("PUT_CHILD_DONE", sent)
+"""
+
+
+def bench_learner_compare(seats: int = 2, sync: str = "allreduce",
+                          window_s: float = 10.0, unrolls_per_put: int = 8,
+                          steps: int = 32, obs_dim: int = 64,
+                          reps: int = 1) -> dict:
+    """Real multi-process A/B of the learner TIER (runtime/
+    learner_tier.py): ONE learner seat vs N cooperating seats, each a
+    REAL process running the deployed ApexLearner + sharded-replay
+    ingest + LearnerTier wiring, fed by one duration-mode PUT child per
+    seat over loopback TCP. The measured number is aggregate
+    ingest+train frames/s over a fixed window — the N-seat variant pays
+    the collective's host exchange inside its train steps, so the ratio
+    prices exactly what `--learners N` would deploy.
+
+    The verdict follows the repo's adjudication bar (Pallas-LSTM rule):
+    the tier ships enabled-by-default ONLY at >= 1.2x one seat's
+    throughput; the committed `benchmarks/learner_verdict.json` carries
+    the decision `runtime/learner_tier.seat_count()` (and the launcher
+    gate) consult. On a 2-core container N seats split the SAME cores —
+    an honest negative ships the tier opt-in, and the equivalence/chaos
+    pins in tests/test_learner_tier.py are the durable value."""
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # Transient stalls (a peer's jit compile) must not read as deaths
+    # inside the measured window.
+    env.setdefault("DRL_LEARNER_WAIT_S", "30")
+
+    def run_variant(n: int) -> dict:
+        ports = [_free_port() for _ in range(n)]
+        peers = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(n))
+        seat_procs = []
+        put_procs = []
+        # Dedicated stdout/stderr readers per seat (the seat-drill
+        # pattern): an undrained stderr pipe would block a chatty child
+        # mid-window, and a plain readline() would make the result
+        # deadline dead code against a wedged one.
+        stderr_tails: dict = {}
+        result_lines: dict = {}
+        watchers: list = []
+
+        def watch(idx, proc):
+            tail = stderr_tails.setdefault(idx, [])
+
+            def drain_err():
+                for line in proc.stderr:
+                    tail.append(line)
+                    del tail[:-60]
+
+            def drain_out():
+                for line in proc.stdout:
+                    if line.startswith("SEAT_RESULT="):
+                        result_lines[idx] = line
+            for fn in (drain_err, drain_out):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+                watchers.append(t)
+
+        try:
+            for r in range(n):
+                seat_procs.append(subprocess.Popen(
+                    [sys.executable, "-c", _LEARNER_SEAT_CHILD, "127.0.0.1",
+                     str(ports[r]), str(r), str(n), sync, peers,
+                     str(window_s), str(steps), str(obs_dim)],
+                    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True))
+            for r, proc in enumerate(seat_procs):
+                line = proc.stdout.readline()  # blocks only until READY
+                if "SEAT_READY" not in line:
+                    raise RuntimeError(
+                        f"seat failed to start: {proc.stderr.read()[-800:]}")
+                watch(r, proc)
+            for r in range(n):
+                put_procs.append(subprocess.Popen(
+                    [sys.executable, "-c", _LEARNER_PUT_CHILD, "127.0.0.1",
+                     str(ports[r]), str(window_s + 10.0),
+                     str(unrolls_per_put), str(steps), str(obs_dim)],
+                    env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
+            deadline = time.monotonic() + window_s + 180.0
+            while (len(result_lines) < n and time.monotonic() < deadline
+                   and not any(p.poll() is not None and r not in result_lines
+                               for r, p in enumerate(seat_procs))):
+                time.sleep(0.1)
+            time.sleep(0.5)  # let the drain threads consume any result
+            results = []     # line still buffered at a child's exit
+            for r in range(n):
+                line = result_lines.get(r)
+                if line is None:
+                    raise RuntimeError(
+                        f"seat {r} died or wedged mid-window: "
+                        f"{''.join(stderr_tails.get(r, []))[-800:]}")
+                results.append(json.loads(line.split("=", 1)[1]))
+        finally:
+            for proc in put_procs + seat_procs:
+                if proc.poll() is None:
+                    proc.kill()
+            for proc in put_procs + seat_procs:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            for t in watchers:
+                t.join(timeout=3.0)
+        total_fps = round(sum(r["frames_per_s"] for r in results), 1)
+        out = {"seats": n,
+               "frames_per_s": total_fps,
+               "per_seat_frames_per_s": [r["frames_per_s"] for r in results],
+               "train_steps_in_window": sum(r["train_steps_in_window"]
+                                            for r in results)}
+        if n > 1:
+            out["rounds_ok"] = sum((r["coll_stats"] or {}).get("rounds_ok", 0)
+                                   for r in results)
+            out["rounds_aborted"] = sum(
+                (r["tier_stats"] or {}).get("round_retries", 0)
+                for r in results)
+            if sync == "allreduce" and out["rounds_ok"] == 0:
+                # A 2-seat run whose seats never actually exchanged a
+                # round measured two INDEPENDENT learners — fail the
+                # variant instead of recording a mislabeled ratio.
+                raise RuntimeError("tier variant completed zero collective "
+                                   "rounds — not a tier measurement")
+        return out
+
+    out: dict = {
+        "seats": seats, "sync": sync, "window_s": window_s,
+        "note": ("real multi-process A/B: each seat is a full learner "
+                 "process (ApexLearner + 2 replay shards + LearnerTier "
+                 "collective), fed by its own PUT child over loopback "
+                 "TCP for a fixed window; aggregate ingest+train "
+                 "frames/s, collective exchange priced inside the "
+                 "window")}
+    best_solo = best_tier = None
+    for _ in range(reps):
+        solo = run_variant(1)
+        tier = run_variant(seats)
+        if best_solo is None or solo["frames_per_s"] > best_solo["frames_per_s"]:
+            best_solo = solo
+        if best_tier is None or tier["frames_per_s"] > best_tier["frames_per_s"]:
+            best_tier = tier
+    out["solo"] = best_solo
+    out["tier"] = best_tier
+    ratio = best_tier["frames_per_s"] / max(best_solo["frames_per_s"], 1e-9)
+    out["tier_vs_solo"] = round(ratio, 2)
+    out["auto_enable"] = ratio >= 1.2  # the repo's adjudication bar
+    out["verdict"] = (f"learner tier ({seats} seats, {sync}) "
+                      f"{ratio:.2f}x solo ingest+train: "
+                      + ("auto-on" if out["auto_enable"] else "opt-in"))
+    print(f"[bench] learner_compare: solo "
+          f"{best_solo['frames_per_s']:,.0f} f/s vs {seats} seats "
+          f"{best_tier['frames_per_s']:,.0f} f/s -> {out['verdict']}",
+          file=sys.stderr)
+    return out
+
+
 # Child processes for bench_inference_compare. The REPLICA child is one
 # act-serving process of the inference tier (runtime/serving.py): it
 # pulls weights from the parent's transport server, warms the bucketed
@@ -3267,20 +3564,422 @@ def bench_chaos_compare(n_actors: int = 2, secs: float = 18.0,
     out["dip_ratio"] = round(ratio, 2)
     out["zero_corruption"] = corrupt == 0
     out["repromoted_in_deadline"] = repromoted
+    # Kill-ONE-OF-N-learners drill (runtime/learner_tier.py): SIGKILL
+    # one of two cooperating learner seats mid-run; the survivor must
+    # re-form the collective SOLO, take over publication (board
+    # re-created under the same name, version identity), and every
+    # landed trajectory must still crc-verify. BENCH_SEAT_DRILL=0
+    # skips (it spawns 4 jax children).
+    if os.environ.get("BENCH_SEAT_DRILL", "1") == "1":
+        try:
+            out["seat_drill"] = _chaos_seat_drill(
+                repromote_deadline_s=repromote_deadline_s)
+            out["seat_drill_pass"] = bool(out["seat_drill"]["pass"])
+        except Exception as e:  # noqa: BLE001
+            out["seat_drill"] = {"error": f"{type(e).__name__}: {e}"}
+            out["seat_drill_pass"] = False
     out["chaos_pass"] = bool(corrupt == 0 and ratio >= dip_bound
-                             and repromoted)
+                             and repromoted
+                             and out.get("seat_drill_pass", True))
     rs = best_c["repromote_s"]
+    seat_note = ""
+    if "seat_drill_pass" in out:
+        seat_note = (", seat-kill "
+                     + ("ok" if out["seat_drill_pass"] else "FAIL"))
     out["verdict"] = (
         f"chaos {ratio:.2f}x baseline (bound {dip_bound}), "
         f"{corrupt} corrupt, re-promote "
         f"{'%.1fs' % rs if rs is not None else 'MISSING'}"
-        f"/{repromote_deadline_s:.0f}s: "
+        f"/{repromote_deadline_s:.0f}s{seat_note}: "
         + ("PASS" if out["chaos_pass"] else "FAIL"))
     print(f"[bench] chaos_compare: baseline "
           f"{best_b['frames_per_s']:,.0f} f/s vs chaos "
           f"{best_c['frames_per_s']:,.0f} f/s -> {out['verdict']}",
           file=sys.stderr)
     return out
+
+
+# Children for the kill-one-of-N-learners drill: one learner SEAT of a
+# 2-seat tier (real LearnerTier collective + FleetSupervisor + crc
+# verification of every landed trajectory) and one actor per seat
+# (crc-stamped PUTs + weight-board pulls with the heartbeat-driven
+# reattach ladder — the surviving seat's takeover must reach it).
+_SEAT_DRILL_LEARNER_CHILD = r"""
+import json, os, signal, sys, threading, time, zlib
+
+import numpy as np
+
+(host, port, rank, seats, peers, board_name, stats_path, window_s,
+ steps, obs_dim) = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    sys.argv[5], sys.argv[6], sys.argv[7], float(sys.argv[8]),
+    int(sys.argv[9]), int(sys.argv[10]))
+from distributed_reinforcement_learning_tpu.runtime.learner_tier import (
+    LearnerTier)
+
+tier = LearnerTier(rank, peers.split(","), sync="allreduce").start()
+
+import jax
+
+from distributed_reinforcement_learning_tpu.agents.apex import (
+    ApexAgent, ApexBatch, ApexConfig)
+from distributed_reinforcement_learning_tpu.data.fifo import TrajectoryQueue
+from distributed_reinforcement_learning_tpu.runtime import (
+    apex_runner, fleet, weight_board)
+from distributed_reinforcement_learning_tpu.runtime.transport import (
+    TransportServer)
+from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+agent = ApexAgent(ApexConfig(obs_shape=(obs_dim,), num_actions=2))
+wire_q = TrajectoryQueue(256)     # crc-verified, then forwarded
+learner_q = TrajectoryQueue(256)  # what the learner ingests
+weights = WeightStore()
+learner = apex_runner.ApexLearner(
+    agent, learner_q, weights, batch_size=16, replay_capacity=4096,
+    train_start_unrolls=2, rng=jax.random.PRNGKey(rank))
+tier.attach(learner)
+
+board = None
+
+def make_board():
+    # Publisher-only: create (or RECLAIM, creator-pid) the tier's
+    # shared board and replay the current snapshot into it.
+    global board
+    b = weight_board.WeightBoard.create(board_name, 4 << 20)
+    weights.attach_board(b)
+    board = b
+
+if tier.is_publisher():
+    make_board()
+tier.set_promote_cb(make_board)
+sup = fleet.FleetSupervisor(board_pid_fn=tier.publisher_pid).start()
+server = TransportServer(wire_q, weights, host=host, port=port,
+                         fleet=sup).start()
+
+stop = threading.Event()
+signal.signal(signal.SIGTERM, lambda *a: stop.set())
+verified = corrupt = 0
+vlock = threading.Lock()
+
+def verify_loop():
+    global verified, corrupt
+    while not stop.is_set():
+        item = wire_q.get(timeout=0.2)
+        if item is None:
+            continue
+        try:
+            state = np.ascontiguousarray(item["batch"].state)
+            ok = int(item["crc"]) == (zlib.crc32(state.tobytes())
+                                      & 0xFFFFFFFF)
+        except Exception:
+            ok = False
+        with vlock:
+            if ok:
+                verified += 1
+            else:
+                corrupt += 1
+        if ok:
+            learner_q.put(item["batch"], timeout=0.5)
+
+vt = threading.Thread(target=verify_loop, daemon=True)
+vt.start()
+
+# Warm/compile outside the drill: local prefill + one collective round
+# (both seats reach this barrier together).
+# Warm unrolls use the SAME unroll length as the drill actor's PUTs
+# (a mixed-length queue would fail the stacked dequeue) and round-trip
+# the CODEC so the replay store is seeded with the reconstructed
+# namedtuple class the wire path yields (replay_compare's precedent —
+# the SoA store's tree map is namedtuple-TYPE-strict).
+from distributed_reinforcement_learning_tpu.data import codec
+
+rng = np.random.RandomState(rank)
+for _ in range(4):
+    learner_q.put(codec.decode(codec.encode(ApexBatch(
+        state=rng.rand(steps, obs_dim).astype(np.float32),
+        next_state=rng.rand(steps, obs_dim).astype(np.float32),
+        previous_action=rng.randint(0, 2, steps).astype(np.int32),
+        action=rng.randint(0, 2, steps).astype(np.int32),
+        reward=rng.randn(steps).astype(np.float32),
+        done=(rng.rand(steps) < 0.1))), copy=True))
+while learner.ingest_many(timeout=0.0):
+    pass
+assert tier.await_peers(120.0), "tier startup barrier failed"
+assert learner.train() is not None
+print("SEAT_READY", os.getpid(), flush=True)
+
+deadline = time.monotonic() + window_s
+next_stats = 0.0
+while not stop.is_set() and time.monotonic() < deadline:
+    # BOUNDED drain: allreduce couples the seats' TRAIN cadences, so an
+    # unbounded ingest drain under a fast producer would starve this
+    # seat's rounds and stall the peer mid-round (the BSP livelock the
+    # tier docs call out) — cap unrolls per train call instead.
+    drained = False
+    for _ in range(8):
+        if not learner.ingest_many(timeout=0.005):
+            break
+        drained = True
+    if learner.train() is None and not drained:
+        time.sleep(0.01)
+    if time.monotonic() >= next_stats:
+        next_stats = time.monotonic() + 0.2
+        with vlock:
+            line = {"pid": os.getpid(), "rank": rank, "verified": verified,
+                    "corrupt": corrupt, "train_steps": learner.train_steps,
+                    "version": weights.version,
+                    "publisher": tier.is_publisher(),
+                    "solo": tier.collective.membership.solo,
+                    "wire_q": wire_q.size(), "learner_q": learner_q.size(),
+                    "rounds_ok": tier.collective.stat("rounds_ok")}
+        with open(stats_path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+stop.set()
+vt.join(timeout=2.0)
+learner.close()
+server.stop()
+sup.stop()
+tier.close()
+if board is not None:
+    board.close_writer()
+    board.close()
+    board.unlink()
+"""
+
+_SEAT_DRILL_ACTOR_CHILD = r"""
+import json, sys, time, zlib
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.runtime import fleet, weight_board
+from distributed_reinforcement_learning_tpu.runtime.transport import (
+    RemoteQueue, TransportClient)
+
+(host, port, rank, board_name, steps, obs_dim, secs) = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+    int(sys.argv[5]), int(sys.argv[6]), float(sys.argv[7]))
+ApexBatch = __import__("collections").namedtuple(
+    "ApexBatch", ["state", "next_state", "previous_action", "action",
+                  "reward", "done"])
+client = TransportClient(host, port)
+queue = RemoteQueue(client)
+bw = weight_board.attach_board_weights(board_name, client)
+hb = fleet.HeartbeatLoop(host, port, "actor", rank)
+hb.watch(bw)
+hb.start()
+client.connect_retries = 3
+rng = np.random.RandomState(rank)
+sent = i = 0
+version = -1
+version_changes = []  # (monotonic t, version) on every observed change
+deadline = time.monotonic() + secs
+while time.monotonic() < deadline:
+    state = rng.rand(steps, obs_dim).astype(np.float32)
+    tree = {"batch": ApexBatch(
+        state=state,
+        next_state=rng.rand(steps, obs_dim).astype(np.float32),
+        previous_action=rng.randint(0, 2, steps).astype(np.int32),
+        action=rng.randint(0, 2, steps).astype(np.int32),
+        reward=rng.randn(steps).astype(np.float32),
+        done=(rng.rand(steps) < 0.1)),
+        "crc": np.uint32(zlib.crc32(np.ascontiguousarray(state).tobytes())
+                         & 0xFFFFFFFF)}
+    try:
+        sent += bool(queue.put(tree))
+    except (ConnectionError, OSError):
+        time.sleep(0.2)  # seat outage: ride it out
+    i += 1
+    if i % 8 == 0 and bw is not None:
+        try:
+            got = bw.get_if_newer(version)
+            if got is not None:
+                version = got[1]
+                version_changes.append([round(time.monotonic(), 3), version])
+        except (ConnectionError, OSError):
+            pass
+    time.sleep(0.002)
+hb.stop()
+out = {"sent": sent, "version_changes": version_changes,
+       "board_stats": bw.snapshot_stats() if bw is not None else None,
+       "hb_stats": hb.snapshot_stats()}
+if bw is not None:
+    bw.close()
+client.close()
+print("DRILL_ACTOR=" + json.dumps(out), flush=True)
+"""
+
+
+def _chaos_seat_drill(secs: float = 22.0, steps: int = 8, obs_dim: int = 16,
+                      repromote_deadline_s: float = 15.0) -> dict:
+    """Kill ONE of N=2 learner seats mid-run (the PUBLISHER, seat 0 —
+    the hardest case) and measure, not assume:
+
+    - the SURVIVOR re-forms the collective solo and keeps training
+      (stats lines show solo=true + train_steps advancing);
+    - the survivor takes over PUBLICATION: promoted to publisher,
+      re-creates the shared board under the same name (creator-pid
+      reclaim), and the surviving seat's actor observes post-kill
+      version changes THROUGH its reattached board (version-identity
+      semantics — the ladder validates the new creator via the
+      heartbeat reply's board_pid);
+    - ZERO corrupted trajectories: every unroll that landed on either
+      seat crc32-verifies, across the kill.
+    """
+    import shutil
+    import tempfile
+
+    from distributed_reinforcement_learning_tpu.runtime.shm_ring import (
+        _attach_shm)
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # Probe pacing scaled to the drill window; ladder/collective shapes
+    # are the production ones.
+    env.setdefault("DRL_FLEET_HB_S", "0.25")
+    env.setdefault("DRL_REATTACH_BASE_S", "0.25")
+    env.setdefault("DRL_REATTACH_MAX_S", "1.0")
+    env.setdefault("DRL_LEARNER_WAIT_S", "2.0")
+    env.setdefault("DRL_FLEET_DEAD_S", "1.5")
+
+    tag = f"drlseat-{os.getpid()}-{os.urandom(3).hex()}"
+    board_name = f"{tag}-b"
+    tmp = tempfile.mkdtemp(prefix="bench_seatdrill_")
+    stats_paths = [os.path.join(tmp, f"seat{r}.jsonl") for r in range(2)]
+    ports = [_free_port() for _ in range(2)]
+    peers = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(2))
+    seats: list = []
+    actors: list = []
+    stderr_tails: dict = {}
+    watchers: list = []
+
+    def watch_stderr(name, proc):
+        tail = stderr_tails.setdefault(name, [])
+        for line in proc.stderr:
+            tail.append(line)
+            del tail[:-60]
+
+    def last_stats(r: int) -> dict:
+        per = _chaos_read_stats(stats_paths[r])
+        # newest line per pid; one pid per seat here (no respawn)
+        return per.popitem()[1] if per else {}
+
+    try:
+        for r in range(2):
+            proc = subprocess.Popen(
+                [sys.executable, "-c", _SEAT_DRILL_LEARNER_CHILD,
+                 "127.0.0.1", str(ports[r]), str(r), "2", peers, board_name,
+                 stats_paths[r], str(secs), str(steps), str(obs_dim)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            seats.append(proc)
+            t = threading.Thread(target=watch_stderr, args=(f"seat{r}", proc),
+                                 daemon=True)
+            t.start()
+            watchers.append(t)
+        for r, proc in enumerate(seats):
+            line = proc.stdout.readline()
+            if "SEAT_READY" not in line:
+                raise RuntimeError(
+                    f"drill seat {r} failed to start: "
+                    f"{''.join(stderr_tails.get(f'seat{r}', []))[-800:]}")
+        for r in range(2):
+            proc = subprocess.Popen(
+                [sys.executable, "-c", _SEAT_DRILL_ACTOR_CHILD, "127.0.0.1",
+                 str(ports[r]), str(r), board_name, str(steps), str(obs_dim),
+                 str(secs)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            actors.append(proc)
+            t = threading.Thread(target=watch_stderr,
+                                 args=(f"actor{r}", proc), daemon=True)
+            t.start()
+            watchers.append(t)
+        # Kill only after OBSERVED verified traffic on BOTH seats (a
+        # vacuous early kill would prove nothing).
+        t_gate = time.monotonic() + 90.0
+        while time.monotonic() < t_gate:
+            if all(last_stats(r).get("verified", 0) >= 10 for r in range(2)):
+                break
+            if any(p.poll() is not None for p in seats):
+                raise RuntimeError(
+                    "a drill seat died before the kill: "
+                    + "".join(stderr_tails.get("seat0", [])
+                              + stderr_tails.get("seat1", []))[-800:])
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("seat drill: no verified traffic within 90s")
+        pre_kill = last_stats(1)
+        t_kill = time.monotonic()
+        seats[0].kill()  # SIGKILL the PUBLISHER seat
+        seats[0].wait()
+        # Survivor must go solo + publisher + keep training, inside the
+        # re-promotion deadline.
+        reelected_s = None
+        while time.monotonic() - t_kill < repromote_deadline_s:
+            s = last_stats(1)
+            if (s.get("solo") and s.get("publisher")
+                    and s.get("train_steps", 0)
+                    > pre_kill.get("train_steps", 0)):
+                reelected_s = round(time.monotonic() - t_kill, 2)
+                break
+            time.sleep(0.1)
+        results = []
+        for r, proc in enumerate(actors):
+            proc.wait(timeout=secs + 120)
+            out_s = proc.stdout.read()
+            line = next((ln for ln in out_s.splitlines()
+                         if ln.startswith("DRILL_ACTOR=")), None)
+            results.append(json.loads(line.split("=", 1)[1])
+                           if line else None)
+        seats[1].wait(timeout=secs + 120)
+        final = last_stats(1)
+        dead_final = last_stats(0)
+        corrupt = (final.get("corrupt", 0) or 0) + \
+            (dead_final.get("corrupt", 0) or 0)
+        verified = (final.get("verified", 0) or 0) + \
+            (dead_final.get("verified", 0) or 0)
+        surv_actor = results[1] or {}
+        post_kill_versions = [
+            v for t, v in surv_actor.get("version_changes", ())
+            if t >= t_kill]
+        board_reattaches = (surv_actor.get("board_stats") or {}).get(
+            "reattaches", 0)
+        ok = bool(corrupt == 0 and verified > 0
+                  and reelected_s is not None
+                  and post_kill_versions
+                  and board_reattaches >= 1)
+        return {
+            "verified": verified, "corrupt": corrupt,
+            "reelected_s": reelected_s,
+            "repromote_deadline_s": repromote_deadline_s,
+            "survivor_solo": bool(final.get("solo")),
+            "survivor_publisher": bool(final.get("publisher")),
+            "survivor_train_steps": final.get("train_steps", 0),
+            "post_kill_versions_observed": len(post_kill_versions),
+            "survivor_board_reattaches": board_reattaches,
+            "actor_stats": results,
+            "pass": ok,
+        }
+    finally:
+        for proc in seats + actors:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in seats + actors:
+            try:
+                proc.wait(timeout=10)
+            except (subprocess.TimeoutExpired, OSError):
+                pass
+        for t in watchers:
+            t.join(timeout=3.0)
+        try:
+            seg = _attach_shm(board_name)
+            seg.unlink()
+            seg.close()
+        except (FileNotFoundError, OSError):
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def bench_r2d2_learn(B: int, iters: int) -> dict:
@@ -4160,11 +4859,25 @@ def main() -> None:
             extra["replay_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] replay_compare failed: {e}", file=sys.stderr)
 
+    # Multi-process learner-tier A/B (the auto-enable adjudication for
+    # the sharded learner tier, runtime/learner_tier.py): one seat vs
+    # two cooperating seats with the host-collective gradient exchange.
+    if os.environ.get("BENCH_LEARNER", "1") == "1" and _ok("learner_compare", 180):
+        try:
+            r = bench_learner_compare()
+            extra["learner_compare"] = r
+            if "verdict" in r:
+                extra["learner_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["learner_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] learner_compare failed: {e}", file=sys.stderr)
+
     # Multi-process chaos drill (the elastic-fleet adjudication,
     # runtime/fleet.py): kill+respawn the learner mid-window, assert
-    # zero corrupted trajectories, bounded throughput dip, and full
-    # re-promotion within the deadline.
-    if os.environ.get("BENCH_CHAOS", "1") == "1" and _ok("chaos_compare", 150):
+    # zero corrupted trajectories, bounded throughput dip, full
+    # re-promotion within the deadline, and the kill-one-of-N learner
+    # SEAT drill (runtime/learner_tier.py).
+    if os.environ.get("BENCH_CHAOS", "1") == "1" and _ok("chaos_compare", 200):
         try:
             r = bench_chaos_compare()
             extra["chaos_compare"] = r

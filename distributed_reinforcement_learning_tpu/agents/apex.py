@@ -74,6 +74,13 @@ class ApexAgent:
         self.act = jax.jit(self._act)
         self.td_error = jax.jit(self._td_error)
         self.learn = jax.jit(self._learn, donate_argnums=(0,))
+        # Split learn step for the sharded learner tier
+        # (runtime/learner_tier.py): grads computes, the host collective
+        # merges, apply_grads commits. apply_grads does NOT donate state:
+        # the tier may retry a round against the same state after a
+        # membership change aborts the first attempt.
+        self.grads = jax.jit(self._grads)
+        self.apply_grads = jax.jit(self._apply_grads)
         # K prioritized steps per dispatch; priorities come back stacked
         # [K, B] and land K-1 steps stale (common.scan_learn_weighted).
         self.learn_many = jax.jit(
@@ -134,18 +141,21 @@ class ApexAgent:
         loss = jnp.mean(td_sq * is_weight)
         return loss, dqn.td_error(tv, sav)
 
-    def _learn(self, state: common.TargetTrainState, batch: ApexBatch, is_weight,
-               axis_name: str | None = None):
+    def _grads(self, state: common.TargetTrainState, batch: ApexBatch, is_weight):
+        """Gradient half of the learn step: (grads, td, loss) with NO
+        update applied. The learner-tier allreduce (parallel/
+        collective.py) runs between this and `_apply_grads`, so a seat's
+        local-batch gradients can be mean-merged across the tier before
+        the (identical-everywhere) Adam update — the host-side analogue
+        of `_learn`'s in-graph pmean."""
         (loss, td), grads = jax.value_and_grad(self._loss, has_aux=True)(
             state.params, state.target_params, batch, is_weight
         )
-        if axis_name is not None:
-            # shard_map data-parallel callers (runtime/anakin_apex.py mesh
-            # mode): each device grads its local prioritized batch; the
-            # pmean makes the applied update the global-batch gradient and
-            # keeps the replicated params bit-identical across devices.
-            grads = jax.lax.pmean(grads, axis_name)
-            loss = jax.lax.pmean(loss, axis_name)
+        return grads, td, loss
+
+    def _apply_grads(self, state: common.TargetTrainState, grads, loss):
+        """Update half of the learn step: optimizer + param apply on
+        (possibly tier-merged) gradients; metrics match `_learn`'s."""
         updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
         params = jax.tree.map(lambda p, u: p + u, state.params, updates)
         new_state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
@@ -154,4 +164,17 @@ class ApexAgent:
             "grad_norm": common.global_norm(grads),
             "learning_rate": self._schedule(state.step),
         }
+        return new_state, metrics
+
+    def _learn(self, state: common.TargetTrainState, batch: ApexBatch, is_weight,
+               axis_name: str | None = None):
+        grads, td, loss = self._grads(state, batch, is_weight)
+        if axis_name is not None:
+            # shard_map data-parallel callers (runtime/anakin_apex.py mesh
+            # mode): each device grads its local prioritized batch; the
+            # pmean makes the applied update the global-batch gradient and
+            # keeps the replicated params bit-identical across devices.
+            grads = jax.lax.pmean(grads, axis_name)
+            loss = jax.lax.pmean(loss, axis_name)
+        new_state, metrics = self._apply_grads(state, grads, loss)
         return new_state, td, metrics

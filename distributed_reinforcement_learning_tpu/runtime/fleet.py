@@ -90,14 +90,29 @@ def heartbeat_interval_s() -> float:
 class ProbeContext:
     """What a heartbeat reply proved, handed to `reattach()` probes:
     the learner incarnation's pid (None when the learner predates the
-    fleet ops — probes then skip creator-pid validation) and whether
+    fleet ops — probes then skip creator-pid validation), the pid that
+    created the shared weight BOARD (the elected publisher seat in
+    learner-tier topologies; the learner itself otherwise), and whether
     this reply revealed a NEW incarnation (epoch change)."""
 
-    __slots__ = ("learner_pid", "restarted")
+    __slots__ = ("learner_pid", "board_pid", "restarted")
 
     def __init__(self, learner_pid: int | None = None,
-                 restarted: bool = False):
+                 restarted: bool = False,
+                 board_pid: int | None = None):
         self.learner_pid = learner_pid
+        # board_pid semantics: None (reply carried no field — outside
+        # tier mode the learner IS the board creator, inherit its pid);
+        # 0 (tier reply, publisher pid UNKNOWN right now — board probes
+        # must SKIP pid validation, not validate against this seat's
+        # own pid and burn the ladder on a healthy shared board);
+        # any other int = the board creator's pid.
+        if board_pid is None:
+            self.board_pid = learner_pid
+        elif board_pid == 0:
+            self.board_pid = None
+        else:
+            self.board_pid = board_pid
         self.restarted = restarted
 
 
@@ -241,8 +256,17 @@ class ShmReattachMixin:
         a frozen weight version — see the concrete classes). A creator
         pid disproven by the heartbeat reply flags the attachment; the
         owner thread demotes on its next use and the ladder re-attaches
-        the respawned learner's segment."""
-        expect = getattr(ctx, "learner_pid", None)
+        the respawned learner's segment.
+
+        Which pid a surface validates against is its `_pid_field`: rings
+        are created by the seat the member heartbeats (`learner_pid`),
+        but the learner-TIER shared weight board is created by the
+        elected PUBLISHER seat — the heartbeat reply carries that as
+        `board_pid` (falling back to the learner's own pid outside tier
+        mode, where learner == board creator), and BoardWeights
+        validates against it."""
+        expect = getattr(ctx, getattr(self, "_pid_field", "learner_pid"),
+                         None)
         with self._lock:
             attached = getattr(self, self._ref_attr)
         if attached is not None:
@@ -343,9 +367,17 @@ class FleetSupervisor:
     SUSPECT_AFTER = 3.0   # x heartbeat_s without a beat -> suspect
     DEAD_AFTER = 10.0     # x heartbeat_s without a beat -> dead (evicted)
 
-    def __init__(self, heartbeat_s: float | None = None):
+    def __init__(self, heartbeat_s: float | None = None,
+                 board_pid_fn=None):
         self.heartbeat_s = (heartbeat_interval_s()
                             if heartbeat_s is None else heartbeat_s)
+        # Learner-tier wiring (runtime/learner_tier.py): the pid that
+        # owns the SHARED weight board — the elected publisher seat —
+        # so members' board reattach probes validate against the right
+        # creator even when they heartbeat a non-publisher seat. None
+        # (the default) omits the field and ProbeContext falls back to
+        # the learner's own pid (learner == board creator).
+        self._board_pid_fn = board_pid_fn
         self.suspect_s = _env_float("DRL_FLEET_SUSPECT_S",
                                     self.SUSPECT_AFTER * self.heartbeat_s)
         self.dead_s = _env_float("DRL_FLEET_DEAD_S",
@@ -366,9 +398,27 @@ class FleetSupervisor:
 
     # -- transport surface (serve threads) ---------------------------------
 
-    def _reply_locked(self, known: bool = True) -> dict:
-        return {"epoch": self.epoch, "pid": self.pid,
-                "heartbeat_s": self.heartbeat_s, "known": known}
+    def _board_pid(self) -> int | None:
+        """Resolved OUTSIDE `_lock` (the tier's resolver takes its own
+        membership lock — no nesting under the roster lock). None =
+        not a tier (field omitted, members inherit the learner's pid);
+        0 = tier but the publisher's pid is UNKNOWN right now (members
+        must SKIP board pid validation — ProbeContext's contract)."""
+        if self._board_pid_fn is None:
+            return None
+        try:
+            pid = self._board_pid_fn()
+        except Exception:  # noqa: BLE001 — advisory field only
+            return 0
+        return int(pid) if pid else 0
+
+    def _reply_locked(self, known: bool = True,
+                      board_pid: int | None = None) -> dict:
+        reply = {"epoch": self.epoch, "pid": self.pid,
+                 "heartbeat_s": self.heartbeat_s, "known": known}
+        if board_pid is not None:
+            reply["board_pid"] = board_pid
+        return reply
 
     def _event_locked(self, kind: str, key: str, **extra) -> None:
         # Counters surface through register_supervisor_telemetry's
@@ -382,6 +432,7 @@ class FleetSupervisor:
         the transport json-encodes."""
         key = f"{info.get('role', '?')}-{info.get('rank', '?')}"
         pid = int(info.get("pid", 0))
+        board_pid = self._board_pid()  # resolved before the roster lock
         with self._lock:
             old = self._members.get(key)
             if old is None:
@@ -405,18 +456,19 @@ class FleetSupervisor:
                 "joined_at": time.time(),
             }
             self._event_locked(kind, key, pid=pid)
-            return self._reply_locked()
+            return self._reply_locked(board_pid=board_pid)
 
     def heartbeat(self, info: dict) -> dict:
         """OP_HEARTBEAT: refresh liveness. `known=False` in the reply
         tells an unregistered member (we restarted, or it was evicted)
         to re-register."""
         key = f"{info.get('role', '?')}-{info.get('rank', '?')}"
+        board_pid = self._board_pid()  # resolved before the roster lock
         with self._lock:
             self._counters["heartbeats"] += 1
             member = self._members.get(key)
             if member is None or member["pid"] != int(info.get("pid", 0)):
-                return self._reply_locked(known=False)
+                return self._reply_locked(known=False, board_pid=board_pid)
             if member["state"] == "suspect":
                 self._event_locked("recover", key)
             elif member["state"] == "dead":
@@ -427,7 +479,7 @@ class FleetSupervisor:
             member["state"] = "alive"
             member["last_seen"] = time.monotonic()
             member["version"] = int(info.get("version", member["version"]))
-            return self._reply_locked()
+            return self._reply_locked(board_pid=board_pid)
 
     # -- sweep (liveness + learner-side re-promotion) ----------------------
 
@@ -639,6 +691,7 @@ class HeartbeatLoop:
         registered = False
         epoch: str | None = None
         learner_pid: int | None = None
+        board_pid: int | None = None
         first = True
         while True:
             # Beat FIRST, then sleep: the supervisor should learn about
@@ -688,6 +741,7 @@ class HeartbeatLoop:
                     # actor at an orphan segment. None = probes skip
                     # pid validation (the documented pre-fleet mode).
                     learner_pid = None
+                    board_pid = None
                 else:
                     self._bump("heartbeat_failures")
                     registered = False
@@ -712,7 +766,15 @@ class HeartbeatLoop:
                     self._bump("learner_restarts")
                 epoch = new_epoch
                 learner_pid = int(reply.get("pid", 0)) or None
-            ctx = ProbeContext(learner_pid=learner_pid, restarted=restarted)
+                # Tier topologies: the shared board's creator is the
+                # elected PUBLISHER seat, not this member's learner.
+                # Absent field -> None (inherit learner pid); explicit
+                # 0 -> publisher unknown, ProbeContext skips board pid
+                # validation (never falls back to the member's seat).
+                raw_bp = reply.get("board_pid")
+                board_pid = None if raw_bp is None else int(raw_bp)
+            ctx = ProbeContext(learner_pid=learner_pid, restarted=restarted,
+                               board_pid=board_pid)
             with self._lock:
                 surfaces = list(self._surfaces)
             for surface in surfaces:

@@ -1719,6 +1719,22 @@ def run_role(
         rt = _dc.replace(rt, publish_interval=max(1, int(interval_env)))
 
     if mode == "learner":
+        # Sharded learner tier (runtime/learner_tier.py): when the
+        # launcher exported a seat identity, this process is ONE of N
+        # cooperating learner seats — own data plane on server_port +
+        # rank, own replay shards, gradients exchanged through the host
+        # collective, exactly one elected seat publishing to the shared
+        # weight plane. None = the pre-tier single learner, untouched.
+        from distributed_reinforcement_learning_tpu.runtime import learner_tier
+
+        tier = learner_tier.build_tier()
+        if tier is not None:
+            # Endpoint up FIRST (before the seconds of jit init below):
+            # peers' startup barriers probe it, and a seat that binds
+            # late eats into everyone's await_peers budget.
+            tier.start()
+            print(f"[learner] tier seat {tier.rank}/{tier.seats} "
+                  f"(sync={tier.sync}, publisher={tier.is_publisher()})")
         # Multi-chip / multi-host learner. parallel.distributed.initialize
         # joins the JAX runtime when DRL_COORDINATOR/DRL_NUM_PROCESSES are
         # set (no-op single-host); with N processes x M devices the learn
@@ -1730,6 +1746,11 @@ def run_role(
         from distributed_reinforcement_learning_tpu.parallel import distributed
 
         multihost = distributed.initialize()
+        if tier is not None and multihost:
+            raise ValueError(
+                "the learner tier (DRL_LEARNER_SEATS) and the jax.distributed "
+                "multihost learner (DRL_COORDINATOR) are different scale-out "
+                "planes — pick one")
         local_batch = rt.batch_size
         mesh = None
         devs = jax.devices() if multihost else jax.local_devices()
@@ -1803,13 +1824,18 @@ def run_role(
         # count). Failure leaves TCP-only weight pulls.
         board = None
         board_name = os.environ.get("DRL_SHM_WEIGHTS_CREATE", "").strip()
-        if board_name:
+        if board_name and (tier is None or tier.is_publisher()):
             from distributed_reinforcement_learning_tpu.runtime import weight_board
 
             board = weight_board.serve_board(board_name)
             if board is not None:
                 weights.attach_board(board)
                 print("[learner] shm weight board serving co-hosted actors")
+        # Non-publisher seats hold the SAME board name unused: on
+        # publisher death the tier's election fires the promote callback
+        # below, which re-creates the segment (creator-pid reclaim) and
+        # replays the current snapshot into it — actors reattach through
+        # their fleet ladders exactly as after a learner restart.
         # Sharded replay with ingest-time prioritization (data/
         # replay_service.py; gate + facade in runtime/replay_shard.py):
         # when enabled, every transport ingest thread decodes, scores,
@@ -1835,6 +1861,29 @@ def run_role(
             mesh=mesh,
             replay_service=replay_service,
         )
+        if tier is not None:
+            # Wrap the learn step with the collective exchange and arm
+            # the publication takeover: on promotion (lowest live rank
+            # after a death) this seat re-creates the shared board under
+            # the SAME name (creator-pid reclaim) and the WeightStore
+            # replays its current snapshot into it — surviving actors'
+            # reattach ladders find it exactly like a restarted learner.
+            tier.attach(learner)
+
+            def _on_promoted():
+                nonlocal board
+                if not board_name or board is not None:
+                    return
+                from distributed_reinforcement_learning_tpu.runtime import (
+                    weight_board)
+
+                board = weight_board.serve_board(board_name)
+                if board is not None:
+                    weights.attach_board(board)
+                    print("[learner] tier takeover: shm weight board "
+                          "re-created for co-hosted actors", flush=True)
+
+            tier.set_promote_cb(_on_promoted)
         ckpt = None
         if checkpoint_dir is not None:
             from distributed_reinforcement_learning_tpu.utils.checkpoint import Checkpointer
@@ -1859,15 +1908,32 @@ def run_role(
         from distributed_reinforcement_learning_tpu.runtime import fleet as fleet_mod
 
         supervisor = None
+        member_loop = None
         if fleet_mod.fleet_enabled():
-            supervisor = fleet_mod.FleetSupervisor().start()
+            # Every learner (and every tier SEAT) supervises its own
+            # members: the seat's actors register and heartbeat HERE,
+            # and in tier mode the reply's `board_pid` names the
+            # elected PUBLISHER seat so board reattach probes validate
+            # the shared segment against its real creator.
+            supervisor = fleet_mod.FleetSupervisor(
+                board_pid_fn=(tier.publisher_pid if tier is not None
+                              else None)).start()
             if replay_service is not None:
                 supervisor.watch(ingest_queue)  # ReplayIngestFifo revive
-        # Each multihost learner process serves its own data plane on
-        # server_port + process_index: globally unambiguous (actors pick
-        # a learner via DRL_LEARNER_INDEX) and collision-free when the
-        # processes share one machine (tests; single-host multi-chip).
-        serve_port = rt.server_port + (jax.process_index() if multihost else 0)
+            if tier is not None and tier.rank != 0:
+                # Learner seats are additionally first-class MEMBERS of
+                # seat 0's roster (role "learner", rank k): one roster
+                # shows the whole tier to obs_report and chaos drills.
+                member_loop = fleet_mod.start_member_loop(
+                    rt, "learner", tier.rank,
+                    version_fn=lambda: weights.version)
+        # Each multihost learner process (and each tier seat) serves its
+        # own data plane on server_port + index: globally unambiguous
+        # (actors pick a learner via DRL_LEARNER_INDEX) and
+        # collision-free when the processes share one machine.
+        serve_port = rt.server_port + (
+            tier.rank if tier is not None
+            else (jax.process_index() if multihost else 0))
         server = TransportServer(ingest_queue, weights, host="0.0.0.0",
                                  port=serve_port, inference=inference,
                                  fleet=supervisor).start()
@@ -1890,7 +1956,9 @@ def run_role(
         # depth, weight version — are polled per flush, never on the
         # learn thread's hot path.
         if maybe_configure("learner",
-                           jax.process_index() if multihost else 0, run_dir):
+                           tier.rank if tier is not None
+                           else (jax.process_index() if multihost else 0),
+                           run_dir):
             _OBS.sample("transport/queue_depth", queue.size)
             _OBS.sample("learner/weight_version", lambda: weights.version)
             if weights.sharded:
@@ -1941,9 +2009,20 @@ def run_role(
                 # Roster gauges + join/suspect/dead/rejoin counters —
                 # the obs_report "Fleet health" section.
                 fleet_mod.register_supervisor_telemetry(supervisor)
+            if member_loop is not None:
+                fleet_mod.register_member_telemetry(member_loop)
+            if tier is not None:
+                # Collective round latency + membership/publisher
+                # timeline — the obs_report "Learner tier" section.
+                learner_tier.register_telemetry(tier)
+        if tier is not None and not tier.await_peers():
+            print(f"[learner] tier seat {tier.rank}: some peers never "
+                  f"answered the startup barrier; starting degraded over "
+                  f"{tier.collective.membership.live()}", flush=True)
         print(f"[learner] serving on :{serve_port}; training {num_updates} updates")
         try:
-            _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval)
+            _learner_loop(algo, learner, num_updates, ckpt, checkpoint_interval,
+                          bounded_drain=tier is not None)
         finally:
             if ckpt is not None and learner.train_steps > 0:
                 learner.save_checkpoint(ckpt)
@@ -1963,6 +2042,10 @@ def run_role(
                 replay_service.close()  # stop the update-router thread
             if supervisor is not None:
                 supervisor.stop()
+            if member_loop is not None:
+                member_loop.stop()
+            if tier is not None:
+                tier.close()  # stop the sweep + the collective endpoint
             _OBS.close()  # final shard flush + trace terminator
         print(f"[learner] done: {learner.train_steps} updates")
     elif mode == "actor":
@@ -2008,7 +2091,11 @@ def run_role(
 
             # fallback: a demoted board keeps the shard-scoped TCP pull
             # path (and its own reattach ladder) instead of regressing
-            # to whole-blob transfers.
+            # to whole-blob transfers. (In learner-TIER topologies the
+            # shared board's creator is the elected PUBLISHER seat; the
+            # reattach ladder validates against the heartbeat reply's
+            # board_pid field — BoardWeights._pid_field — so no special
+            # casing here.)
             bw = weight_board.attach_board_weights(board_name, client,
                                                    fallback=tcp_weights)
             if bw is not None:
@@ -2191,6 +2278,7 @@ def _learner_loop(
     num_updates: int,
     ckpt=None,
     checkpoint_interval: int = 500,
+    bounded_drain: bool = False,
 ) -> None:
     last_saved = learner.train_steps
 
@@ -2200,6 +2288,14 @@ def _learner_loop(
             learner.save_checkpoint(ckpt)
             last_saved = learner.train_steps
 
+    # Learner-TIER seats (bounded_drain): the allreduce collective
+    # couples the seats' TRAIN cadences — an unbounded ingest drain
+    # under actors that produce faster than one unroll per drain slice
+    # would starve this seat's rounds and stall every peer mid-round
+    # (BSP livelock). Cap the unrolls consumed per train call; the solo
+    # learner keeps the historical drain-until-empty behavior.
+    drain_cap = 8 if bounded_drain else None
+
     if algo in ("impala", "ximpala"):  # same FIFO learner loop
         while learner.train_steps < num_updates:
             learner.step(timeout=5.0)
@@ -2207,8 +2303,13 @@ def _learner_loop(
     elif algo == "apex":
         while learner.train_steps < num_updates:
             drained = False
+            budget = drain_cap
             while learner.ingest_many(timeout=0.05):
                 drained = True
+                if budget is not None:
+                    budget -= 1
+                    if budget <= 0:
+                        break
             if learner.train() is None and not drained:
                 time.sleep(0.05)
             maybe_checkpoint()

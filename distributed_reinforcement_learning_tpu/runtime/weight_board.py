@@ -917,6 +917,12 @@ class BoardWeights(_LockedStatsMixin, ShmReattachMixin):
     # weights (version identity tolerates the rollback).
 
     _ref_attr = "_board"
+    # Validate against the BOARD creator's pid from the heartbeat
+    # reply, not the learner's own: in learner-tier topologies the
+    # shared board is created by the elected PUBLISHER seat while the
+    # member heartbeats its own seat (fleet.ProbeContext.board_pid
+    # falls back to learner_pid outside tier mode).
+    _pid_field = "board_pid"
 
     def _probe_attach(self):
         return attach_any(self._name)

@@ -132,7 +132,26 @@ def main() -> None:
                    help="algorithm (default: section-name prefix)")
     p.add_argument("--actors", type=int, default=2)
     p.add_argument("--learners", type=int, default=1,
-                   help=">1: multihost learner processes over one global mesh")
+                   help=">1: multiple learner processes — a SHARDED "
+                        "LEARNER TIER (independent seats exchanging "
+                        "gradients over the host collective, "
+                        "runtime/learner_tier.py) when seat mode "
+                        "resolves on (--learner_sync / DRL_LEARNER_SEATS "
+                        "/ the committed learner_verdict), else the "
+                        "jax.distributed multihost learners over one "
+                        "global mesh")
+    p.add_argument("--learner_sync", choices=("allreduce", "async",
+                                              "multihost"), default=None,
+                   help="with --learners N>1: force the learner-tier "
+                        "seat mode with this collective sync "
+                        "(DRL_LEARNER_SYNC — allreduce: lockstep ring "
+                        "gradient exchange; async: bounded-staleness "
+                        "parameter merging) or force the old multihost "
+                        "pjit group. Unset defers to DRL_LEARNER_SEATS, "
+                        "then the committed "
+                        "benchmarks/learner_verdict.json adjudication, "
+                        "then multihost; see docs/performance.md "
+                        "'Learner tier'")
     p.add_argument("--updates", type=int, default=500)
     p.add_argument("--run_dir", default=None,
                    help="run directory: the learner's metrics.jsonl plus "
@@ -216,13 +235,57 @@ def main() -> None:
     respawn = args.respawn or ("chaos" if args.chaos else "off")
     if args.chaos and respawn == "off":
         p.error("--chaos needs a respawn policy; drop --respawn off")
+
+    # Learner-tier seat mode (runtime/learner_tier.py): with
+    # --learners N>1, decide between N cooperating SEATS over the host
+    # collective and the old jax.distributed multihost pjit group. The
+    # gate is INLINED (canonical resolution: learner_tier.seat_count /
+    # sync_mode) for the same import-cost reason as shm_gate below.
+    def learner_tier_sync() -> str | None:
+        if args.learners <= 1 or args.learner_sync == "multihost":
+            return None
+        env_sync = os.environ.get("DRL_LEARNER_SYNC", "").strip().lower()
+        if args.learner_sync in ("allreduce", "async"):
+            return args.learner_sync
+        env_n = os.environ.get("DRL_LEARNER_SEATS", "").strip()
+        if env_n:
+            try:
+                n = int(env_n)
+            except ValueError:
+                p.error(f"DRL_LEARNER_SEATS must be an integer, got {env_n!r}")
+            return (env_sync or "allreduce") if n >= 2 else None
+        import json
+
+        try:
+            with open(os.path.join(REPO, "benchmarks",
+                                   "learner_verdict.json")) as f:
+                verdict = json.load(f)
+            if verdict.get("auto_enable", False):
+                return env_sync or str(verdict.get("sync", "allreduce"))
+        except (OSError, ValueError):
+            pass
+        return None
+
+    tier_sync = learner_tier_sync()
+    if tier_sync == "allreduce" and algo != "apex":
+        # tier.attach would reject this anyway — but only after every
+        # seat paid seconds of jit/agent init. The algo and the sync
+        # are both known right here.
+        p.error(f"learner-tier allreduce needs the apex family's split "
+                f"learn step (agent.grads/apply_grads); use "
+                f"--learner_sync async for {algo!r}")
     if respawn != "off" and args.learners > 1:
         # jax.distributed offers no single-process rejoin of a pjit
-        # group — the whole learner set restarts together (the
-        # test_multihost restart pattern), which this per-role loop
-        # cannot express.
-        p.error("--respawn needs --learners 1 (a pjit group can only "
-                "restart wholesale)")
+        # group, and tier SEATS cannot rejoin a live collective either
+        # (dead ranks stay dead — params diverged; see
+        # parallel/collective.py): a respawned ex-publisher would
+        # elect itself publisher against the promoted survivor and
+        # race it for the shared board name. Either way the learner
+        # set restarts WHOLESALE, which this per-role loop cannot
+        # express (ROADMAP lists live seat re-admission as the
+        # follow-on).
+        p.error("--respawn needs --learners 1 (a pjit group or a "
+                "learner tier can only restart wholesale)")
     launcher = os.path.join(REPO, ALGO_LAUNCHER[algo])
 
     class Role:
@@ -348,8 +411,18 @@ def main() -> None:
     # the committed weights_compare adjudication on x86-64 only (the
     # gate is INLINED for the same import-cost reason as above).
     if shm_gate("DRL_SHM_WEIGHTS", "weights_verdict.json"):
-        board_names = {pid: f"drlwboard-{tag}-{pid}"
-                       for pid in range(args.learners)}
+        if tier_sync is not None:
+            # Seat mode: ONE shared board name for the whole tier —
+            # only the elected publisher seat creates/writes it
+            # (run_role gates on tier.is_publisher(); a takeover
+            # re-creates the same name via creator-pid reclaim), and
+            # every actor attaches the same segment regardless of
+            # which seat's data plane it feeds.
+            shared = f"drlwboard-{tag}-tier"
+            board_names = {pid: shared for pid in range(args.learners)}
+        else:
+            board_names = {pid: f"drlwboard-{tag}-{pid}"
+                           for pid in range(args.learners)}
         print(f"[cluster] shm weight board(s) enabled for {args.actors} "
               f"co-hosted actor(s)", file=sys.stderr)
 
@@ -387,13 +460,26 @@ def main() -> None:
         p.error("--inference_replicas needs remote-act actors; "
                 "pass --remote_act too")
     learners = []
-    if args.learners > 1:
+    if args.learners > 1 and tier_sync is None:
         env["DRL_COORDINATOR"] = f"localhost:{_free_port()}"
         env["DRL_NUM_PROCESSES"] = str(args.learners)
+    coll_peers = ""
+    if tier_sync is not None:
+        # One collective endpoint per seat; the roster (index = rank)
+        # is exported to every seat so the ring and the probes agree.
+        coll_peers = ",".join(f"127.0.0.1:{_free_port()}"
+                              for _ in range(args.learners))
+        print(f"[cluster] learner tier: {args.learners} seat(s), "
+              f"sync={tier_sync}", file=sys.stderr)
     for pid in range(args.learners):
         lenv = {**env}
-        if args.learners > 1:
+        if args.learners > 1 and tier_sync is None:
             lenv["DRL_PROCESS_ID"] = str(pid)
+        if tier_sync is not None:
+            lenv["DRL_LEARNER_SEATS"] = str(args.learners)
+            lenv["DRL_LEARNER_RANK"] = str(pid)
+            lenv["DRL_LEARNER_PEERS"] = coll_peers
+            lenv["DRL_LEARNER_SYNC"] = tier_sync
         mine = [ring_names[t] for t in sorted(ring_names)
                 if t % args.learners == pid]
         if mine:

@@ -546,6 +546,54 @@ def build_report(tdir: str, merge: bool = True) -> str:
         out("-- Fleet health (supervisor + heartbeats) --")
         lines.extend(fleet_lines)
 
+    # Learner tier (runtime/learner_tier.py): per-seat train rate +
+    # collective round latency, membership/publisher timeline, merge
+    # accounting. Section only appears when seats ran with the tier.
+    tier_lines: list[str] = []
+    for shard in shards:
+        pub = shard.gauge_stats("tier/publisher")
+        if pub is None:
+            continue
+        rates = shard.counter_rates()
+
+        def total(key, rates=rates):
+            return rates.get(key, {}).get("total", 0)
+
+        live = shard.gauge_stats("tier/live_seats")
+        trained = rates.get("learner/train_steps", {})
+        tier_lines.append(
+            f"  {shard_label(shard)}: publisher "
+            f"{'YES' if pub['last'] else 'no'} (was "
+            f"{'ever' if pub['max'] else 'never'})  live seats "
+            f"{live['last'] if live else 0:.0f} (min "
+            f"{live['min'] if live else 0:.0f})  train "
+            f"{trained.get('total', 0):.0f} steps "
+            f"({trained.get('rate', 0):.1f}/s)")
+        tier_lines.append(
+            f"    publisher timeline "
+            f"[{sparkline(shard.series.get('tier/publisher', []))}]")
+        rms = shard.gauge_stats("tier/round_ms")
+        if rms is not None:
+            tier_lines.append(
+                f"    collective round mean {rms['mean']:.2f}ms  max "
+                f"{rms['max']:.2f}ms  ({rms['n']} samples)")
+        tier_lines.append(
+            f"    rounds {total('tier/rounds_ok'):.0f} ok / "
+            f"{total('tier/round_retries'):.0f} retried / "
+            f"{total('tier/round_giveups'):.0f} solo-fallback  "
+            f"peer deaths {total('tier/peer_deaths'):.0f}  "
+            f"promotions {total('tier/promotions'):.0f}")
+        merges = total("tier/merges_applied")
+        if merges or total("tier/merge_rounds"):
+            tier_lines.append(
+                f"    async merges {merges:.0f} applied / "
+                f"{total('tier/merges_skipped_stale'):.0f} dropped stale "
+                f"({total('tier/merge_rounds'):.0f} rounds)")
+    if tier_lines:
+        out("")
+        out("-- Learner tier (seats + collective) --")
+        lines.extend(tier_lines)
+
     # Inference serving (runtime/inference.py + runtime/serving.py):
     # per-service act throughput, batch occupancy, admission rejects and
     # queue wait; per-actor replica-selection counters. Section only

@@ -1,0 +1,63 @@
+"""Two-process e2e worker for tests/test_learner_tier.py.
+
+One learner SEAT of a 2-seat collective: joins the roster, runs a
+fixed number of allreduce rounds over a seeded vector, and prints the
+merged results (crc + first elements) for the parent to compare across
+seats. Mode "die" exits hard after the first round — the surviving
+seat must re-form solo and finish its remaining rounds on local
+vectors (the demote-to-solo path) instead of wedging.
+
+Usage: learner_seat_worker.py <rank> <peers_csv> <rounds> <mode>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import zlib
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.runtime.learner_tier import (
+    LearnerTier,
+)
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    peers = sys.argv[2].split(",")
+    rounds = int(sys.argv[3])
+    mode = sys.argv[4]
+
+    tier = LearnerTier(rank, peers, sync="allreduce",
+                       probe_interval_s=0.25, dead_after_s=1.0)
+    tier.collective.wait_s = 5.0
+    tier.start()
+    assert tier.await_peers(30.0), "startup barrier failed"
+
+    rng = np.random.RandomState(100 + rank)
+    out = []
+    for i in range(rounds):
+        vec = rng.rand(257).astype(np.float32) * (rank + 1)
+        merged = tier._merged_rounds(vec)
+        out.append({
+            "round": i,
+            "crc": zlib.crc32(merged.tobytes()) & 0xFFFFFFFF,
+            "head": [float(x) for x in merged[:3]],
+            "solo": tier.collective.membership.solo,
+        })
+        if mode == "die" and rank == 0 and i == 0:
+            # Hard exit mid-tier: no close(), no goodbye — the peer
+            # must detect the death and re-form solo.
+            os._exit(17)
+    print("SEAT_OUT=" + json.dumps({
+        "rank": rank, "rounds": out,
+        "publisher": tier.is_publisher(),
+        "stats": tier.snapshot_stats(),
+        "coll": tier.collective.snapshot_stats()}), flush=True)
+    tier.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,7 @@ _TRIMMED = {
     "BENCH_TRANSPORT": "0", "BENCH_CODEC": "0", "BENCH_WEIGHTS": "0",
     "BENCH_WEIGHTS_SHARD": "0", "BENCH_REPLAY": "0", "BENCH_INFER": "0",
     "BENCH_CHAOS": "0", "BENCH_ACTOR": "0",
+    "BENCH_LEARNER": "0", "BENCH_SEAT_DRILL": "0",
 }
 
 
@@ -356,6 +357,63 @@ class TestReplayCompare:
         assert shard_count() == 0
 
 
+class TestLearnerCompare:
+    """bench_learner_compare: the one-seat vs N-seat learner-tier A/B
+    whose verdict gates runtime/learner_tier's auto-enable. Driven
+    directly at a tiny config (CPU, real seat child processes + real
+    collective rounds) — the committed adjudication numbers live in
+    benchmarks/learner_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        bench = _load_bench()
+        r = bench.bench_learner_compare(seats=2, sync="allreduce",
+                                        window_s=4.0, unrolls_per_put=4,
+                                        steps=8, obs_dim=12, reps=1)
+        for side in ("solo", "tier"):
+            assert r[side]["frames_per_s"] > 0, r
+            assert r[side]["train_steps_in_window"] > 0, r
+        assert r["solo"]["seats"] == 1 and r["tier"]["seats"] == 2
+        assert len(r["tier"]["per_seat_frames_per_s"]) == 2
+        # The tier variant really exchanged gradients (the section
+        # fails itself otherwise — two independent learners would be a
+        # mislabeled ratio).
+        assert r["tier"]["rounds_ok"] > 0
+        assert r["tier_vs_solo"] > 0
+        assert r["auto_enable"] == (r["tier_vs_solo"] >= 1.2)
+        assert r["verdict"].startswith("learner tier ") and (
+            "auto-on" in r["verdict"] or "opt-in" in r["verdict"])
+
+    def test_compact_line_carries_learner_verdict_key(self):
+        bench = _load_bench()
+        assert "learner_verdict" in bench._COMPACT_KEYS
+        # The trimmed env the failure-mode subprocess tests run under
+        # must gate this (multi-process) section off — and the seat
+        # drill with it.
+        assert _TRIMMED["BENCH_LEARNER"] == "0"
+        assert _TRIMMED["BENCH_SEAT_DRILL"] == "0"
+
+    def test_committed_verdict_file_consistent(self, monkeypatch):
+        """The committed adjudication parses, and seat_count() follows
+        it when DRL_LEARNER_SEATS is unset (env force > committed
+        verdict > off)."""
+        monkeypatch.delenv("DRL_LEARNER_SEATS", raising=False)
+        verdict = json.loads(
+            (REPO / "benchmarks" / "learner_verdict.json").read_text())
+        assert isinstance(verdict["auto_enable"], bool)
+        assert verdict["ratio_runs"] and verdict["bar"] == 1.2
+        assert verdict["sync"] in ("allreduce", "async")
+        from distributed_reinforcement_learning_tpu.runtime.learner_tier import (
+            seat_count, tier_auto_enabled)
+
+        assert tier_auto_enabled() is verdict["auto_enable"]
+        assert (seat_count() > 0) is verdict["auto_enable"]
+        monkeypatch.setenv("DRL_LEARNER_SEATS", "3")
+        assert seat_count() == 3  # env force wins over the verdict
+        monkeypatch.setenv("DRL_LEARNER_SEATS", "0")
+        assert seat_count() == 0
+
+
 class TestInferenceCompare:
     """bench_inference_compare: the learner-hosted vs replica-tier act
     client-swarm A/B whose verdict gates runtime/serving's replica
@@ -481,8 +539,11 @@ class TestChaosCompare:
     at a tiny config; the committed adjudication lives in
     benchmarks/chaos_verdict.json."""
 
-    def test_section_shape_and_verdict(self):
+    def test_section_shape_and_verdict(self, monkeypatch):
         bench = _load_bench()
+        # The learner-seat drill has its own test below — running it
+        # here too would double the (multi-process) cost.
+        monkeypatch.setenv("BENCH_SEAT_DRILL", "0")
         # Window sized for a loaded 2-core host: the kill is gated on
         # observed verified traffic (so a slow-starting actor child
         # cannot make the drill vacuous) and lands kill_at seconds
@@ -515,18 +576,43 @@ class TestChaosCompare:
         # must gate this (multi-process) section off.
         assert _TRIMMED["BENCH_CHAOS"] == "0"
 
+    def test_seat_drill_kill_one_of_two_learners(self):
+        """The kill-ONE-OF-N-learners drill (runtime/learner_tier.py):
+        SIGKILL the publisher seat of a real 2-seat tier mid-run — the
+        survivor re-forms the collective solo, takes over publication
+        (board re-created under the same name; its actor observes
+        post-kill versions through the reattached board), and every
+        landed trajectory still crc-verifies."""
+        bench = _load_bench()
+        r = bench._chaos_seat_drill(secs=16.0, steps=4, obs_dim=8,
+                                    repromote_deadline_s=12.0)
+        assert r["corrupt"] == 0 and r["verified"] > 0, r
+        assert r["survivor_solo"] and r["survivor_publisher"], r
+        assert r["reelected_s"] is not None \
+            and r["reelected_s"] <= r["repromote_deadline_s"], r
+        assert r["post_kill_versions_observed"] >= 1, r
+        assert r["survivor_board_reattaches"] >= 1, r
+        assert r["pass"] is True
+
     def test_committed_verdict_file_consistent(self):
         """The committed chaos adjudication parses and is internally
-        consistent (pass flag == its three measured sub-verdicts)."""
+        consistent (pass flag == its measured sub-verdicts, the
+        learner-seat drill included)."""
         verdict = json.loads(
             (REPO / "benchmarks" / "chaos_verdict.json").read_text())
         assert isinstance(verdict["chaos_pass"], bool)
         assert verdict["chaos_pass"] == (
             verdict["zero_corruption"]
             and verdict["dip_ratio"] >= verdict["dip_bound"]
-            and verdict["repromoted_in_deadline"])
+            and verdict["repromoted_in_deadline"]
+            and verdict.get("seat_drill_pass", True))
         assert verdict["chaos"]["incarnations"] == 2
         assert verdict["repromote_deadline_s"] > 0
+        # The committed verdict must carry the kill-one-of-N drill.
+        assert verdict["seat_drill_pass"] is True
+        drill = verdict["seat_drill"]
+        assert drill["corrupt"] == 0
+        assert drill["survivor_publisher"] and drill["survivor_solo"]
 
 
 class TestDeviceChunkGate:

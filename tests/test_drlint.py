@@ -673,6 +673,9 @@ class TestCliAndTreeGate:
             "data/replay_service.py": 2,  # ReplayShard + ShardedReplayService
             "runtime/replay_shard.py": 1,  # ReplayIngestFifo
             "data/native.py": 1,
+            "parallel/collective.py": 3,  # Membership + endpoint
+            #                               + HostCollective
+            "runtime/learner_tier.py": 1,  # LearnerTier
             "runtime/fleet.py": 3,       # RetryLadder + FleetSupervisor
             #                              + HeartbeatLoop
             "runtime/actor_pipeline.py": 2,  # UnrollPublisher +
