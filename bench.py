@@ -74,7 +74,8 @@ _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
                  "watchdog", "chunk_regressions", "transport_verdict",
                  "codec_verdict", "weights_verdict", "weights_shard_verdict",
                  "replay_verdict", "inference_verdict", "chaos_verdict",
-                 "actor_pipeline_verdict", "learner_verdict")
+                 "actor_pipeline_verdict", "learner_verdict",
+                 "device_path_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -1056,6 +1057,42 @@ def bench_stage_budget(cfg, B: int, learn_fps: float | None) -> dict:
                   "frames_per_s": round(B * T / h2d_s, 1),
                   "timing": h2d_stats}
 
+    # h2d_overlap: effective H2D with double buffering — the device
+    # sample path's copy discipline (data/device_path.py): the
+    # device_put for batch k+1 is issued while batch k's compute is in
+    # flight, so the marginal per-batch time prices only the NON-hidden
+    # part of the copy. overlap_vs_serial > 1 means the link really
+    # does overlap with compute on this host (vs the serial h2d row's
+    # committed 0.87 GB/s); ~1 means copies serialize anyway (one
+    # memory system — the 2-core CPU answer).
+    def h2d_overlap_window(n):
+        t0 = time.perf_counter()
+        acc = 0.0
+        state = batch_np.state.reshape(-1)
+        h2d_ctr[0] += 1
+        state[h2d_ctr[0] % 4096] = h2d_ctr[0] % 251
+        dev = jax.device_put(batch_np)
+        for _ in range(n):
+            h2d_ctr[0] += 1
+            state[h2d_ctr[0] % 4096] = h2d_ctr[0] % 251
+            nxt = jax.device_put(batch_np)  # k+1's copy, k's compute below
+            acc = acc + reduce_fn(dev)
+            dev = nxt
+        float(acc)
+        return time.perf_counter() - t0
+
+    ov_s, ov_stats = _marginal_step_s(h2d_overlap_window, 6, samples=3)
+    out["h2d_overlap"] = {
+        "per_batch_ms": round(1e3 * ov_s, 2),
+        "gb_per_s_effective": round(total_bytes / ov_s / 1e9, 2),
+        "frames_per_s": round(B * T / ov_s, 1),
+        "overlap_vs_serial": round(h2d_s / ov_s, 2),
+        "timing": ov_stats,
+        "note": ("double-buffered: device_put(k+1) issued while "
+                 "compute(k) is in flight — the effective feed rate the "
+                 "fused device sample path sustains"),
+    }
+
     if learn_fps is not None:
         out["learn"] = {"frames_per_s": learn_fps}
 
@@ -1086,7 +1123,8 @@ def bench_stage_budget(cfg, B: int, learn_fps: float | None) -> dict:
                  "drain bounds publishes/s, amortized by publish_interval"),
     }
 
-    for k in ("encode", "shm_put", "gather", "tcp_put", "h2d", "learn"):
+    for k in ("encode", "shm_put", "gather", "tcp_put", "h2d",
+              "h2d_overlap", "learn"):
         if k in out and "frames_per_s" in out[k]:
             out[k]["meets_target"] = out[k]["frames_per_s"] >= target
 
@@ -1118,7 +1156,8 @@ def bench_stage_budget(cfg, B: int, learn_fps: float | None) -> dict:
 
     print(f"[bench] stage budget: " + ", ".join(
         f"{k}={out[k]['frames_per_s']:,.0f}f/s"
-        for k in ("encode", "shm_put", "gather", "tcp_put", "h2d", "learn")
+        for k in ("encode", "shm_put", "gather", "tcp_put", "h2d",
+                  "h2d_overlap", "learn")
         if k in out and "frames_per_s" in out[k])
         + f"; attainable={rates[binding]:,.0f}f/s (binding: {binding})",
         file=sys.stderr)
@@ -2264,6 +2303,188 @@ def bench_replay_compare(n_unrolls: int = 192, unrolls_per_put: int = 8,
     print(f"[bench] replay_compare: mono {best_m['frames_per_s']:,.0f} "
           f"f/s vs sharded {best_s['frames_per_s']:,.0f} f/s "
           f"-> {out['verdict']}", file=sys.stderr)
+    return out
+
+
+def bench_device_path_compare(window_s: float = 6.0, unrolls_per_put: int = 8,
+                              steps: int = 32, obs_dim: int = 64,
+                              num_shards: int = 2, k: int | None = None,
+                              batch_size: int = 32, reps: int = 1) -> dict:
+    """Two-process A/B of the fused DEVICE SAMPLE PATH (data/
+    device_path.py) against the host sample loop it replaces — both
+    variants run the AUTO-ENABLED sharded replay service (PR 6), so the
+    only delta is where the per-update gather -> stack -> H2D -> D2H
+    round-trip runs: on the learn thread (host path,
+    `prioritized_train_call`) or on the path's background thread with
+    double-buffered H2D and ONE D2H per K (`device_train_call`). A
+    duration-mode child PUTs identical unrolls over loopback TCP into
+    the real transport server for the whole window (shard ingest
+    contends with the gather exactly as deployed), and the measured
+    number is LEARNER train throughput — train steps x batch transitions
+    per second — because removed learn-thread host work is precisely
+    what this path claims.
+
+    The verdict follows the repo's adjudication bar (Pallas-LSTM rule):
+    the path ships enabled-by-default ONLY at >= 1.2x the host loop's
+    train throughput; the committed `benchmarks/device_path_verdict.json`
+    carries the decision `data/device_path.device_path_enabled` consults.
+    """
+    import jax
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.agents.apex import (
+        ApexAgent, ApexConfig)
+    from distributed_reinforcement_learning_tpu.data import codec
+    from distributed_reinforcement_learning_tpu.data.fifo import blob_ingest
+    from distributed_reinforcement_learning_tpu.data.replay_service import (
+        ShardedReplayService)
+    from distributed_reinforcement_learning_tpu.runtime import apex_runner
+    from distributed_reinforcement_learning_tpu.runtime.replay_shard import (
+        ReplayIngestFifo)
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        TransportServer, _make_queue)
+    from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+    if k is None:
+        k = int(os.environ.get("BENCH_DEVPATH_K", "4"))
+    k = max(1, k)
+    acfg = ApexConfig(obs_shape=(obs_dim,), num_actions=2)
+    agent = ApexAgent(acfg)  # ONE jit cache shared by both variants
+    from collections import namedtuple
+
+    cls = namedtuple("ApexBatch", ["state", "next_state", "previous_action",
+                                   "action", "reward", "done"])
+    wrng = np.random.RandomState(0)
+
+    def warm_blobs(count):
+        return [bytes(codec.encode(cls(
+            state=wrng.rand(steps, obs_dim).astype(np.float32),
+            next_state=wrng.rand(steps, obs_dim).astype(np.float32),
+            previous_action=wrng.randint(0, 2, steps).astype(np.int32),
+            action=wrng.randint(0, 2, steps).astype(np.int32),
+            reward=wrng.randn(steps).astype(np.float32),
+            done=wrng.rand(steps) < 0.1))) for _ in range(count)]
+
+    def run_variant(device_path: bool) -> dict:
+        queue = _make_queue(64)
+        svc = ShardedReplayService(num_shards, 16384, mode="transition",
+                                   scorer="max", seed=0)
+        ingest_q = ReplayIngestFifo(svc, queue)
+        weights = WeightStore()
+        learner = apex_runner.ApexLearner(
+            agent, queue, weights, batch_size=batch_size,
+            replay_capacity=16384, rng=jax.random.PRNGKey(0),
+            replay_service=svc, updates_per_call=k)
+        # Explicit per-variant gate (no env mutation): the mixin
+        # resolves device_path_force before DRL_DEVICE_PATH/verdict.
+        learner.device_path_force = device_path
+        proc = server = None
+        train_ms: list[float] = []
+        try:
+            prepare, put = blob_ingest(ingest_q)
+            for blob in warm_blobs(14):
+                put(prepare(blob))
+            # Warm + compile OUTSIDE the timed window (learn/learn_many
+            # + the path's first gather/H2D round on the device variant).
+            warm_deadline = time.monotonic() + 120.0
+            while learner.train() is None:
+                if time.monotonic() > warm_deadline:
+                    raise RuntimeError("warm train step never landed")
+                time.sleep(0.002)
+            server = TransportServer(ingest_q, weights, host="127.0.0.1",
+                                     port=_free_port()).start()
+            proc = subprocess.Popen(
+                [sys.executable, "-c", _LEARNER_PUT_CHILD, "127.0.0.1",
+                 str(server.port), str(window_s + 10.0),
+                 str(unrolls_per_put), str(steps), str(obs_dim)],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                text=True)
+            base = svc.ingested_blobs()
+            while svc.ingested_blobs() == base:  # window starts under load
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"feeder died: {proc.stderr.read()[-500:]}")
+                time.sleep(0.001)
+            t0 = time.perf_counter()
+            steps0 = learner.train_steps
+            ing0 = svc.ingested_blobs()
+            deadline = t0 + window_s
+            while time.perf_counter() < deadline:
+                c0 = time.perf_counter()
+                m = learner.train()
+                train_ms.append((time.perf_counter() - c0) * 1e3)
+                if m is None:
+                    time.sleep(0.001)
+            elapsed = time.perf_counter() - t0
+            steps_done = learner.train_steps - steps0
+            ingested = svc.ingested_blobs() - ing0
+            if ingested == 0:
+                raise RuntimeError("feeder landed zero unrolls in the "
+                                   "window — not an under-load "
+                                   "measurement")
+            out = {"train_steps_in_window": steps_done,
+                   "train_frames_per_s": round(
+                       steps_done * batch_size / elapsed, 1),
+                   "train_call_ms_p50": _pctl(sorted(train_ms), 0.50),
+                   "train_call_ms_p99": _pctl(sorted(train_ms), 0.99),
+                   "ingested_unrolls_in_window": ingested}
+            if device_path:
+                dp = learner._device_path
+                if dp is None or learner._device_path_demoted:
+                    # A demoted variant measured the HOST path under a
+                    # devpath label — fail it instead of recording a
+                    # mislabeled ratio (the weights_compare rule).
+                    raise RuntimeError("device path never activated or "
+                                       "demoted mid-window")
+                out["devpath"] = dp.stats()
+            return out
+        finally:
+            # Error exits must not leak threads into the later bench
+            # sections (the gather thread + 2 serve threads + router
+            # would contend for the 2-core host and skew their ratios).
+            if proc is not None:
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait(timeout=10)
+            if server is not None:
+                server.stop()
+            learner.close()
+            svc.close()
+            queue.close()
+
+    out: dict = {
+        "k": k, "batch_size": batch_size, "window_s": window_s,
+        "shards": num_shards,
+        "note": ("two-process A/B: a duration-mode child PUTs identical "
+                 "unrolls over loopback TCP into the sharded ingest for "
+                 "the whole window while the learner trains; host = "
+                 "learn-thread gather+stack+H2D+D2H per call "
+                 "(prioritized_train_call), device = background gather "
+                 "thread + double-buffered H2D + one scanned learn_many "
+                 "+ one D2H per K (data/device_path.py); metric is "
+                 "train transitions/s")}
+    best_h = best_d = None
+    for _ in range(reps):
+        h = run_variant(device_path=False)
+        d = run_variant(device_path=True)
+        if best_h is None or h["train_frames_per_s"] > best_h["train_frames_per_s"]:
+            best_h = h
+        if best_d is None or d["train_frames_per_s"] > best_d["train_frames_per_s"]:
+            best_d = d
+    out["host"] = best_h
+    out["device"] = best_d
+    ratio = (best_d["train_frames_per_s"]
+             / max(best_h["train_frames_per_s"], 1e-9))
+    out["device_vs_host"] = round(ratio, 2)
+    out["auto_enable"] = ratio >= 1.2  # the repo's adjudication bar
+    out["verdict"] = (f"device sample path {ratio:.2f}x host train "
+                      f"throughput at K={k}: "
+                      + ("auto-on" if out["auto_enable"] else "opt-in"))
+    print(f"[bench] device_path_compare: host "
+          f"{best_h['train_frames_per_s']:,.0f} tr/s vs device "
+          f"{best_d['train_frames_per_s']:,.0f} tr/s -> {out['verdict']}",
+          file=sys.stderr)
     return out
 
 
@@ -4858,6 +5079,20 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["replay_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] replay_compare failed: {e}", file=sys.stderr)
+
+    # Two-process host-vs-device sample-path A/B (the auto-enable
+    # adjudication for the fused device-resident sample path,
+    # data/device_path.py).
+    if os.environ.get("BENCH_DEVICE_PATH", "1") == "1" and \
+            _ok("device_path_compare", 150):
+        try:
+            r = bench_device_path_compare()
+            extra["device_path_compare"] = r
+            if "verdict" in r:
+                extra["device_path_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["device_path_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] device_path_compare failed: {e}", file=sys.stderr)
 
     # Multi-process learner-tier A/B (the auto-enable adjudication for
     # the sharded learner tier, runtime/learner_tier.py): one seat vs

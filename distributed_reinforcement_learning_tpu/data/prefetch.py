@@ -61,12 +61,20 @@ class DevicePrefetcher:
         # device compute like the H2D itself. Over a mesh the stack needs
         # its own spec (`stack_sharding`, B on the data axis, K
         # unsharded) — the per-batch `sharding` would put K there.
-        self.stack_calls = max(1, int(stack_calls))
-        self.stack_sharding = stack_sharding
-        if self.stack_calls > 1 and sharding is not None and stack_sharding is None:
+        #
+        # The depth is RECONFIGURABLE post-construction (`reconfigure`):
+        # the live config is one immutable (k, stack_sharding, epoch)
+        # tuple swapped atomically by the controlling thread and read
+        # once per round by the prefetch thread; queued batches carry
+        # the epoch they were stacked under and get_batch drops
+        # mismatches — a renegotiated K can never hand the learn path a
+        # stale-shape stack.
+        k = max(1, int(stack_calls))
+        if k > 1 and sharding is not None and stack_sharding is None:
             raise ValueError(
                 "stack_calls > 1 over a mesh needs stack_sharding "
                 "(a [K, B, ...] spec with the batch dim on the data axis)")
+        self._cfg: tuple[int, Any, int] = (k, stack_sharding, 0)
         self._out: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
         self.dropped_batches = 0  # dequeued-but-untrained batches lost at stop
         self._error: BaseException | None = None
@@ -75,6 +83,40 @@ class DevicePrefetcher:
             target=self._loop, daemon=True, name="device-prefetch"
         )
         self._thread.start()
+
+    @property
+    def stack_calls(self) -> int:
+        return self._cfg[0]
+
+    @property
+    def stack_sharding(self):
+        return self._cfg[1]
+
+    def reconfigure(self, stack_calls: int, stack_sharding: Any | None = None
+                    ) -> None:
+        """Renegotiate the K-stack depth post-construction.
+
+        PR 13's tier attach REFUSED `updates_per_call>1` on a
+        prefetching learner because flipping the learner's counter would
+        feed the constructed [K, B, ...] stack into the K==1 learn path
+        and shape-crash the first step. The epoch-tagged handoff makes
+        the negotiation safe instead: batches already queued at the old
+        depth are dropped at `get_batch` (counted in `dropped_batches`),
+        and the next prefetch round stacks at the new depth. Called from
+        the learner's controlling thread (tier attach / construction
+        wiring) — a single atomic reference swap, no lock needed against
+        the prefetch thread's per-round read."""
+        k = max(1, int(stack_calls))
+        cur_k, cur_sharding, epoch = self._cfg
+        if stack_sharding is None:
+            stack_sharding = cur_sharding
+        if k > 1 and self.sharding is not None and stack_sharding is None:
+            raise ValueError(
+                "stack_calls > 1 over a mesh needs stack_sharding "
+                "(a [K, B, ...] spec with the batch dim on the data axis)")
+        if k == cur_k and stack_sharding is cur_sharding:
+            return
+        self._cfg = (k, stack_sharding, epoch + 1)
 
     def _loop(self) -> None:
         try:
@@ -105,12 +147,15 @@ class DevicePrefetcher:
         # (which holds K dequeues alive at once) must copy out of the pool:
         # np.stack below already does, but the pool's rotation window may
         # be narrower than K — disable pooling when stacking.
-        pooled = (getattr(self.source, "supports_pooled_get", False)
-                  and jax.default_backend() not in ("cpu",)
-                  and self.stack_calls == 1)
+        pool_ok = (getattr(self.source, "supports_pooled_get", False)
+                   and jax.default_backend() not in ("cpu",))
         while not self._stop.is_set():
+            # One config read per round: a reconfigure lands at the NEXT
+            # round; this round's product carries this round's epoch.
+            stack_calls, stack_sharding, epoch = self._cfg
+            pooled = pool_ok and stack_calls == 1
             parts = []
-            while len(parts) < self.stack_calls and not self._stop.is_set():
+            while len(parts) < stack_calls and not self._stop.is_set():
                 try:
                     if pooled:
                         batch = self.source.get_batch(self.batch_size, timeout=0.2,
@@ -130,10 +175,10 @@ class DevicePrefetcher:
                         return
                     continue
                 parts.append(batch)
-            if len(parts) < self.stack_calls:
+            if len(parts) < stack_calls:
                 self._note_dropped(parts)  # stopped mid-stack
                 return
-            if self.stack_calls > 1:
+            if stack_calls > 1:
                 from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
 
                 batch = stack_pytrees(parts)
@@ -145,8 +190,7 @@ class DevicePrefetcher:
             # overlaps with whatever the device is computing. Multi-host
             # meshes route through make_array_from_process_local_data
             # (parallel.mesh.place_local_batch).
-            sharding = (self.stack_sharding if self.stack_calls > 1
-                        else self.sharding)
+            sharding = stack_sharding if stack_calls > 1 else self.sharding
             if sharding is not None:
                 from distributed_reinforcement_learning_tpu.parallel import place_local_batch
 
@@ -162,7 +206,7 @@ class DevicePrefetcher:
                 jax.block_until_ready(batch)
             while not self._stop.is_set():
                 try:
-                    self._out.put(batch, timeout=0.2)
+                    self._out.put((epoch, stack_calls, batch), timeout=0.2)
                     break
                 except _queue.Full:
                     continue
@@ -172,17 +216,28 @@ class DevicePrefetcher:
 
         Raises the prefetch thread's failure (if it died) rather than
         returning None forever. timeout=None blocks — but in slices, so a
-        thread death still surfaces instead of hanging the blocking get."""
+        thread death still surfaces instead of hanging the blocking get.
+        Batches stacked under a depth that `reconfigure` has since
+        replaced are dropped here (their source batches counted in
+        `dropped_batches`) — the caller only ever sees the live shape."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             try:
-                return self._out.get(timeout=0.2 if deadline is None
-                                     else max(0.0, min(0.2, deadline - time.monotonic())))
+                epoch, stack_calls, batch = self._out.get(
+                    timeout=0.2 if deadline is None
+                    else max(0.0, min(0.2, deadline - time.monotonic())))
             except _queue.Empty:
                 if self._error is not None:
                     raise RuntimeError("prefetch thread died") from self._error
                 if deadline is not None and time.monotonic() >= deadline:
                     return None
+                continue
+            if epoch != self._cfg[2]:
+                self.dropped_batches += stack_calls  # stale-depth stack
+                _log.info("prefetch dropped a stale-depth stack "
+                          "(%d batches) after reconfigure", stack_calls)
+                continue
+            return batch
 
     def close(self) -> None:
         self._stop.set()

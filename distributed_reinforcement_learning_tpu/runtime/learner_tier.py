@@ -428,17 +428,6 @@ class LearnerTier:
                 f"{type(learner).__name__} has no `_learn` seam for the "
                 f"tier to wrap")
         if getattr(learner, "updates_per_call", 1) > 1:
-            if getattr(learner, "_prefetcher", None) is not None:
-                # The impala-family prefetcher was CONSTRUCTED to stack
-                # K dequeues into one [K, B, ...] batch — flipping the
-                # counter here would feed that stack into the K==1
-                # learn path and shape-crash the first step.
-                raise ValueError(
-                    "tier seats need updates_per_call=1 with the "
-                    "prefetching impala learner (its DevicePrefetcher "
-                    "was built to stack K>1 batches) — set "
-                    "updates_per_call 1 in the config section for tier "
-                    "topologies")
             if self.sync == "allreduce" or not hasattr(learner,
                                                       "_learn_many"):
                 # allreduce needs a host boundary per update; and the
@@ -446,16 +435,26 @@ class LearnerTier:
                 # agent.learn_many) bypasses every wrappable seam, so
                 # async would silently never merge there. Forcing K=1
                 # is safe for these learners — the K path is chosen per
-                # train call, nothing was pre-built around K.
+                # train call: the impala prefetcher RENEGOTIATES its
+                # stack depth (PR 13 refused here before the depth
+                # became reconfigurable — stale [K, B, ...] stacks are
+                # epoch-dropped, never fed to the K==1 learn path), and
+                # the replay family's fused device path renegotiates
+                # the same way on its next train call
+                # (ReplayTrainMixin._device_path_for), degrading to
+                # double-buffered H2D only.
                 import sys
 
+                pf = getattr(learner, "_prefetcher", None)
+                if pf is not None:
+                    pf.reconfigure(stack_calls=1)
                 print("[learner_tier] WARNING: updates_per_call forced "
                       "to 1 (the tier merges per train step)",
                       file=sys.stderr)
                 learner.updates_per_call = 1
-            # else: impala-family K>1 without a prefetcher under async
-            # — _learn_many is wrapped below, K preserved (one merge
-            # check per K-step scan call).
+            # else: impala-family K>1 under async — _learn_many is
+            # wrapped below, K preserved (one merge check per K-step
+            # scan call; a prefetcher keeps stacking K).
         if self.sync == "allreduce":
             agent = learner.agent
             if getattr(learner, "_sharded", None) is not None:

@@ -480,6 +480,16 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
     def _train_once(self, replay) -> dict:
         """The sample -> learn -> re-prioritize body of one train call,
         against whichever replay `_active_replay()` resolved."""
+        path = self._device_path_for(replay)
+        if path is not None:
+            # Fused device path (data/device_path.py): gather + stack +
+            # H2D happened on the path's thread, overlapped with the
+            # previous call's learn. (Shards-only, so recent-mixing —
+            # which refuses to compose with shards — can never race it.)
+            from distributed_reinforcement_learning_tpu.runtime.replay_train import (
+                device_train_call)
+
+            return device_train_call(self, path, replay)
         if self.updates_per_call > 1:
             from distributed_reinforcement_learning_tpu.runtime.replay_train import (
                 prioritized_train_call)
@@ -511,6 +521,7 @@ class R2D2Learner(PublishCadenceMixin, ReplayTrainMixin):
     def close(self) -> None:
         self.flush_publish()
         self.close_metrics()
+        self._close_device_path()  # join the gather thread
         self._profiler.close()
 
 

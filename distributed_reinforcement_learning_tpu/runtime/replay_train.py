@@ -17,14 +17,24 @@ mesh guard, the steps-since-last target-sync cadence (a modulo goes
 off-grid under stride-K counters), and that cadence's checkpoint
 round-trip (without it, a restore would see _last_target_sync=0 and
 overwrite the restored target net up to interval-1 steps early).
+
+It also owns the FUSED device sample path (data/device_path.py):
+`_device_path_for` lazily builds a `DeviceSamplePath` over the healthy
+sharded service on the first gated train call, renegotiates K after a
+learner-tier attach, and demotes PERMANENTLY (one log line) when the
+path latches dead — `device_train_call` below is its train-call body
+(one `learn_many` scan per pre-transferred entry, ONE D2H per K).
 """
 
 from __future__ import annotations
 
+import sys
+import time
+
 import jax
 import numpy as np
 
-from distributed_reinforcement_learning_tpu.data.fifo import stack_pytrees
+from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 
 
 class ReplayTrainMixin:
@@ -79,6 +89,82 @@ class ReplayTrainMixin:
                 f"target_sync_interval ({self.target_sync_interval}) — the "
                 "scan cannot target-sync mid-call")
         self._last_target_sync = 0
+        # Fused device sample path (data/device_path.py): built lazily
+        # on the first gated train call — by then a learner tier has
+        # attached (it may force K=1) and the gate/verdict is readable.
+        # `device_path_force` overrides the env/verdict gate (bench A/B
+        # and tests set it; None = resolve DRL_DEVICE_PATH normally).
+        self._device_path = None
+        self._device_path_demoted = False
+        self.device_path_force: bool | None = None
+
+    # -- fused device sample path ------------------------------------------
+
+    def _device_path_for(self, replay):
+        """The device path for THIS train call, or None (host loop).
+
+        Requires the already-resolved active replay to be the healthy
+        sharded service: its per-shard locks make the background gather
+        safe, while the monolithic backends are learner-thread-only by
+        contract (a demotion closes the path BEFORE the host loop takes
+        the sampling RNG back). Mesh learners stay on the host path —
+        their batches need explicit sharding placement."""
+        if self._device_path_demoted:
+            return None
+        dp = self._device_path
+        svc = self.replay_service
+        if svc is None or replay is not svc:
+            if dp is not None:
+                self._demote_device_path(
+                    "replay service demoted to the monolithic backend")
+            return None
+        if dp is None:
+            from distributed_reinforcement_learning_tpu.data.device_path import (
+                device_path_enabled)
+
+            force = self.device_path_force
+            enabled = device_path_enabled() if force is None else bool(force)
+            if not enabled or self._batch_sharding is not None:
+                self._device_path_demoted = True  # resolve the gate once
+                return None
+            from distributed_reinforcement_learning_tpu.data.device_path import (
+                DeviceSamplePath)
+
+            self._device_path = dp = DeviceSamplePath(
+                svc, self.batch_size, self.updates_per_call, self._np_rng)
+        elif dp.k != self.updates_per_call:
+            # A learner-tier attach forced K=1 after the path was built:
+            # renegotiate — stale-K entries are epoch-dropped inside the
+            # path, never fed to the K==1 learn seam.
+            dp.reconfigure(self.updates_per_call)
+        if dp.dead:
+            self._demote_device_path(dp.dead_reason or "gather died")
+            return None
+        return dp
+
+    def _demote_device_path(self, reason: str) -> None:
+        """Permanent demote-to-host-path (the ring/board ladder shape):
+        close() JOINS the gather thread, so the learner's `_np_rng` is
+        exclusively the host loop's again before it samples. If the
+        join times out (a wedged gather round), the shared stream is
+        ABANDONED to the zombie thread and the host loop continues on a
+        fresh one — RandomState is not thread-safe, and a corrupted
+        sampling stream is worse than a one-time reseed (the stream
+        carries no replay semantics beyond stratified-draw positions)."""
+        dp, self._device_path = self._device_path, None
+        self._device_path_demoted = True
+        if dp is not None and not dp.close():
+            self._np_rng = np.random.RandomState()
+            print("[device_path] WARNING: gather thread did not join; "
+                  "host loop continues on a fresh sampling stream",
+                  file=sys.stderr)
+        print(f"[device_path] WARNING: fused sample path demoted to the "
+              f"host loop: {reason}", file=sys.stderr)
+
+    def _close_device_path(self) -> None:
+        if self._device_path is not None:
+            self._device_path.close()
+            self._device_path = None
 
     def _finish_train_call(self) -> None:
         """Advance counters by the call's K steps; publish and target-sync
@@ -114,30 +200,63 @@ def prioritized_train_call(learner, k: int, replay=None) -> dict:
     learn thread never walks a sum tree here. Batches 2..K were sampled
     before any of the K updates landed either way — the same
     K-1-step priority staleness the scan always had."""
+    from distributed_reinforcement_learning_tpu.data.device_path import (
+        gather_scan_batch)
+
     if replay is None:
         replay = learner._active_replay()
-    soa = getattr(replay, "stacked_samples", False)
-    sampled = []
     with learner.timer.stage("replay_sample"):
-        for _ in range(k):
-            sampled.append(replay.sample(learner.batch_size, learner._np_rng))
         # Host-side batch assembly belongs to the sample stage (the K=1
-        # path stacks there too): keep the learn stage device-only.
-        if soa:
-            # SoA backend hands back already-stacked [B, ...] arrays.
-            stacked = stack_pytrees([items for items, _, _ in sampled])
-        else:
-            # AoS: one copy — stack all K*B items once, view as [K, B, ...].
-            flat = stack_pytrees([it for items, _, _ in sampled for it in items])
-            stacked = jax.tree.map(
-                lambda x: x.reshape((k, -1) + x.shape[1:]), flat)
-        weights = np.stack([np.asarray(w, np.float32) for _, _, w in sampled])
+        # path stacks there too): keep the learn stage device-only. ONE
+        # gather definition shared with the device path (device_path.py),
+        # so the two paths cannot drift.
+        stacked, weights, idx_list = gather_scan_batch(
+            replay, learner.batch_size, k, learner._np_rng)
     with learner.timer.stage("learn"):
         learner.state, prio_stack, metrics_stack = learner.agent.learn_many(
             learner.state, stacked, weights)
         metrics = jax.tree.map(lambda x: x[-1], metrics_stack)
     with learner.timer.stage("replay_update"):
         prio_stack = np.asarray(prio_stack)
-        for (_, idxs, _), prio in zip(sampled, prio_stack):
+        for idxs, prio in zip(idx_list, prio_stack):
+            replay.update_batch(idxs, prio)
+    return metrics
+
+
+def device_train_call(learner, path, replay) -> dict | None:
+    """One train call off the fused device path: the entry's batch and
+    IS weights are ALREADY device-resident (the path's gather thread
+    sampled, stacked, and issued the H2D while the previous call's scan
+    ran), so the learn stage is dispatch-only. K>1 runs as one jitted
+    `learn_many` scan; K==1 goes through the learner's `_learn` seam so
+    a tier's collective wrap still applies (the degrade contract). The
+    K-step priorities come back in a SINGLE D2H and fan out to the
+    replay's writeback router per sampled batch — with the sharded
+    service those are the packed (tag|epoch|shard|tree_idx) indexes, so
+    a shard death mid-K drops only its own stale-epoch updates.
+
+    Returns None when the gather is behind (the caller's train() skips
+    the step; a DEAD path was already demoted by `_device_path_for`)."""
+    with learner.timer.stage("replay_sample"):
+        entry = path.next_entry(timeout=1.0)
+    if entry is None:
+        return None
+    k, batch, weights, idx_list = entry
+    with learner.timer.stage("learn"):
+        if k > 1:
+            learner.state, prio_stack, metrics_stack = learner.agent.learn_many(
+                learner.state, batch, weights)
+            metrics = jax.tree.map(lambda x: x[-1], metrics_stack)
+        else:
+            learner.state, prio, metrics = learner._learn(
+                learner.state, batch, weights)
+            prio_stack = prio[None]
+    with learner.timer.stage("replay_update"):
+        t0 = time.perf_counter()
+        prio_host = np.asarray(prio_stack)  # THE single D2H per K
+        if _OBS.enabled:
+            _OBS.gauge("devpath/d2h_ms", (time.perf_counter() - t0) * 1e3)
+            _OBS.gauge("devpath/scan_k", k)
+        for idxs, prio in zip(idx_list, prio_host):
             replay.update_batch(idxs, prio)
     return metrics

@@ -48,7 +48,14 @@ from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 
 
 class XformerLearner(R2D2Learner):
-    """R2D2Learner bound to an XformerAgent; see module docstring."""
+    """R2D2Learner bound to an XformerAgent; see module docstring.
+
+    The fused device sample path (data/device_path.py) rides the
+    inherited `_train_once`: over a healthy sharded service the gather
+    + stack + H2D of the next prioritized sequence batch overlaps this
+    learner's attention-heavy learn step — the family where hiding the
+    host path matters most (largest per-step device time to hide it
+    behind)."""
 
 
 class XformerActor:

@@ -294,7 +294,7 @@ def build_report(tdir: str, merge: bool = True) -> str:
             if name.startswith(("staleness_bucket/", "codec/", "board/",
                                 "replay_shard/", "inference/",
                                 "remote_act/", "wshard/", "weights/",
-                                "fleet/", "pipe/")):
+                                "fleet/", "pipe/", "devpath/")):
                 continue  # rendered as their own sections below
             any_counter = True
             out(f"  {shard_label(shard):<14} {name:<28} "
@@ -471,6 +471,62 @@ def build_report(tdir: str, merge: bool = True) -> str:
         out("")
         out("-- Replay shards (ingest-time prioritization) --")
         lines.extend(shard_lines)
+
+    # Device sample path (data/device_path.py): the fused gather ->
+    # H2D -> scanned-learn pipeline on the learner shard. Depth gauge
+    # (device-resident sampled calls waiting), H2D bytes + per-entry
+    # copy time, the overlap ratio (how much of the gather+copy the
+    # learn scan hid: 1.0 = the learn thread never waited), scan-K
+    # utilization, and the single-D2H priority readback latency.
+    # Section only appears when a run trained through the fused path.
+    devpath_lines: list[str] = []
+    for shard in shards:
+        rates = shard.counter_rates()
+        entries = rates.get("devpath/entries")
+        if entries is None:
+            continue
+        gather = shard.gauge_stats("devpath/gather_ms")
+        h2d = shard.gauge_stats("devpath/h2d_ms")
+        bytes_total = rates.get("devpath/h2d_bytes", {}).get("total", 0)
+        h2d_part = (f"h2d {h2d['mean']:.2f}ms/entry "
+                    f"({bytes_total / 1e6:.1f} MB total)  "
+                    if h2d is not None else "")
+        # Overlap: the sample stage on the learn thread is pure entry
+        # WAIT under the fused path — time the background pipeline
+        # failed to hide. 1.0 means gather+copy were fully hidden.
+        wait_rows = [r for r in rows if r["stage"] == "replay_sample"
+                     and r["proc"] == shard_label(shard)]
+        overlap_part = ""
+        if wait_rows and gather is not None and h2d is not None:
+            hidden = gather["mean"] + h2d["mean"]
+            waited = wait_rows[0]["p50_ms"]
+            if hidden > 0:
+                ratio = max(0.0, min(1.0, 1.0 - waited / hidden))
+                overlap_part = (f"overlap {ratio:.0%} "
+                                f"(entry wait p50 {waited:.2f}ms)  ")
+        devpath_lines.append(
+            f"  {shard_label(shard)}: {entries['total']:.0f} entries "
+            f"({entries['rate']:.1f}/s)  {h2d_part}{overlap_part}"
+            f"dropped {rates.get('devpath/dropped_entries', {}).get('total', 0):.0f}")
+        depth = shard.gauge_stats("devpath/depth")
+        scan_k = shard.gauge_stats("devpath/scan_k")
+        d2h = shard.gauge_stats("devpath/d2h_ms")
+        parts = []
+        if depth is not None:
+            parts.append(f"prefetch depth mean {depth['mean']:.1f} "
+                         f"(max {depth['max']:.0f})")
+        if scan_k is not None:
+            parts.append(f"scan-K mean {scan_k['mean']:.1f} "
+                         f"(last {scan_k['last']:.0f})")
+        if d2h is not None:
+            parts.append(f"priority D2H mean {d2h['mean']:.2f}ms "
+                         f"max {d2h['max']:.2f}ms ({d2h['n']} calls)")
+        if parts:
+            devpath_lines.append("    " + "  ".join(parts))
+    if devpath_lines:
+        out("")
+        out("-- Device sample path (fused gather/H2D/scan) --")
+        lines.extend(devpath_lines)
 
     # Fleet health (runtime/fleet.py): the learner shard carries the
     # roster gauges (alive/suspect/dead over time) + the supervisor's

@@ -1,11 +1,11 @@
 #!/usr/bin/env bash
 # Runtime concurrency sanitizer driver (docs/static_analysis.md
-# "Runtime sanitizer"). Runs the ten concurrency suites under
+# "Runtime sanitizer"). Runs the eleven concurrency suites under
 # DRL_SANITIZE=1 so every package lock/_GUARDED_BY attr/blocking call
 # is checked live, then reconciles the JSONL artifact against the
 # static lock model:
 #
-#   scripts/sanitize.sh              # ten suites + reconcile
+#   scripts/sanitize.sh              # eleven suites + reconcile
 #   scripts/sanitize.sh OUT_DIR      # keep the artifact in OUT_DIR
 #
 # Exit nonzero when any suite fails, any runtime finding was recorded
@@ -32,6 +32,7 @@ SUITES=(
   tests/test_serving.py
   tests/test_inference.py
   tests/test_actor_pipeline.py
+  tests/test_device_path.py
 )
 
 env JAX_PLATFORMS=cpu DRL_SANITIZE=1 DRL_SANITIZE_OUT="$ART" \

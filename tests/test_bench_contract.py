@@ -45,6 +45,7 @@ _TRIMMED = {
     "BENCH_WEIGHTS_SHARD": "0", "BENCH_REPLAY": "0", "BENCH_INFER": "0",
     "BENCH_CHAOS": "0", "BENCH_ACTOR": "0",
     "BENCH_LEARNER": "0", "BENCH_SEAT_DRILL": "0",
+    "BENCH_DEVICE_PATH": "0",
 }
 
 
@@ -355,6 +356,59 @@ class TestReplayCompare:
         assert shard_count() == 3  # env force wins over the verdict
         monkeypatch.setenv("DRL_REPLAY_SHARDS", "0")
         assert shard_count() == 0
+
+
+class TestDevicePathCompare:
+    """bench_device_path_compare: the host-vs-fused sample-path A/B
+    whose verdict gates data/device_path's auto-enable. Driven directly
+    at a tiny config (CPU, real feeder child over loopback TCP, real
+    sharded service both sides) — the committed adjudication numbers
+    live in benchmarks/device_path_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        bench = _load_bench()
+        r = bench.bench_device_path_compare(window_s=1.5, steps=16,
+                                            obs_dim=16, k=2, batch_size=16,
+                                            reps=1)
+        for side in ("host", "device"):
+            assert r[side]["train_frames_per_s"] > 0, r
+            assert r[side]["train_steps_in_window"] > 0
+            assert r[side]["ingested_unrolls_in_window"] > 0  # under load
+            assert (r[side]["train_call_ms_p99"]
+                    >= r[side]["train_call_ms_p50"])
+        # The device variant really trained through the fused path.
+        dp = r["device"]["devpath"]
+        assert dp["entries_out"] > 0 and dp["h2d_bytes"] > 0
+        assert dp["k"] == 2 and dp["dead_reason"] is None
+        assert r["device_vs_host"] > 0
+        assert r["auto_enable"] == (r["device_vs_host"] >= 1.2)
+        assert r["verdict"].startswith("device sample path ") and (
+            "auto-on" in r["verdict"] or "opt-in" in r["verdict"])
+
+    def test_compact_line_carries_device_path_verdict_key(self):
+        bench = _load_bench()
+        assert "device_path_verdict" in bench._COMPACT_KEYS
+
+    def test_trimmed_env_disables_section(self):
+        assert _TRIMMED["BENCH_DEVICE_PATH"] == "0"
+
+    def test_committed_verdict_file_consistent(self, monkeypatch):
+        """The committed adjudication parses, and the gate follows it
+        when DRL_DEVICE_PATH is unset (env force > verdict > off)."""
+        monkeypatch.delenv("DRL_DEVICE_PATH", raising=False)
+        path = REPO / "benchmarks" / "device_path_verdict.json"
+        verdict = json.loads(path.read_text())
+        assert isinstance(verdict["auto_enable"], bool)
+        assert verdict["ratio_runs"] and verdict["bar"] == 1.2
+        from distributed_reinforcement_learning_tpu.data.device_path import (
+            device_path_enabled)
+
+        assert device_path_enabled(str(path)) is verdict["auto_enable"]
+        monkeypatch.setenv("DRL_DEVICE_PATH", "1")
+        assert device_path_enabled(str(path))
+        monkeypatch.setenv("DRL_DEVICE_PATH", "0")
+        assert not device_path_enabled(str(path))
 
 
 class TestLearnerCompare:

@@ -672,6 +672,8 @@ class TestCliAndTreeGate:
             "data/replay.py": 3,         # Native/Array backends + doc note
             "data/replay_service.py": 2,  # ReplayShard + ShardedReplayService
             "runtime/replay_shard.py": 1,  # ReplayIngestFifo
+            "data/device_path.py": 1,    # DeviceSamplePath (doc form:
+            #                              SPSC queue + atomic cfg swap)
             "data/native.py": 1,
             "parallel/collective.py": 3,  # Membership + endpoint
             #                               + HostCollective

@@ -595,6 +595,25 @@ class TestLearnerTier:
         k8 = K8()
         tier.attach(k8)
         assert k8.updates_per_call == 1
+
+        class FakePrefetcher:
+            stack_calls = 8
+            reconfigured_to = None
+
+            def reconfigure(self, stack_calls):
+                self.reconfigured_to = stack_calls
+
+        class K8Prefetching(K8):
+            updates_per_call = 8  # class attr rebinding per instance
+
+        k8p = K8Prefetching()
+        k8p._prefetcher = FakePrefetcher()
+        # PR 13 REFUSED this shape (flipping the counter would feed the
+        # constructed [K, B, ...] stack into the K==1 learn path); the
+        # reconfigurable stack depth makes attach negotiate instead.
+        tier.attach(k8p)
+        assert k8p.updates_per_call == 1
+        assert k8p._prefetcher.reconfigured_to == 1
         tier.close()
 
     def test_build_tier_env_resolution(self, monkeypatch):

@@ -77,6 +77,55 @@ def test_impala_learner_with_prefetch_trains():
         learner.close()
 
 
+def test_prefetcher_reconfigure_k_stack_post_construction():
+    """PR 13's tier attach refused updates_per_call>1 on a prefetching
+    learner; the stack depth is now renegotiable: K>1 stacks already
+    queued are dropped (counted) and the next round produces the new
+    shape — the K==1 learn path never sees a stale [K, B, ...] stack."""
+    queue = TrajectoryQueue(capacity=128)
+    for i in range(32):
+        queue.put(_traj(i))
+    pf = DevicePrefetcher(queue, batch_size=4, stack_calls=2, depth=2)
+    try:
+        batch = pf.get_batch(timeout=5.0)
+        assert batch is not None and batch["state"].shape == (2, 4, 4, 3)
+        assert pf.stack_calls == 2
+        pf.reconfigure(stack_calls=1)
+        assert pf.stack_calls == 1
+        deadline = time.monotonic() + 30.0
+        while True:
+            batch = pf.get_batch(timeout=5.0)
+            assert batch is not None
+            if batch["state"].shape == (4, 4, 3):
+                break  # new depth reached; stale stacks were dropped
+            raise AssertionError(
+                f"stale-shape stack surfaced: {batch['state'].shape}")
+        assert time.monotonic() < deadline
+        # Upscale works too (the fused path / tier can negotiate K up).
+        for i in range(48):  # keep the source fed across the dropped rounds
+            queue.put(_traj(100 + i))
+        pf.reconfigure(stack_calls=3)
+        deadline = time.monotonic() + 30.0
+        while True:
+            batch = pf.get_batch(timeout=5.0)
+            assert batch is not None and time.monotonic() < deadline
+            if batch["state"].shape == (3, 4, 4, 3):
+                break
+    finally:
+        pf.close()
+
+
+def test_prefetcher_reconfigure_same_k_is_noop():
+    queue = TrajectoryQueue(capacity=8)
+    pf = DevicePrefetcher(queue, batch_size=4, stack_calls=2)
+    try:
+        epoch_before = pf._cfg[2]
+        pf.reconfigure(stack_calls=2)
+        assert pf._cfg[2] == epoch_before  # no epoch churn, no drops
+    finally:
+        pf.close()
+
+
 def test_prefetcher_surfaces_source_failure():
     """A dead prefetch thread must be distinguishable from slow actors:
     get_batch re-raises the thread's failure instead of timing out forever."""
