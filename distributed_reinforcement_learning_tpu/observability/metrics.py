@@ -107,6 +107,8 @@ class Telemetry:
         "_counters": "_lock",
         "_gauges": "_lock",
         "_providers": "_lock",
+        "_flush_errors": "_lock",
+        "_provider_errors": "_lock",
     }
     _NOT_GUARDED = {
         "enabled": "flipped by configure()/close() around the threaded "
@@ -131,6 +133,8 @@ class Telemetry:
         self._gauges: dict[str, _Window] = {}
         # name -> (provider fn, record kind: "gauge" | "counter")
         self._providers: dict[str, tuple[Callable[[], Any], str]] = {}
+        self._flush_errors = 0     # whole-flush failures (first one warns)
+        self._provider_errors = 0  # dead providers, surfaced as a counter
         self._file = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -218,11 +222,19 @@ class Telemetry:
     # -- flushing ----------------------------------------------------------
 
     def _flush_loop(self, interval: float) -> None:
+        import sys
+
         while not self._stop.wait(interval):
             try:
                 self.flush()
-            except Exception:  # noqa: BLE001 — telemetry must never kill a run
-                pass
+            except Exception as e:  # noqa: BLE001 — telemetry must never
+                with self._lock:    # kill a run; count it, warn ONCE
+                    self._flush_errors += 1
+                    first = self._flush_errors == 1
+                if first:
+                    print(f"[telemetry] WARNING: flush failed (further "
+                          f"failures counted silently): {e!r}",
+                          file=sys.stderr)
 
     def flush(self) -> None:
         if not self.enabled or self._file is None:
@@ -243,7 +255,9 @@ class Telemetry:
             try:
                 value = float(fn())
             except Exception:  # noqa: BLE001 — a dead provider (closed queue
-                continue       # at shutdown) must not poison the flush
+                with self._lock:        # at shutdown) must not poison the
+                    self._provider_errors += 1  # flush; counted + emitted
+                continue
             if kind == "counter":
                 lines.append({"kind": "counter", "t": now, "name": name,
                               "value": value})
@@ -251,6 +265,15 @@ class Telemetry:
                 lines.append({"kind": "gauge", "t": now, "name": name, "n": 1,
                               "last": value, "mean": value, "min": value,
                               "max": value})
+        with self._lock:
+            perrs, ferrs = self._provider_errors, self._flush_errors
+        if perrs:
+            lines.append({"kind": "counter", "t": now,
+                          "name": "telemetry.provider_errors",
+                          "value": perrs})
+        if ferrs:
+            lines.append({"kind": "counter", "t": now,
+                          "name": "telemetry.flush_errors", "value": ferrs})
         if lines:
             self._file.write("".join(json.dumps(line) + "\n" for line in lines))
             self._file.flush()

@@ -319,7 +319,10 @@ class CollectiveEndpoint:
                 except (TransportError, OSError):
                     return
                 except Exception:  # noqa: BLE001 — malformed peer bytes
-                    try:                    # must not kill the endpoint
+                    # must not kill the endpoint: answer ST_ERROR and
+                    # count it (snapshot_stats/"serve_errors").
+                    self._owner._bump("serve_errors")
+                    try:
                         _send_msg(conn, ST_ERROR)
                     except OSError:
                         return
@@ -406,6 +409,7 @@ class HostCollective:
         host, port = self.addrs[rank]
         self._endpoint = CollectiveEndpoint(self, host, port)
         self.stats = {"rounds_ok": 0, "rounds_aborted": 0, "peer_deaths": 0,
+                      "serve_errors": 0,
                       "solo_rounds": 0, "bytes_sent": 0, "bytes_received": 0,
                       "merges_sent": 0, "merges_received": 0,
                       "merge_naks": 0, "probes_failed": 0,

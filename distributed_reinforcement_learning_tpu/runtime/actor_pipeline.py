@@ -507,7 +507,7 @@ class ActorPipeline:
                 undo = getattr(self._actor, "slice_discard_act", None)
                 try:
                     discarded = fut.result(timeout=30.0)
-                except Exception:  # noqa: BLE001 — its error is secondary
+                except Exception:  # noqa: BLE001  # drlint: disable=silent-except(settle error is secondary: the primary step/submit exception is already propagating past this finally, and the wedged latch demotes with its own log)
                     # Classify by fut.done(), NOT by exception type: on
                     # py3.10+ socket.timeout IS builtin TimeoutError, so
                     # an act that SETTLED with a socket timeout would

@@ -408,7 +408,7 @@ class FleetSupervisor:
             return None
         try:
             pid = self._board_pid_fn()
-        except Exception:  # noqa: BLE001 — advisory field only
+        except Exception:  # noqa: BLE001  # drlint: disable=silent-except(0 = documented "publisher unknown" protocol demotion; members skip board-pid validation per ProbeContext contract)
             return 0
         return int(pid) if pid else 0
 
@@ -609,7 +609,8 @@ class HeartbeatLoop:
         self._lock = threading.Lock()
         self._surfaces: list[Any] = []
         self.stats = {"heartbeats": 0, "heartbeat_failures": 0,
-                      "registrations": 0, "learner_restarts": 0}
+                      "registrations": 0, "learner_restarts": 0,
+                      "version_errors": 0}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._client = None       # loop-thread-only after start()
@@ -676,8 +677,10 @@ class HeartbeatLoop:
                         for s in self._surfaces]
         try:
             version = int(self._version_fn())
-        except Exception:  # noqa: BLE001 — version is advisory
-            version = -1
+        except Exception:  # noqa: BLE001 — version is advisory: -1 tells
+            version = -1   # the supervisor "unknown", and the failure is
+            with self._lock:  # visible in snapshot_stats()
+                self.stats["version_errors"] += 1
         return {"role": self.role, "rank": self.rank, "pid": os.getpid(),
                 "surfaces": surfaces, "version": version}
 

@@ -201,4 +201,9 @@ class PublishCadenceMixin:
             self.weights.publish(self.state.params, self.train_steps)
             self._last_publish_step = self.train_steps
         if _async_publish(self.sync_publish):
-            self.weights.flush_async()
+            # Retire the worker, not just drain it: the learner is the
+            # store's only publisher, so past this point the worker
+            # would idle on its condvar forever (the sanitizer's leak
+            # census flags exactly that). Store close() drains pending
+            # then joins; any later publish falls back to the sync path.
+            self.weights.close()

@@ -132,8 +132,8 @@ def _attach_shm(name: str):
         from multiprocessing import resource_tracker
 
         resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
-    except Exception:  # noqa: BLE001 — tracker internals moved: worst case
-        pass           # is a spurious warning at exit, never corruption
+    except Exception:  # noqa: BLE001  # drlint: disable=silent-except(tracker internals are stdlib-version-dependent; worst case is a spurious resource_tracker warning at exit, never corruption)
+        pass
     return shm
 
 

@@ -409,7 +409,8 @@ class TransportServer(_LockedStatsMixin):
                       "weight_bytes_sent": 0, "shard_sends": 0,
                       "shard_bytes_sent": 0, "shard_full_sends": 0,
                       "shard_delta_sends": 0, "shard_skip_sends": 0,
-                      "acts_served": 0, "act_busy_replies": 0}
+                      "acts_served": 0, "act_busy_replies": 0,
+                      "fleet_msg_errors": 0}
         self._stats_lock = threading.Lock()
 
     def start(self) -> "TransportServer":
@@ -448,7 +449,12 @@ class TransportServer(_LockedStatsMixin):
             s = self.snapshot_stats()
             try:
                 depth = self.queue.size() if self.queue is not None else 0
-            except Exception:  # noqa: BLE001 — closed queue at shutdown
+            except Exception as e:  # noqa: BLE001 — closed queue at shutdown
+                if not self._stop.is_set():
+                    # Mid-run death of the stats thread must not be
+                    # mistaken for clean shutdown: say why it stopped.
+                    print(f"[transport] WARNING: stats loop exiting: "
+                          f"{e!r}", file=_sys.stderr)
                 return
             print(f"[transport] depth={depth} "
                   f"unrolls={s['unrolls_accepted']} busy={s['busy_replies']} "
@@ -763,7 +769,8 @@ class TransportServer(_LockedStatsMixin):
                                      else self.fleet.heartbeat(info))
                             blob = _fleet.pack_fleet_msg(reply)
                         except Exception:  # noqa: BLE001 — malformed
-                            _send_msg(conn, ST_ERROR)  # member, not fatal
+                            self._bump("fleet_msg_errors")  # member,
+                            _send_msg(conn, ST_ERROR)       # not fatal
                         else:
                             _send_msg(conn, ST_OK, blob)
                 elif op == OP_QUEUE_SIZE:

@@ -224,8 +224,9 @@ class WeightStore:
 
             try:
                 board.close_writer()
-            except Exception:  # noqa: BLE001 — segment already gone
-                pass
+            except Exception as ce:  # noqa: BLE001 — segment already gone,
+                print(f"[weights] WARNING: board close_writer failed "
+                      f"during disable: {ce!r}", file=sys.stderr)
             print(f"[weights] WARNING: shm weight board disabled "
                   f"({e}); actors fall back to TCP pulls", file=sys.stderr)
 
@@ -407,7 +408,13 @@ class WeightStore:
         self.flush_async()
         with self._cond:
             self._closed = True
+            worker = self._worker
             self._cond.notify_all()
+        # Join OUTSIDE the condvar (the worker's drain loop reacquires it
+        # to observe _closed): close() must not return while the publish
+        # worker may still be mid-_apply against boards being torn down.
+        if worker is not None:
+            worker.join(timeout=5.0)
 
     @property
     def version(self) -> int:

@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
 # The pre-commit entry point (README "Pre-commit checks"): static lint
-# over the changed files, a bounded runtime-sanitizer smoke, and the
-# tier-1 pointer. Fast by design — the full gates (whole-tree lint,
-# scripts/sanitize.sh over all nine suites, tier-1) stay with CI.
+# over the changed files, a bounded runtime-sanitizer smoke (lock
+# checks + the leak census — a leaked thread/segment/socket in the
+# smoke suite is a finding and fails here), and the tier-1 pointer.
+# Fast by design — the full gates (whole-tree lint, scripts/sanitize.sh
+# over all eleven suites, tier-1) stay with CI.
 #
 #   scripts/check.sh             # lint vs HEAD + sanitize smoke
 #   scripts/check.sh BASE        # lint vs another git base ref
@@ -34,4 +36,4 @@ rm -f "$ART"
 echo "== tier-1 =="
 echo "not run here (minutes); the gate is:"
 echo "  JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'"
-echo "full sanitizer pass: scripts/sanitize.sh (nine suites + reconcile)"
+echo "full sanitizer pass: scripts/sanitize.sh (eleven suites + reconcile)"

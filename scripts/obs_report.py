@@ -895,6 +895,29 @@ def sanitizer_section(tdir: str, top: int = 5) -> list[str]:
         lines.append("  findings: 0")
     lines.append(f"  observed: {len(art.edges)} lock edges, "
                  f"{len(art.accesses)} guarded attrs exercised")
+    # Leak census (kind: "lifecycle"): per-resource acquire/release
+    # tallies, rolled up by owner class so a run report answers "whose
+    # threads / segments / sockets, and did they all end" at a glance.
+    if art.lifecycle:
+        per_res: dict[str, dict[str, int]] = {}
+        owners: dict[str, set[str]] = {}
+        for rec in art.lifecycle:
+            res = rec.get("res", "?")
+            a = per_res.setdefault(res, {"n": 0, "ended": 0})
+            a["n"] += rec.get("n", 0)
+            a["ended"] += rec.get("ended", 0)
+            owners.setdefault(res, set()).add(rec.get("owner", "<module>"))
+        noun = {"thread": "threads", "shm": "shm segments",
+                "socket": "sockets"}
+        for res in sorted(per_res):
+            a = per_res[res]
+            leaked = a["n"] - a["ended"]
+            own = ", ".join(sorted(owners[res]))
+            lines.append(
+                f"  census [{noun.get(res, res)}]: {a['n']} acquired, "
+                f"{a['ended']} released"
+                + (f", {leaked} LEAKED" if leaked else "")
+                + f"  (owners: {own})")
     holds = sorted(art.holds.items(),
                    key=lambda kv: kv[1]["max_ms"], reverse=True)[:top]
     if holds:

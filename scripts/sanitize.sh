@@ -2,17 +2,22 @@
 # Runtime concurrency sanitizer driver (docs/static_analysis.md
 # "Runtime sanitizer"). Runs the eleven concurrency suites under
 # DRL_SANITIZE=1 so every package lock/_GUARDED_BY attr/blocking call
-# is checked live, then reconciles the JSONL artifact against the
-# static lock model:
+# is checked live — and, via the leak census, every thread/shm
+# segment/socket the runtime acquires is tracked to its release — then
+# reconciles the JSONL artifact against the static models:
 #
 #   scripts/sanitize.sh              # eleven suites + reconcile
 #   scripts/sanitize.sh OUT_DIR      # keep the artifact in OUT_DIR
 #
 # Exit nonzero when any suite fails, any runtime finding was recorded
-# (rt-lock-order / rt-guardedby / rt-blocking / rt-hold), or reconcile
-# flags a stale _GUARDED_BY annotation / lock-graph model gap that is
-# not waived in tools/drlint/rt/waivers.py. The committed expectation
-# is ZERO on a clean tree.
+# (rt-lock-order / rt-guardedby / rt-blocking / rt-hold, or the
+# census's rt-thread-leak / rt-shm-leak / rt-shm-attach-unlink /
+# rt-socket-leak: a leaked thread, an un-unlinked creator segment, an
+# attach-side unlink, an unclosed socket), or reconcile flags a stale
+# _GUARDED_BY annotation / lock-graph model gap / lifecycle diff
+# (observed spawn-create owners vs the static thread/resource models)
+# that is not waived in tools/drlint/rt/waivers.py. The committed
+# expectation is ZERO on a clean tree: zero findings AND zero leaks.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

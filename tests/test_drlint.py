@@ -728,6 +728,10 @@ class TestBlockingUnderLock:
                         if self._sock is None:
                             self._connect_locked()
                         return _recv_exact(self._sock, 8)
+
+                def close(self):
+                    if self._sock is not None:
+                        self._sock.close()
         """)
         assert set(rules_of(findings)) == {"blocking-under-lock"}
         msgs = "\n".join(f.message for f in findings)
@@ -790,7 +794,7 @@ class TestBlockingUnderLock:
                         subprocess.run(["true"], check=True)
                         self._shm = SharedMemory(name="x", create=True, size=8)
 
-                def drop(self):
+                def stop(self):
                     with self._lock:
                         self._shm.unlink()
         """)
@@ -870,7 +874,9 @@ class TestBlockingUnderLock:
                 def fetch(self):
                     sock = socket.create_connection(("h", 1))  # no lock held
                     time.sleep(1.0)                            # ditto
-                    return sock.recv_into(bytearray(8), 8)
+                    got = sock.recv_into(bytearray(8), 8)
+                    sock.close()
+                    return got
 
                 def wait_bounded(self):
                     with self._cond:
@@ -1465,7 +1471,7 @@ class TestJsonSchema:
         int(f["fingerprint"], 16)  # hex
         assert set(out["summary"]) == {"findings", "baselined", "files",
                                        "rules"}
-        assert len(out["rules"]) == 10
+        assert len(out["rules"]) == 13
 
     def test_fingerprint_stable_across_line_shifts(self):
         src = "import numpy as np\n\ndef f():\n    return np.random.rand()\n"
@@ -1568,13 +1574,418 @@ class TestChangedMode:
         assert "fresh.py" in proc.stdout
 
 
+# ---------------------------------------------------------- thread-lifecycle
+
+class TestThreadLifecycle:
+    def test_unjoined_attr_thread_detected(self):
+        """A non-daemon thread attr with no join on any stop path is the
+        canonical leak-by-construction."""
+        findings = lintp("""
+            import threading
+
+            class Worker:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+
+                def close(self):
+                    pass
+        """)
+        hits = [f for f in findings if f.rule == "thread-lifecycle"]
+        assert len(hits) == 1, rules_of(findings)
+        assert "_t" in hits[0].message and "join" in hits[0].message
+
+    def test_daemon_without_stop_latch_detected(self):
+        findings = lintp("""
+            import threading
+
+            class Pump:
+                def start(self):
+                    self._t = threading.Thread(target=self._run, daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+        """)
+        hits = [f for f in findings if f.rule == "thread-lifecycle"]
+        assert len(hits) == 1, rules_of(findings)
+        assert "latch" in hits[0].message
+
+    def test_joined_on_close_is_clean(self):
+        findings = lintp("""
+            import threading
+
+            class Worker:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+
+                def close(self):
+                    self._t.join(timeout=5.0)
+        """)
+        assert "thread-lifecycle" not in rules_of(findings)
+
+    def test_latched_daemon_is_clean(self):
+        findings = lintp("""
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._stop = threading.Event()
+
+                def start(self):
+                    self._t = threading.Thread(target=self._run, daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    while not self._stop.is_set():
+                        pass
+
+                def close(self):
+                    self._stop.set()
+        """)
+        assert "thread-lifecycle" not in rules_of(findings)
+
+    def test_snapshot_join_idiom_is_clean(self):
+        """The repo's TransportServer idiom: threads appended to a
+        container, snapshot-copied under the lock, joined outside."""
+        findings = lintp("""
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._threads = []
+
+                def serve(self):
+                    t = threading.Thread(target=self._run)
+                    with self._lock:
+                        self._threads.append(t)
+                    t.start()
+
+                def _run(self):
+                    pass
+
+                def stop(self):
+                    with self._lock:
+                        threads = list(self._threads)
+                    for t in threads:
+                        t.join(timeout=2.0)
+        """)
+        assert "thread-lifecycle" not in rules_of(findings)
+
+    def test_join_under_sanitized_lock_detected(self):
+        """join() while holding the class's own lock is the deadlock
+        shape: the worker may need that lock to exit."""
+        findings = lintp("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    with self._lock:
+                        pass
+
+                def close(self):
+                    with self._lock:
+                        self._t.join()
+        """)
+        hits = [f for f in findings if f.rule == "thread-lifecycle"
+                and "holding" in f.message]
+        assert len(hits) == 1, rules_of(findings)
+
+    def test_function_local_unjoined_thread_detected(self):
+        findings = lintp("""
+            import threading
+
+            def fire_and_forget():
+                t = threading.Thread(target=print)
+                t.start()
+        """)
+        hits = [f for f in findings if f.rule == "thread-lifecycle"]
+        assert len(hits) == 1, rules_of(findings)
+        assert "never joined" in hits[0].message
+
+    def test_function_local_joined_or_escaping_is_clean(self):
+        findings = lintp("""
+            import threading
+
+            def run_both():
+                t = threading.Thread(target=print)
+                t.start()
+                t.join()
+
+            def make_worker():
+                t = threading.Thread(target=print)
+                t.start()
+                return t
+        """)
+        assert "thread-lifecycle" not in rules_of(findings)
+
+
+# -------------------------------------------------------- resource-lifecycle
+
+class TestResourceLifecycle:
+    def test_attach_side_unlink_detected(self):
+        """PR 9 creator-pid contract: attachers must never unlink."""
+        findings = lintp("""
+            from multiprocessing import shared_memory
+
+            class Reader:
+                def __init__(self, name):
+                    self._shm = shared_memory.SharedMemory(name=name)
+
+                def close(self):
+                    self._shm.close()
+                    self._shm.unlink()
+        """)
+        hits = [f for f in findings if f.rule == "resource-lifecycle"]
+        assert len(hits) == 1, rules_of(findings)
+        assert "creator" in hits[0].message
+
+    def test_create_without_unlink_detected(self):
+        """The creator closing but never unlinking leaves the segment in
+        /dev/shm — the reaper is a crash backstop, not a release path."""
+        findings = lintp("""
+            from multiprocessing import shared_memory
+
+            class Ring:
+                def __init__(self, name):
+                    self._shm = shared_memory.SharedMemory(
+                        name=name, create=True, size=1024)
+
+                def close(self):
+                    self._shm.close()
+        """)
+        hits = [f for f in findings if f.rule == "resource-lifecycle"]
+        assert len(hits) == 1, rules_of(findings)
+        assert "never unlinked" in hits[0].message
+
+    def test_creator_close_and_unlink_is_clean(self):
+        findings = lintp("""
+            from multiprocessing import shared_memory
+
+            class Ring:
+                def __init__(self, name):
+                    self._shm = shared_memory.SharedMemory(
+                        name=name, create=True, size=1024)
+
+                def close(self):
+                    self._shm.close()
+
+                def unlink(self):
+                    self._shm.unlink()
+        """)
+        assert "resource-lifecycle" not in rules_of(findings)
+
+    def test_unreleased_socket_attr_detected(self):
+        findings = lintp("""
+            import socket
+
+            class Client:
+                def __init__(self, addr):
+                    self._sock = socket.create_connection(addr)
+
+                def send(self, b):
+                    self._sock.sendall(b)
+        """)
+        hits = [f for f in findings if f.rule == "resource-lifecycle"]
+        assert len(hits) == 1, rules_of(findings)
+        assert "release" in hits[0].message
+
+    def test_with_managed_and_escaping_locals_are_clean(self):
+        findings = lintp("""
+            import socket
+
+            def probe(addr):
+                with socket.create_connection(addr) as s:
+                    return s.recv(1)
+
+            def dial(addr):
+                s = socket.create_connection(addr)
+                return s
+
+            def bounded(addr):
+                s = socket.create_connection(addr)
+                try:
+                    return s.recv(1)
+                finally:
+                    s.close()
+        """)
+        assert "resource-lifecycle" not in rules_of(findings)
+
+    def test_function_local_leak_detected(self):
+        findings = lintp("""
+            import socket
+
+            def leak(addr):
+                s = socket.create_connection(addr)
+                s.sendall(b"hi")
+        """)
+        hits = [f for f in findings if f.rule == "resource-lifecycle"]
+        assert len(hits) == 1, rules_of(findings)
+
+
+# ------------------------------------------------------------- silent-except
+
+class TestSilentExcept:
+    def test_swallowed_broad_except_detected(self):
+        findings = lint("""
+            def poll(q):
+                try:
+                    return q.get()
+                except Exception:
+                    pass
+        """)
+        hits = [f for f in findings if f.rule == "silent-except"]
+        assert len(hits) == 1, rules_of(findings)
+        assert "swallows" in hits[0].message
+
+    def test_bare_except_detected(self):
+        findings = lint("""
+            def poll(q):
+                try:
+                    return q.get()
+                except:
+                    return None
+        """)
+        assert rules_of([f for f in findings
+                         if f.rule == "silent-except"]) == ["silent-except"]
+
+    def test_loud_handlers_are_clean(self):
+        findings = lint("""
+            import logging
+
+            log = logging.getLogger(__name__)
+
+            class Stats:
+                def __init__(self, lock):
+                    self.stats = {"errors": 0}
+                    self._lock = lock
+
+                def a(self, q):
+                    try:
+                        return q.get()
+                    except Exception:
+                        log.warning("get failed")
+
+                def b(self, q):
+                    try:
+                        return q.get()
+                    except Exception:
+                        raise RuntimeError("get failed")
+
+                def c(self, q):
+                    try:
+                        return q.get()
+                    except Exception:
+                        with self._lock:
+                            self.stats["errors"] += 1
+
+                def d(self, q):
+                    try:
+                        return q.get()
+                    except Exception as e:
+                        return repr(e)
+        """)
+        assert "silent-except" not in rules_of(findings)
+
+    def test_narrow_except_and_import_guard_are_clean(self):
+        findings = lint("""
+            def parse(s):
+                try:
+                    return int(s)
+                except ValueError:
+                    pass
+
+            try:
+                import gymnasium
+            except Exception:
+                gymnasium = None
+        """)
+        assert "silent-except" not in rules_of(findings)
+
+    def test_justified_suppression_silences(self):
+        findings = lint("""
+            def poll(q):
+                try:
+                    return q.get()
+                except Exception:  # drlint: disable=silent-except(queue drain is best-effort by contract)
+                    pass
+        """)
+        assert "silent-except" not in rules_of(findings)
+
+    def test_bare_suppression_without_justification_persists(self):
+        """The justification grammar has teeth: a bare disable (or one
+        under 10 chars) does NOT clear the finding."""
+        findings = lint("""
+            def poll(q):
+                try:
+                    return q.get()
+                except Exception:  # drlint: disable=silent-except
+                    pass
+
+            def poll2(q):
+                try:
+                    return q.get()
+                except Exception:  # drlint: disable=silent-except(meh)
+                    pass
+        """)
+        hits = [f for f in findings if f.rule == "silent-except"]
+        assert len(hits) == 2, rules_of(findings)
+
+    def test_outside_package_paths_are_exempt(self):
+        findings = lint("""
+            def poll(q):
+                try:
+                    return q.get()
+                except Exception:
+                    pass
+        """, path="tests/test_x.py")
+        assert "silent-except" not in rules_of(findings)
+
+
+# ------------------------------------------------------------------- budget
+
+class TestWallClockBudget:
+    def test_full_package_lint_under_budget(self):
+        """De-flake guard: all thirteen passes over the full package
+        share one Program build; the whole run must stay well under the
+        pre-commit attention span. Budget is ~12x the observed ~2.5 s
+        to absorb CI-container noise without masking a real regression
+        (an accidental per-rule re-parse would be ~10x alone)."""
+        import time
+
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drlint",
+             "distributed_reinforcement_learning_tpu"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        elapsed = time.monotonic() - t0
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert elapsed < 30.0, f"lint took {elapsed:.1f}s (budget 30s)"
+
+
 class TestRuleRegistry:
-    def test_all_ten_rules_registered(self):
+    def test_all_thirteen_rules_registered(self):
         assert sorted(ALL_RULES) == sorted([
             "jit-purity", "host-sync", "lock-discipline",
             "guardedby-completeness", "nondeterminism",
-            "dtype-pitfall", "blocking-under-lock",
+            "dtype-pitfall", "silent-except", "blocking-under-lock",
             "lock-order", "protocol-contract", "knob-registry",
+            "thread-lifecycle", "resource-lifecycle",
         ])
 
     def test_partial_runs_do_not_misreport_stale_baseline(self, tmp_path):

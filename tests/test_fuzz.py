@@ -7,7 +7,12 @@ hold for ANY router output, not just well-behaved ones).
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property-based tier needs hypothesis; skip where absent")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 import jax
 import jax.numpy as jnp

@@ -217,6 +217,7 @@ def test_impala_actor_trains_via_remote_act():
     finally:
         stop.set()
         queue.close()
+        learner.close()  # joins the async weights-publish worker
         server.stop()
         inference.stop()
         t.join(timeout=5.0)

@@ -273,6 +273,123 @@ class TestGuardedRuntimeSemantics:
         assert not (tmp_path / "sanitize.jsonl").exists()
 
 
+class TestLeakCensus:
+    """ISSUE 17 acceptance: the leak census catches planted leaks —
+    an unjoined thread, an un-unlinked creator segment, an attach-side
+    unlink, an unclosed socket — and stays silent (while still emitting
+    lifecycle evidence) on a clean fixture."""
+
+    def test_planted_thread_and_shm_leak_detected(self, tmp_path):
+        """Daemon thread never joined + creator segment never unlinked.
+        (The thread must be a daemon: CPython joins non-daemon threads
+        BEFORE atexit, so only daemons can be alive when the census's
+        at-exit report runs — which is exactly the leak class that
+        escapes every join.)"""
+        records = run_sanitized(tmp_path, """
+            import threading
+            import time
+            from multiprocessing import shared_memory
+            import distributed_reinforcement_learning_tpu  # installs rt
+
+            t = threading.Thread(target=lambda: time.sleep(60),
+                                 daemon=True)
+            t.start()       # PLANTED: never joined, alive at exit
+
+            shm = shared_memory.SharedMemory(create=True, size=64)
+            shm.close()     # PLANTED: creator closes but never unlinks
+        """)
+        thread_hits = findings(records, "rt-thread-leak")
+        assert len(thread_hits) == 1, findings(records)
+        assert "still alive past owner close" in thread_hits[0]["message"]
+        shm_hits = findings(records, "rt-shm-leak")
+        assert len(shm_hits) == 1, findings(records)
+        assert "never unlinked by its creator" in shm_hits[0]["message"]
+        # SARIF-lite fingerprints: stable recomputation from the
+        # record's own anchor fields, same scheme as static findings.
+        from tools.drlint.rt.sanitizer import fingerprint
+
+        for f in (*thread_hits, *shm_hits):
+            assert f["fingerprint"] == fingerprint(
+                f["rule"], f["file"], f["context"], f["message"]), f
+            assert f["stack"], f  # creation frames, not report frames
+
+    def test_attach_side_unlink_fired_live(self, tmp_path):
+        """The creator-pid contract observed empirically: unlink()
+        through an ATTACH handle is flagged at the call, not at exit."""
+        records = run_sanitized(tmp_path, """
+            from multiprocessing import shared_memory
+            import distributed_reinforcement_learning_tpu
+
+            creator = shared_memory.SharedMemory(create=True, size=64)
+            reader = shared_memory.SharedMemory(name=creator.name)
+            reader.close()
+            reader.unlink()   # PLANTED: attacher unlinks
+            creator.close()
+        """)
+        hits = findings(records, "rt-shm-attach-unlink")
+        assert len(hits) == 1, findings(records)
+        assert "only the creator may unlink" in hits[0]["message"]
+        # The segment WAS unlinked (by the wrong side) — no double
+        # report as an exit-time shm leak.
+        assert not findings(records, "rt-shm-leak"), findings(records)
+
+    def test_planted_socket_leak_detected(self, tmp_path):
+        records = run_sanitized(tmp_path, """
+            import socket
+            import distributed_reinforcement_learning_tpu
+
+            s = socket.socket()   # PLANTED: never closed
+            s.bind(("127.0.0.1", 0))
+        """)
+        hits = findings(records, "rt-socket-leak")
+        assert len(hits) == 1, findings(records)
+        assert "never closed" in hits[0]["message"]
+
+    def test_clean_lifecycles_are_silent_but_evidenced(self, tmp_path):
+        """Joined thread, closed+unlinked creator segment, closed
+        socket: zero findings, but the artifact carries the lifecycle
+        records --reconcile diffs against the static models."""
+        records = run_sanitized(tmp_path, """
+            import socket
+            import threading
+            from multiprocessing import shared_memory
+            import distributed_reinforcement_learning_tpu
+
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            t.join()
+
+            shm = shared_memory.SharedMemory(create=True, size=64)
+            shm.close()
+            shm.unlink()
+
+            s = socket.socket()
+            s.close()
+        """)
+        assert not findings(records), findings(records)
+        life = {r["res"]: r for r in records
+                if r.get("kind") == "lifecycle"}
+        assert set(life) == {"thread", "shm", "socket"}, life
+        assert life["thread"]["joined"] == life["thread"]["n"] == 1
+        assert life["shm"]["ended"] == 1
+        assert life["socket"]["ended"] == 1
+
+    def test_census_gate_off_disables_tracking(self, tmp_path):
+        """DRL_SANITIZE_CENSUS=0: the planted leaks go unreported and
+        no lifecycle records land (the rest of the sanitizer stays on)."""
+        records = run_sanitized(tmp_path, """
+            import threading
+            import time
+            import distributed_reinforcement_learning_tpu
+
+            t = threading.Thread(target=lambda: time.sleep(60),
+                                 daemon=True)
+            t.start()
+        """, extra_env={"DRL_SANITIZE_CENSUS": "0"})
+        assert not findings(records), findings(records)
+        assert not [r for r in records if r.get("kind") == "lifecycle"]
+
+
 class TestReconcile:
     """Static<->dynamic reconciliation over in-memory fixtures (the
     CLI wraps exactly these calls)."""
@@ -300,7 +417,7 @@ class TestReconcile:
         return Program([ModuleInfo(src, "pkg/guarded.py")])
 
     @staticmethod
-    def _artifact(accesses=(), edges=(), findings=()):
+    def _artifact(accesses=(), edges=(), findings=(), lifecycle=()):
         from tools.drlint.rt.reconcile import Artifact
 
         art = Artifact()
@@ -311,6 +428,8 @@ class TestReconcile:
                          "src_site": "x:1", "dst_site": "y:2", "stack": []})
         for f in findings:
             art.consume({"kind": "finding", **f})
+        for r in lifecycle:
+            art.consume({"kind": "lifecycle", **r})
         return art
 
     def test_stale_annotation_detected_and_waivable(self):
@@ -426,12 +545,106 @@ class TestReconcile:
         assert first == [] and second == [], (first, second)
         assert ("Guarded", "items") in waivers
 
+    def test_lifecycle_model_gap_detected(self):
+        """The census observed Guarded acquiring a thread, but the
+        static thread-lifecycle model has no site for it: a resolution
+        blind spot, flagged at the class."""
+        from tools.drlint.rt.reconcile import reconcile
+
+        full = [("Guarded", "items"), ("Guarded", "count")]
+        art = self._artifact(
+            accesses=full,
+            lifecycle=[{"res": "thread", "owner": "Guarded",
+                        "site": "pkg/guarded.py:4", "n": 2, "ended": 2,
+                        "joined": 2}])
+        out = reconcile(art, self._program(), guarded_waivers={},
+                        edge_waivers={}, lifecycle_waivers={})
+        assert [f.rule for f in out] == ["lifecycle-model-gap"], out
+        assert "Guarded" in out[0].message
+        assert "blind spot" in out[0].message
+
+    def test_stale_lifecycle_detected_and_waivable(self):
+        """A class the static model says spawns a thread, never
+        observed by any sanitized run: stale entry, waivable with a
+        justification like the guarded/edge lists."""
+        from tools.drlint.rt.reconcile import reconcile
+
+        extra = """
+            class Spawner:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+
+                def close(self):
+                    self._t.join()
+        """
+        program = self._program(extra)
+        full = [("Guarded", "items"), ("Guarded", "count")]
+        # A module-owned record makes the lifecycle section non-empty
+        # without claiming any class (pre-census artifacts skip the
+        # diff entirely; that silence must not hide stale entries once
+        # the census IS running).
+        art = self._artifact(
+            accesses=full,
+            lifecycle=[{"res": "thread", "owner": "<module>",
+                        "site": "fix.py:1", "n": 1, "ended": 1,
+                        "joined": 1}])
+        out = reconcile(art, program, guarded_waivers={}, edge_waivers={},
+                        lifecycle_waivers={})
+        assert [f.rule for f in out] == ["stale-lifecycle"], out
+        assert "Spawner" in out[0].message and "thread" in out[0].message
+        out = reconcile(art, program, guarded_waivers={}, edge_waivers={},
+                        lifecycle_waivers={("Spawner", "thread"):
+                                           "fixture class, never "
+                                           "constructed by the suites"})
+        assert not out, out
+
+    def test_lifecycle_waiver_hygiene(self):
+        """Waivers rot like any other suppression: one covering an
+        entry this run DID observe and one naming no static entry are
+        both flagged."""
+        from tools.drlint.rt.reconcile import reconcile
+
+        extra = """
+            class Spawner:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+
+                def close(self):
+                    self._t.join()
+        """
+        program = self._program(extra)
+        full = [("Guarded", "items"), ("Guarded", "count")]
+        art = self._artifact(
+            accesses=full,
+            lifecycle=[{"res": "thread", "owner": "Spawner",
+                        "site": "pkg/guarded.py:20", "n": 1, "ended": 1,
+                        "joined": 1}])
+        out = reconcile(
+            art, program, guarded_waivers={}, edge_waivers={},
+            lifecycle_waivers={
+                ("Spawner", "thread"): "observed now, waiver is stale",
+                ("Ghost", "thread"): "names nothing in the tree at all"})
+        rules = [f.rule for f in out]
+        assert rules == ["waiver-hygiene", "waiver-hygiene"], out
+        messages = " | ".join(f.message for f in out)
+        assert "was observed by this run" in messages
+        assert "names no static lifecycle entry" in messages
+
     def test_committed_waivers_validate(self):
         """Every shipped waiver carries a real justification."""
         from tools.drlint.rt import waivers
 
         for subj, why in [*waivers.GUARDED_WAIVERS.items(),
-                          *waivers.EDGE_WAIVERS.items()]:
+                          *waivers.EDGE_WAIVERS.items(),
+                          *waivers.LIFECYCLE_WAIVERS.items()]:
             assert isinstance(why, str) and len(why.strip()) >= 10, subj
 
 

@@ -169,6 +169,8 @@ class _PlainStore:
     """A weight store WITHOUT get_blob: exercises the server's fallback
     encode path (outside `_enc_lock`, double-checked, only-forward)."""
 
+    _GUARDED_BY = {"_params": "_lock", "_version": "_lock"}
+
     def __init__(self):
         self._params = None
         self._version = -1
@@ -300,6 +302,7 @@ class TestDistributedImpala:
         finally:
             stop.set()
             queue.close()
+            learner.close()  # joins the async weights-publish worker
             server.stop()
             t.join(timeout=5.0)
             client.close()

@@ -16,7 +16,36 @@ from dataclasses import dataclass, field
 # Inline suppression: `# drlint: disable=rule-a,rule-b` on the finding's
 # line, or on a comment-only line directly above it (useful when the
 # offending expression is long). Rule ids use the catalog's kebab-case.
-_SUPPRESS_RE = re.compile(r"#\s*drlint:\s*disable=([a-zA-Z0-9_,\- ]+)")
+# A rule may carry a parenthesized justification —
+# `# drlint: disable=silent-except(shutdown race, queue closed)` — and
+# the rules in JUSTIFIED_RULES *require* one (>= 10 chars, the baseline
+# bar): a bare `disable=silent-except` does not suppress.
+_SUPPRESS_TOKEN = r"[a-zA-Z0-9_\-]+(?:\([^()]*\))?"
+_SUPPRESS_RE = re.compile(
+    r"#\s*drlint:\s*disable=\s*(%s(?:\s*,\s*%s)*)"
+    % (_SUPPRESS_TOKEN, _SUPPRESS_TOKEN))
+_SUPPRESS_TOKEN_RE = re.compile(r"([a-zA-Z0-9_\-]+)(?:\(([^()]*)\))?")
+
+# Rules whose suppressions must carry a justification: the suppression
+# IS the documentation (the demote-ladder "permanent, with one log"
+# contract), so an undocumented one is worthless.
+JUSTIFIED_RULES = frozenset({"silent-except"})
+
+MIN_JUSTIFICATION = 10  # chars, the baseline/waiver bar
+
+
+def parse_suppression_tokens(tail: str) -> set[str]:
+    """Rule ids a matched `disable=` tail suppresses, justification
+    hygiene applied: a JUSTIFIED_RULES id with no (or a too-short)
+    parenthesized justification is dropped — the finding still fires."""
+    out: set[str] = set()
+    for m in _SUPPRESS_TOKEN_RE.finditer(tail):
+        rule, just = m.group(1), m.group(2)
+        if rule in JUSTIFIED_RULES and \
+                len((just or "").strip()) < MIN_JUSTIFICATION:
+            continue
+        out.add(rule)
+    return out
 
 # Grandfathered-findings cap: the baseline exists to land the linter on
 # an imperfect tree, not to become a second tree. Ten entries, each with
@@ -243,7 +272,7 @@ class ModuleInfo:
             m = _SUPPRESS_RE.search(line)
             if not m:
                 continue
-            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            rules = parse_suppression_tokens(m.group(1))
             # A comment-only line suppresses the NEXT line; a trailing
             # comment suppresses its own line.
             target = i + 1 if line.lstrip().startswith("#") else i

@@ -22,6 +22,14 @@ process with three checkers and an evidence stream:
    socket/subprocess/shm/long-sleep calls under a held sanitized lock
    are findings; every lock release feeds a per-site hold-time
    histogram, with holds past ``DRL_SANITIZE_HOLD_MS`` flagged.
+4. **Leak census** (``rt-thread-leak`` / ``rt-shm-leak`` /
+   ``rt-shm-attach-unlink`` / ``rt-socket-leak``) — factory hooks
+   register every thread, SharedMemory segment, and socket acquired
+   through package code; the at-exit report flags threads alive past
+   their owner's close, segments the creator never unlinked, attach-
+   side unlinks, and sockets never closed, and streams observed
+   spawn/join + create/unlink pairs as ``lifecycle`` records for
+   ``--reconcile``. Disable with ``DRL_SANITIZE_CENSUS=0``.
 
 Findings and first-seen edges/accesses stream to the JSONL artifact
 named by ``DRL_SANITIZE_OUT`` (fingerprints reuse drlint's SARIF-lite
@@ -51,7 +59,7 @@ def install(out_path: str | None = None):
     GuardedBy import hook (+ retrofit), install the blocking-call
     hooks. Idempotent; returns the process Sanitizer."""
     global _installed
-    from tools.drlint.rt import blocking, guards, locks, sanitizer
+    from tools.drlint.rt import blocking, census, guards, locks, sanitizer
 
     san = sanitizer.activate(out_path=out_path)
     if not _installed:
@@ -59,6 +67,10 @@ def install(out_path: str | None = None):
         locks.install_lock_factories()
         guards.install_guard_hook()
         blocking.install_blocking_hooks()
+        # Last: the census wraps on top of blocking.py's shm wrappers,
+        # and its atexit report (LIFO) must run before the sanitizer's
+        # final count flush.
+        census.install_census_hooks()
     return san
 
 

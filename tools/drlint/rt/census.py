@@ -1,0 +1,366 @@
+"""Leak census: the runtime twin of the lifecycle passes.
+
+Factory hooks register every thread, SharedMemory segment, and socket
+whose creation runs through PACKAGE code (innermost repo frame inside
+``distributed_reinforcement_learning_tpu/`` — or a
+``DRL_SANITIZE_SCOPE`` dir, the planted-fixture opt-in; resources
+created directly by tests or stdlib internals are out of scope, same
+rule as the guardedby checker). At process exit — and on demand, per
+test, via :func:`report` from the sanitize harness — the census walks
+its registries and emits findings through the ordinary
+``Sanitizer.finding`` path (same JSONL artifact, same SARIF-lite
+fingerprints, same suppression comments — aliased to the static
+``thread-lifecycle``/``resource-lifecycle`` ids):
+
+- ``rt-thread-leak`` — a tracked thread still alive past its owner's
+  teardown window (at interpreter exit, CPython has already joined
+  non-daemon threads, so anything alive here is a daemon that outlived
+  every close());
+- ``rt-shm-leak`` — a segment this process CREATED and never unlinked
+  (the creator-pid contract: the launcher's reaper is a crash
+  backstop, not a release path);
+- ``rt-shm-attach-unlink`` — fired LIVE when an attach-side handle
+  calls ``unlink()`` (the contract violation the static pass proves
+  lexically, observed empirically);
+- ``rt-socket-leak`` — a tracked socket still open (``fileno() != -1``)
+  at exit.
+
+Each registry also aggregates into ``kind: "lifecycle"`` summary
+records (resource / owner class / creation site / started vs ended
+counts) — the observed spawn/join and create/unlink pairs
+``--reconcile`` diffs against the static thread/resource models.
+
+Gate: ``DRL_SANITIZE_CENSUS=0`` disables the hooks (census is on by
+default whenever ``DRL_SANITIZE=1``).
+"""
+
+from __future__ import annotations
+
+import _thread
+import atexit
+import functools
+import os
+import socket
+import sys
+import threading
+import weakref
+
+from multiprocessing import shared_memory
+
+from tools.drlint.core import _REPO_ROOT, repo_rel
+from tools.drlint.rt import sanitizer as _san_mod
+from tools.drlint.rt.sanitizer import (
+    _defining_class,
+    _in_repo,
+    _is_rt_frame,
+    _scope_dirs,
+)
+
+_PKG_ROOT = os.path.join(_REPO_ROOT, "distributed_reinforcement_learning_tpu")
+
+_installed = False
+_state = _thread.allocate_lock()  # raw: never instrumented
+
+# Registries. Weakrefs only — the census must never extend a leaked
+# object's lifetime (that would turn a report into a cause).
+_threads: list[dict] = []   # {ref, site, frames, owner, name, daemon, joined}
+_sockets: list[dict] = []   # {ref, site, frames, owner}
+_segments: dict[str, dict] = {}  # seg name -> {creator info, counts}
+
+_tl = threading.local()  # re-entrancy guard for the __init__ wrappers
+
+
+def enabled() -> bool:
+    return os.environ.get("DRL_SANITIZE_CENSUS", "1") != "0"
+
+
+_EXECUTOR_FRAG = os.sep + os.path.join("concurrent", "futures") + os.sep
+
+
+def _creation_site():
+    """(in_scope, 'repo-rel:line', owner class, frames) for the
+    innermost non-rt/non-threading caller frame. In scope = package
+    code or a DRL_SANITIZE_SCOPE dir — the census tracks resources the
+    RUNTIME acquires, not ones tests poke into being directly. The
+    owner class is resolved by walking OUTWARD to the first in-scope
+    frame with a defining class, so an acquisition routed through a
+    module-level helper (``create_or_reclaim_shm``) still attributes to
+    the class whose method called it — the name the static models use."""
+    f = sys._getframe(2)
+    frames: list[tuple[str, int, str]] = []
+    site = None
+    site_scoped = False
+    owner = None
+    while f is not None and len(frames) < 25:
+        path = f.f_code.co_filename
+        if _EXECUTOR_FRAG in path:
+            # Executor-spawned worker: the pool owns its threads
+            # (shutdown() joins them) — out of census scope.
+            return False, "?", None, frames
+        if not _is_rt_frame(path) and not path.endswith("threading.py") \
+                and not path.endswith("weakref.py"):
+            frames.append((path, f.f_lineno, f.f_code.co_name))
+            if _in_repo(path):
+                scoped = path.startswith(_PKG_ROOT + os.sep) or \
+                    any(path.startswith(d + os.sep) for d in _scope_dirs())
+                if site is None:
+                    site = f"{repo_rel(path)}:{f.f_lineno}"
+                    site_scoped = scoped
+                if owner is None and scoped:
+                    owner = _defining_class(f)
+        f = f.f_back
+    if site is None or not site_scoped:
+        return False, "?", None, frames
+    return True, site, owner, frames
+
+
+# -- thread hooks -----------------------------------------------------------
+
+def _wrap_thread_init(orig):
+    @functools.wraps(orig)
+    def wrapper(self, *args, **kwargs):
+        orig(self, *args, **kwargs)
+        if getattr(_tl, "depth", 0):
+            return
+        _tl.depth = 1
+        try:
+            in_scope, site, owner, frames = _creation_site()
+            if not in_scope:
+                return
+            meta = {"ref": weakref.ref(self), "site": site,
+                    "frames": frames, "owner": owner,
+                    "name": getattr(self, "name", "?"),
+                    "daemon": bool(getattr(self, "daemon", False)),
+                    "joined": False}
+            self._drlint_census = meta
+            with _state:
+                _threads.append(meta)
+        finally:
+            _tl.depth = 0
+    wrapper.__wrapped_by_drlint_rt__ = True
+    return wrapper
+
+
+def _wrap_thread_join(orig):
+    @functools.wraps(orig)
+    def wrapper(self, timeout=None):
+        orig(self, timeout)
+        meta = getattr(self, "_drlint_census", None)
+        if meta is not None and not self.is_alive():
+            meta["joined"] = True
+    wrapper.__wrapped_by_drlint_rt__ = True
+    return wrapper
+
+
+# -- shared-memory hooks ----------------------------------------------------
+
+def _wrap_shm_init(orig):
+    @functools.wraps(orig)
+    def wrapper(self, *args, **kwargs):
+        orig(self, *args, **kwargs)
+        if getattr(_tl, "depth", 0):
+            return
+        _tl.depth = 1
+        try:
+            create = bool(kwargs.get("create", False)) or \
+                (len(args) >= 2 and bool(args[1]))
+            in_scope, site, owner, frames = _creation_site()
+            if not in_scope:
+                return
+            name = getattr(self, "name", None) or "?"
+            self._drlint_census = {"name": name, "create": create,
+                                   "owner": owner}
+            with _state:
+                seg = _segments.setdefault(name, {
+                    "created": False, "site": site, "frames": frames,
+                    "owner": owner, "attaches": 0, "unlinked": False,
+                    "closes": 0})
+                if create:
+                    # Creation wins the attribution: the leak (a segment
+                    # left in /dev/shm) belongs to the creator.
+                    seg.update(created=True, site=site, frames=frames,
+                               owner=owner)
+                else:
+                    seg["attaches"] += 1
+        finally:
+            _tl.depth = 0
+    wrapper.__wrapped_by_drlint_rt__ = True
+    return wrapper
+
+
+def _wrap_shm_close(orig):
+    @functools.wraps(orig)
+    def wrapper(self):
+        meta = getattr(self, "_drlint_census", None)
+        if meta is not None:
+            with _state:
+                seg = _segments.get(meta["name"])
+                if seg is not None:
+                    seg["closes"] += 1
+        return orig(self)
+    wrapper.__wrapped_by_drlint_rt__ = True
+    return wrapper
+
+
+def _wrap_shm_unlink(orig):
+    @functools.wraps(orig)
+    def wrapper(self):
+        meta = getattr(self, "_drlint_census", None)
+        if meta is not None:
+            with _state:
+                seg = _segments.get(meta["name"])
+                if seg is not None:
+                    seg["unlinked"] = True
+            if not meta["create"]:
+                san = _san_mod.get()
+                if san is not None:
+                    san.finding(
+                        "rt-shm-attach-unlink",
+                        f"attach-side unlink of shm segment "
+                        f"'{meta['name']}' — only the creator may unlink "
+                        f"(creator-pid contract)",
+                        _san_mod._stack_frames())
+        return orig(self)
+    wrapper.__wrapped_by_drlint_rt__ = True
+    return wrapper
+
+
+# -- socket hooks -----------------------------------------------------------
+
+def _wrap_socket_init(orig):
+    @functools.wraps(orig)
+    def wrapper(self, *args, **kwargs):
+        orig(self, *args, **kwargs)
+        if getattr(_tl, "depth", 0):
+            return
+        _tl.depth = 1
+        try:
+            in_scope, site, owner, frames = _creation_site()
+            if not in_scope:
+                return
+            with _state:
+                _sockets.append({"ref": weakref.ref(self), "site": site,
+                                 "frames": frames, "owner": owner})
+        finally:
+            _tl.depth = 0
+    wrapper.__wrapped_by_drlint_rt__ = True
+    return wrapper
+
+
+# -- the census report ------------------------------------------------------
+
+def _owner_label(owner: str | None) -> str:
+    return owner if owner else "<module>"
+
+
+def report(final: bool = True) -> int:
+    """Walk the registries, emit leak findings + lifecycle summaries.
+    Returns the number of leaks found. Called at interpreter exit
+    (after CPython joined non-daemon threads — anything alive is a
+    daemon that outlived its owner's close) and per-test by the
+    sanitize harness with final=False (no lifecycle records, keeps
+    counting)."""
+    san = _san_mod.get()
+    if san is None:
+        return 0
+    leaks = 0
+    me = threading.current_thread()
+    with _state:
+        threads = list(_threads)
+        sockets = list(_sockets)
+        segments = {k: dict(v) for k, v in _segments.items()}
+    for meta in threads:
+        t = meta["ref"]()
+        if t is None or t is me or not t.is_alive():
+            continue
+        leaks += 1
+        san.finding(
+            "rt-thread-leak",
+            f"thread '{meta['name']}' (owner "
+            f"{_owner_label(meta['owner'])}, started at {meta['site']}) "
+            f"still alive past owner close"
+            + (" at process exit" if final else ""),
+            meta["frames"])
+    for name, seg in segments.items():
+        if seg["created"] and not seg["unlinked"]:
+            leaks += 1
+            san.finding(
+                "rt-shm-leak",
+                f"shm segment '{name}' created by "
+                f"{_owner_label(seg['owner'])} at {seg['site']} was "
+                f"never unlinked by its creator",
+                seg["frames"])
+    for meta in sockets:
+        s = meta["ref"]()
+        open_now = False
+        try:
+            open_now = s is not None and s.fileno() != -1
+        except OSError:
+            open_now = False
+        if not open_now:
+            continue
+        leaks += 1
+        san.finding(
+            "rt-socket-leak",
+            f"socket opened by {_owner_label(meta['owner'])} at "
+            f"{meta['site']} never closed",
+            meta["frames"])
+    if final:
+        _emit_lifecycle(san, threads, sockets, segments)
+    return leaks
+
+
+def _emit_lifecycle(san, threads, sockets, segments) -> None:
+    """Aggregate per (resource, owner, site): observed start/end pairs
+    for --reconcile's lifecycle diff."""
+    agg: dict[tuple[str, str, str], dict] = {}
+    for meta in threads:
+        key = ("thread", _owner_label(meta["owner"]), meta["site"])
+        a = agg.setdefault(key, {"n": 0, "ended": 0, "joined": 0})
+        a["n"] += 1
+        t = meta["ref"]()
+        if t is None or not t.is_alive():
+            a["ended"] += 1
+        if meta["joined"]:
+            a["joined"] += 1
+    for meta in sockets:
+        key = ("socket", _owner_label(meta["owner"]), meta["site"])
+        a = agg.setdefault(key, {"n": 0, "ended": 0})
+        a["n"] += 1
+        s = meta["ref"]()
+        try:
+            if s is None or s.fileno() == -1:
+                a["ended"] += 1
+        except OSError:
+            a["ended"] += 1
+    for name, seg in segments.items():
+        key = ("shm", _owner_label(seg["owner"]), seg["site"])
+        a = agg.setdefault(key, {"n": 0, "ended": 0, "attaches": 0})
+        a["n"] += 1
+        if seg["unlinked"] or not seg["created"]:
+            a["ended"] += 1
+        a["attaches"] += seg["attaches"]
+    for (res, owner, site), a in sorted(agg.items()):
+        san._emit({"kind": "lifecycle", "res": res, "owner": owner,
+                   "site": site, **a})
+
+
+def install_census_hooks() -> None:
+    global _installed
+    if _installed or not enabled():
+        return
+    _installed = True
+    threading.Thread.__init__ = _wrap_thread_init(threading.Thread.__init__)
+    threading.Thread.join = _wrap_thread_join(threading.Thread.join)
+    shared_memory.SharedMemory.__init__ = _wrap_shm_init(
+        shared_memory.SharedMemory.__init__)
+    shared_memory.SharedMemory.close = _wrap_shm_close(
+        shared_memory.SharedMemory.close)
+    shared_memory.SharedMemory.unlink = _wrap_shm_unlink(
+        shared_memory.SharedMemory.unlink)
+    socket.socket.__init__ = _wrap_socket_init(socket.socket.__init__)
+    # Registered AFTER the Sanitizer's own atexit flushes (activate()
+    # precedes hook installs in rt.install): LIFO ordering runs the
+    # census first, so its finding_count/lifecycle records still land
+    # in the artifact before the final flush.
+    atexit.register(report)

@@ -17,6 +17,14 @@ this module closes the loop in both directions:
 - **rt finding replay** — every distinct runtime finding recorded in
   the artifact is surfaced again (deduped by fingerprint, with a
   count), so `--reconcile` is a one-stop gate for a sanitized run.
+- **lifecycle diff** — the census's observed spawn/join and
+  create/unlink pairs (``lifecycle`` records) against the static
+  thread/resource models: an observed owner the static pass has no
+  site for is a ``lifecycle-model-gap`` (resolution blind spot —
+  exactly where an unjoined thread could hide from lint); a static
+  owner no sanitized run ever observed is ``stale-lifecycle`` (dead
+  code or a suite gap), waivable in ``LIFECYCLE_WAIVERS``. Skipped
+  for pre-census artifacts (no ``lifecycle`` records).
 - **waiver hygiene** — a waiver whose subject was actually observed
   (or that names an unknown entry), or whose justification is shorter
   than 10 chars, is itself a finding: the list can only shrink.
@@ -47,6 +55,8 @@ _PKG = "distributed_reinforcement_learning_tpu"
 STALE_RULE = "stale-annotation"
 GAP_RULE = "model-gap"
 WAIVER_RULE = "waiver-hygiene"
+LIFE_GAP_RULE = "lifecycle-model-gap"
+LIFE_STALE_RULE = "stale-lifecycle"
 
 Node = tuple[str, str]
 
@@ -60,6 +70,7 @@ class Artifact:
     edges: list[dict] = field(default_factory=list)
     accesses: set[tuple[str, str]] = field(default_factory=set)
     holds: dict[str, dict] = field(default_factory=dict)
+    lifecycle: list[dict] = field(default_factory=list)
     pids: set[int] = field(default_factory=set)
 
     @classmethod
@@ -105,6 +116,8 @@ class Artifact:
             self.edges.append(r)
         elif kind == "access":
             self.accesses.add((r.get("cls", ""), r.get("attr", "")))
+        elif kind == "lifecycle":
+            self.lifecycle.append(r)
         elif kind == "hold":
             h = self.holds.setdefault(
                 r.get("site", "?"),
@@ -211,26 +224,32 @@ class _Normalizer:
 
 def reconcile(artifact: Artifact, program: Program,
               guarded_waivers: dict | None = None,
-              edge_waivers: dict | None = None) -> list[Finding]:
+              edge_waivers: dict | None = None,
+              lifecycle_waivers: dict | None = None) -> list[Finding]:
     """The full diff -> drlint Findings (renderable/JSON-able like any
     static pass's)."""
-    if guarded_waivers is None or edge_waivers is None:
+    if guarded_waivers is None or edge_waivers is None \
+            or lifecycle_waivers is None:
         from tools.drlint.rt import waivers as _w
         guarded_waivers = _w.GUARDED_WAIVERS if guarded_waivers is None \
             else guarded_waivers
         edge_waivers = _w.EDGE_WAIVERS if edge_waivers is None \
             else edge_waivers
+        lifecycle_waivers = _w.LIFECYCLE_WAIVERS if lifecycle_waivers \
+            is None else lifecycle_waivers
     # Always copy: entries are consumed (pop) below, and a caller-owned
     # dict — including the module-level waiver maps — must survive a
     # second reconcile() in the same process.
     guarded_waivers = dict(guarded_waivers)
     edge_waivers = dict(edge_waivers)
+    lifecycle_waivers = dict(lifecycle_waivers)
     findings: list[Finding] = []
     norm = _Normalizer(program)
 
     # 0. Waiver justifications validated up front (before entries are
     #    consumed below) — the lint-baseline contract, same bar.
-    for subj, why in [*guarded_waivers.items(), *edge_waivers.items()]:
+    for subj, why in [*guarded_waivers.items(), *edge_waivers.items(),
+                      *lifecycle_waivers.items()]:
         if not isinstance(why, str) or len(why.strip()) < 10:
             findings.append(Finding(
                 rule=WAIVER_RULE, path="tools/drlint/rt/waivers.py", line=1,
@@ -333,7 +352,85 @@ def reconcile(artifact: Artifact, program: Program,
                 rule=WAIVER_RULE, path="tools/drlint/rt/waivers.py", line=1,
                 message=f"edge waiver {key} names no statically-known "
                         f"lock owner — remove or update it", context=""))
+
+    # 5. Lifecycle: observed spawn/create owners vs the static
+    #    thread/resource models. Gated on the artifact actually carrying
+    #    census records — pre-census artifacts (or DRL_SANITIZE_CENSUS=0
+    #    runs) reconcile exactly as before.
+    if artifact.lifecycle:
+        findings.extend(_lifecycle_diff(artifact, program, norm,
+                                        lifecycle_waivers))
     findings.sort(key=lambda f: (f.rule, f.path, f.line, f.message))
+    return findings
+
+
+def static_lifecycle(program: Program) -> dict[tuple[str, str], tuple]:
+    """(ClassName, res) -> (module, class node) for every class the
+    static lifecycle passes model as owning a thread / shm segment /
+    socket — the claims the census's observed records must meet."""
+    from tools.drlint.rules.resource_lifecycle import build_resource_model
+    from tools.drlint.rules.thread_lifecycle import build_thread_model
+
+    out: dict[tuple[str, str], tuple] = {}
+    for cname, info in build_thread_model(program).items():
+        out.setdefault((cname, "thread"), (info["mod"], info["cls"].node))
+    for cname, info in build_resource_model(program).items():
+        kinds = {k for (k, _node, _meth) in info["attrs"].values()}
+        kinds.update(k for (_fn, _node, k, _name) in info["local_sites"])
+        loc = (info["mod"], info["cls"].node)
+        if any(k.startswith("shm") for k in kinds):
+            out.setdefault((cname, "shm"), loc)
+        if "socket" in kinds:
+            out.setdefault((cname, "socket"), loc)
+    return out
+
+
+def _lifecycle_diff(artifact: Artifact, program: Program,
+                    norm: _Normalizer, lifecycle_waivers: dict
+                    ) -> list[Finding]:
+    findings: list[Finding] = []
+    static_life = static_lifecycle(program)
+    observed: set[tuple[str, str]] = set()
+    gap_seen: set[tuple[str, str]] = set()
+    for rec in artifact.lifecycle:
+        owner = rec.get("owner") or "<module>"
+        res = rec.get("res", "?")
+        observed.add((owner, res))
+        if owner not in norm.classes:
+            continue  # module-level or fixture-owned: no class model
+        if (owner, res) in static_life or (owner, res) in gap_seen:
+            continue
+        gap_seen.add((owner, res))
+        cls = norm.classes[owner]
+        findings.append(cls.mod.finding(
+            LIFE_GAP_RULE, cls.node,
+            f"runtime observed {owner} acquiring a {res} (at "
+            f"{rec.get('site', '?')}) that the static {res} lifecycle "
+            f"model has no site for — the lifecycle pass has a "
+            f"resolution blind spot here, exactly where an unjoined "
+            f"thread or leaked segment could hide from lint"))
+    for (owner, res), (mod, node) in sorted(static_life.items()):
+        if (owner, res) in observed:
+            continue
+        if lifecycle_waivers.pop((owner, res), None) is not None:
+            continue
+        findings.append(mod.finding(
+            LIFE_STALE_RULE, node,
+            f"static lifecycle model says {owner} owns a {res} but no "
+            f"sanitized run ever observed it acquire one: dead code or "
+            f"a suite gap — fix or waive in tools/drlint/rt/waivers.py"))
+    # Leftover-waiver hygiene, same bar as the guarded/edge lists.
+    for (owner, res), _why in sorted(lifecycle_waivers.items()):
+        status = ("was observed by this run"
+                  if (owner, res) in observed else
+                  "names no static lifecycle entry"
+                  if (owner, res) not in static_life else None)
+        if status is None:
+            continue
+        findings.append(Finding(
+            rule=WAIVER_RULE, path="tools/drlint/rt/waivers.py", line=1,
+            message=f"lifecycle waiver ({owner}, {res}) {status} — "
+                    f"remove it", context=""))
     return findings
 
 
@@ -350,6 +447,7 @@ def main(artifact_path: str, paths: list[str] | None,
         "guarded_total": len(claims),
         "guarded_exercised": exercised,
         "edges_observed": len(art.edges),
+        "lifecycle_observed": len(art.lifecycle),
         "processes": len(art.pids),
     }
     if as_json:

@@ -47,7 +47,7 @@ import threading
 import time
 import traceback
 
-from tools.drlint.core import _REPO_ROOT, repo_rel
+from tools.drlint.core import _REPO_ROOT, parse_suppression_tokens, repo_rel
 
 _RT_DIR = os.path.dirname(os.path.abspath(__file__))
 
@@ -60,9 +60,19 @@ SUPPRESSION_ALIASES = {
     "rt-guardedby": ("lock-discipline",),
     "rt-blocking": ("blocking-under-lock",),
     "rt-hold": ("blocking-under-lock",),
+    # Leak-census rules (rt/census.py): the static lifecycle passes'
+    # suppressions silence their runtime twins.
+    "rt-thread-leak": ("thread-lifecycle",),
+    "rt-shm-leak": ("resource-lifecycle",),
+    "rt-shm-attach-unlink": ("resource-lifecycle",),
+    "rt-socket-leak": ("resource-lifecycle",),
 }
 
-_SUPPRESS_RE = re.compile(r"#\s*drlint:\s*disable=([a-zA-Z0-9_,\- ]+)")
+# Same grammar as core._SUPPRESS_RE, parsed by the shared token parser
+# (justification hygiene included) so the two halves never drift.
+_SUPPRESS_RE = re.compile(
+    r"#\s*drlint:\s*disable=\s*([a-zA-Z0-9_\-]+(?:\([^()]*\))?"
+    r"(?:\s*,\s*[a-zA-Z0-9_\-]+(?:\([^()]*\))?)*)")
 
 
 def _hold_threshold_ms() -> float:
@@ -122,7 +132,7 @@ class _SuppressionCache:
             m = _SUPPRESS_RE.search(line)
             if not m:
                 continue
-            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            rules = parse_suppression_tokens(m.group(1))
             target = i + 1 if line.lstrip().startswith("#") else i
             out.setdefault(target, set()).update(rules)
         return out

@@ -22,6 +22,9 @@ from tools.drlint.rules.lock_discipline import check as _lock_discipline
 from tools.drlint.rules.lock_order import check as _lock_order
 from tools.drlint.rules.nondeterminism import check as _nondeterminism
 from tools.drlint.rules.protocol_contract import check as _protocol_contract
+from tools.drlint.rules.resource_lifecycle import check as _resource_lifecycle
+from tools.drlint.rules.silent_except import check as _silent_except
+from tools.drlint.rules.thread_lifecycle import check as _thread_lifecycle
 
 RULES = {
     "jit-purity": _jit_purity,
@@ -30,6 +33,7 @@ RULES = {
     "guardedby-completeness": _guardedby_completeness,
     "nondeterminism": _nondeterminism,
     "dtype-pitfall": _dtype_pitfall,
+    "silent-except": _silent_except,
 }
 
 PROGRAM_RULES = {
@@ -37,6 +41,8 @@ PROGRAM_RULES = {
     "lock-order": _lock_order,
     "protocol-contract": _protocol_contract,
     "knob-registry": _knob_registry,
+    "thread-lifecycle": _thread_lifecycle,
+    "resource-lifecycle": _resource_lifecycle,
 }
 
 ALL_RULES = {**RULES, **PROGRAM_RULES}

@@ -1,0 +1,161 @@
+"""Shared machinery for the lifecycle family (thread-lifecycle,
+resource-lifecycle).
+
+Both passes answer the same shape of question: "does every acquisition
+site in this class reach its release on a stop path?" — where a *stop
+path* is any method reachable, transitively through same-class calls,
+from one of the teardown entry points the runtime actually uses
+(`close`/`stop`/`shutdown`/`drain`/`join`/`terminate`/`abort`,
+`__exit__`, `__del__`, and their `close_producer`-style variants).
+
+This module owns:
+
+- the stop-entry name test and the transitive stop-reachable method
+  set (resolved over the inheritance-merged class model, the same
+  `rules/_locks.py` machinery lock-order resolves calls with);
+- per-method alias maps: locals copied from `self.X` (`t = self._thread`,
+  `threads = list(self._threads)`) and for-loop variables iterating a
+  container attribute — so `for t in threads: t.join()` proves the join
+  of `self._threads`;
+- the shared merged-class memo on `Program._cache`, so the lifecycle
+  passes piggyback on ONE class-model build per lint invocation (the
+  de-flake contract: program passes never re-derive global facts).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Program
+from tools.drlint.rules._locks import (
+    ClassModel,
+    _self_attr,
+    merged_class,
+    program_classes,
+)
+
+# Substrings that make a method a teardown ENTRY point. Matching is by
+# substring so the repo's close_producer/close_consumer/close_metrics/
+# stop_all variants all count without a per-name registry.
+_STOP_STEMS = ("close", "stop", "shutdown", "drain", "join", "terminate",
+               "abort", "unlink")
+_STOP_EXACT = ("__exit__", "__del__")
+
+
+def is_stop_entry(name: str) -> bool:
+    return name in _STOP_EXACT or any(s in name for s in _STOP_STEMS)
+
+
+def merged(program: Program, name: str) -> ClassModel | None:
+    """Inheritance-merged class model, memoized per Program so the two
+    lifecycle passes (and reconcile) share one merge per class."""
+    memo = program._cache.setdefault("lifecycle_merged", {})
+    if name not in memo:
+        cls = program_classes(program).get(name)
+        memo[name] = None if cls is None else merged_class(program, cls)
+    return memo[name]
+
+
+def stop_reachable(program: Program, cls: ClassModel) -> set[str]:
+    """Method names of `cls` (merged view) reachable from a stop entry
+    via `self.m()` calls — the set in which a `.join()`/`.close()`
+    proves teardown actually runs."""
+    memo = program._cache.setdefault("lifecycle_reachable", {})
+    if cls.name in memo:
+        return memo[cls.name]
+    # self.m() call edges within the (merged) class.
+    calls: dict[str, set[str]] = {}
+    for name, fn in cls.methods.items():
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in ("self", "cls") and \
+                    node.func.attr in cls.methods:
+                out.add(node.func.attr)
+        calls[name] = out
+    reach = {m for m in cls.methods if is_stop_entry(m)}
+    frontier = list(reach)
+    while frontier:
+        cur = frontier.pop()
+        for nxt in calls.get(cur, ()):
+            if nxt not in reach:
+                reach.add(nxt)
+                frontier.append(nxt)
+    memo[cls.name] = reach
+    return reach
+
+
+def _copy_source_attr(value: ast.AST) -> str | None:
+    """Attr name when `value` is `self.X` or a shallow copy of it
+    (`list(self.X)`, `tuple(self.X)`, `sorted(self.X)`, `self.X[:]`,
+    `list(self.X.values())`) — the idiom every stop path here uses to
+    snapshot a thread list under its lock before joining outside it."""
+    attr = _self_attr(value)
+    if attr is not None:
+        return attr
+    if isinstance(value, ast.Call) and isinstance(value.func, ast.Name) and \
+            value.func.id in ("list", "tuple", "sorted", "set") and \
+            len(value.args) == 1:
+        inner = value.args[0]
+        attr = _self_attr(inner)
+        if attr is not None:
+            return attr
+        # list(self.X.values()) / list(self.X.items())
+        if isinstance(inner, ast.Call) and \
+                isinstance(inner.func, ast.Attribute) and \
+                inner.func.attr in ("values", "items", "keys"):
+            return _self_attr(inner.func.value)
+    if isinstance(value, ast.Subscript):  # self.X[:]
+        return _self_attr(value.value)
+    return None
+
+
+def method_aliases(fn: ast.FunctionDef) -> dict[str, str]:
+    """local name -> self attribute it aliases, within one method:
+    direct copies (`t = self._thread`, `ts = list(self._threads)`) and
+    for-loop variables over an attribute or an aliased copy
+    (`for t in threads:` after `threads = list(self._threads)`)."""
+    out: dict[str, str] = {}
+    # Two passes: ast.walk is breadth-first, so a top-level `for t in
+    # threads:` is visited BEFORE the `threads = list(self._threads)`
+    # nested in a `with` block above it — collect all copies first.
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            src = _copy_source_attr(node.value)
+            if src is not None:
+                out[node.targets[0].id] = src
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor)) and \
+                isinstance(node.target, ast.Name):
+            src = _copy_source_attr(node.iter)
+            if src is None and isinstance(node.iter, ast.Name):
+                src = out.get(node.iter.id)
+            if src is not None:
+                out[node.target.id] = src
+    return out
+
+
+def attr_calls(fn: ast.FunctionDef, method: str,
+               aliases: dict[str, str] | None = None) -> set[str]:
+    """Self attributes on which `.method()` is called anywhere in `fn`,
+    aliases resolved: `self.X.join()` -> {'X'}; with aliases,
+    `t.join()` after `t = self._thread` (or a loop over the container)
+    also -> the attr."""
+    if aliases is None:
+        aliases = method_aliases(fn)
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr == method):
+            continue
+        recv = node.func.value
+        attr = _self_attr(recv)
+        if attr is not None:
+            out.add(attr)
+        elif isinstance(recv, ast.Name) and recv.id in aliases:
+            out.add(aliases[recv.id])
+    return out

@@ -1,0 +1,377 @@
+"""resource-lifecycle: every acquired OS resource must have a provable
+release owner — and the RIGHT owner.
+
+Acquisition sites recognized (module-alias resolved):
+
+- **SharedMemory** — `SharedMemory(create=True, ...)` and the repo's
+  `create_or_reclaim_shm(...)` helper are CREATE sites;
+  `SharedMemory(name=...)` / `attach_shm(...)` are ATTACH sites. The
+  PR 9 creator-pid contract applies: the creator must reach both
+  `close()` and `unlink()`; an attacher must reach `close()` and must
+  NOT reach `unlink()` — an attach-side unlink destroys a segment the
+  creator still owns, and is reported wherever it appears. The
+  launcher's pid-keyed reaper is a crash backstop, not a release path:
+  it never substitutes for the in-process close/unlink pair.
+- **sockets** — `socket.socket(...)` / `socket.create_connection(...)`
+  must reach `close()` (or `shutdown`/`detach`).
+- **files** — builtin `open(...)`, `Path.open(...)`, `os.fdopen(...)`,
+  `tempfile.NamedTemporaryFile/TemporaryFile` must reach `close()`.
+
+Ownership and proof mirror thread-lifecycle (rules/_lifecycle.py):
+class-owned attributes (`self.X = acquire()`, directly or through a
+local) need the release reachable from a stop entry
+(`close`/`stop`/`shutdown`/`__exit__`/...) over the merged class
+model — either called on the attribute, or the attribute passed to a
+callee whose name says it releases (`*close*`/`*unlink*`/`*destroy*`),
+or a class-level `atexit.register` hook. Function-locals are fine when
+used as context managers (`with open(...) as f:`), released in the
+same function, or escaping (returned/yielded/passed on — the new
+owner's scope is judged there). Flow-insensitivity is the deliberate
+trade: a release anywhere in the owning scope counts, and the runtime
+leak census (rt/census.py) catches the paths that dodge it in
+practice.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Finding, ModuleInfo, Program
+from tools.drlint.rules._lifecycle import (
+    attr_calls,
+    merged,
+    method_aliases,
+    stop_reachable,
+)
+from tools.drlint.rules._locks import _self_attr, module_model
+
+RULE = "resource-lifecycle"
+
+# kind -> (verbs that count as release, verbs forbidden for this kind)
+_RELEASE = {
+    "shm-create": {"close", "unlink"},   # BOTH required (checked apart)
+    "shm-attach": {"close", "detach"},
+    "socket": {"close", "shutdown", "detach"},
+    "file": {"close"},
+}
+_CALLEE_RELEASE_STEMS = ("close", "unlink", "destroy", "shutdown",
+                         "release", "cleanup")
+
+_FILE_CHAINS = {"os.fdopen", "tempfile.NamedTemporaryFile",
+                "tempfile.TemporaryFile", "io.open", "gzip.open"}
+_SOCKET_CHAINS = {"socket.socket", "socket.create_connection"}
+_SHM_TAIL = "SharedMemory"
+
+
+def _shm_create_kw(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "create" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _acquisition_kind(mod: ModuleInfo, node: ast.AST) -> str | None:
+    if not isinstance(node, ast.Call):
+        return None
+    chain = mod.resolve_chain(node.func)
+    if chain in _SOCKET_CHAINS:
+        return "socket"
+    if chain in _FILE_CHAINS:
+        return "file"
+    if chain is not None and chain.rsplit(".", 1)[-1] == _SHM_TAIL:
+        return "shm-create" if _shm_create_kw(node) else "shm-attach"
+    name = node.func.id if isinstance(node.func, ast.Name) else \
+        node.func.attr if isinstance(node.func, ast.Attribute) else None
+    if name == "open":
+        # builtin open() or Path.open() — both hand back a closeable.
+        return "file"
+    if name == "attach_shm":
+        return "shm-attach"
+    if name in ("create_or_reclaim_shm", "create_shm"):
+        return "shm-create"
+    if name == _SHM_TAIL:
+        return "shm-create" if _shm_create_kw(node) else "shm-attach"
+    return None
+
+
+def _under_with(mod: ModuleInfo, node: ast.AST) -> bool:
+    cur = mod.parents.get(node)
+    while cur is not None and not isinstance(cur, ast.stmt):
+        if isinstance(cur, ast.withitem):
+            return True
+        cur = mod.parents.get(cur)
+    return False
+
+
+def _enclosing_stmt(mod: ModuleInfo, node: ast.AST) -> ast.stmt | None:
+    cur: ast.AST | None = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = mod.parents.get(cur)
+    return cur  # type: ignore[return-value]
+
+
+def _local_self_stores(fn: ast.AST, name: str) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and node.value.id == name:
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+def _local_released(fn: ast.AST, name: str, verbs: set[str]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in verbs and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == name:
+            return True
+    return False
+
+
+def _local_escapes(fn: ast.AST, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Return, ast.Yield)):
+            v = node.value
+            if isinstance(v, ast.Name) and v.id == name:
+                return True
+            # return (shm, created) — tuple escapes too
+            if isinstance(v, (ast.Tuple, ast.List)) and any(
+                    isinstance(e, ast.Name) and e.id == name
+                    for e in v.elts):
+                return True
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == name:
+                continue  # f.read() — a use, not an escape
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    return True
+        # stored into a container/dict: self._segs[k] = shm, d[k] = shm
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and node.value.id == name:
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+                    return True
+    return False
+
+
+def _class_atexit(cls) -> bool:
+    for fn in cls.methods.values():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "register" and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "atexit":
+                return True
+    return False
+
+
+def _callee_released_attrs(fn: ast.AST, stems=_CALLEE_RELEASE_STEMS
+                           ) -> set[str]:
+    """Self attrs passed as an argument to a callee whose name claims a
+    release (`_destroy_segment(self._shm)`, `shutil_close(self._f)`)."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = node.func.id if isinstance(node.func, ast.Name) else \
+            node.func.attr if isinstance(node.func, ast.Attribute) else ""
+        if not any(s in fname for s in stems):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            attr = _self_attr(arg)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+def build_resource_model(program: Program) -> dict[str, dict]:
+    """Per owning class: attr -> acquisition kind, plus the release
+    verbs provably reachable from stop entries. Cached on
+    Program._cache; shared with --reconcile's lifecycle diff."""
+    cached = program._cache.get("resource_model")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    model: dict[str, dict] = {}
+    for mod in program.modules:
+        for cname, cls in module_model(mod).classes.items():
+            attrs: dict[str, tuple] = {}  # attr -> (kind, call node)
+            local_sites: list[tuple] = []  # (method fn, call, kind, name)
+            for meth in cls.node.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for node in ast.walk(meth):
+                    kind = _acquisition_kind(mod, node)
+                    if kind is None or _under_with(mod, node):
+                        continue
+                    stmt = _enclosing_stmt(mod, node)
+                    if isinstance(stmt, ast.Assign) and \
+                            len(stmt.targets) == 1:
+                        tgt = stmt.targets[0]
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            attrs.setdefault(attr, (kind, node, meth))
+                            continue
+                        if isinstance(tgt, ast.Name):
+                            stores = _local_self_stores(meth, tgt.id)
+                            if stores:
+                                attrs.setdefault(sorted(stores)[0],
+                                                 (kind, node, meth))
+                            else:
+                                local_sites.append((meth, node, kind,
+                                                    tgt.id))
+                            continue
+                        # self._segs[k] = SharedMemory(...) — container
+                        # ownership; the census owns the empirical check.
+                        continue
+                    local_sites.append((meth, node, kind, None))
+            if not attrs and not local_sites:
+                continue
+            m = merged(program, cname)
+            if m is None or m.node is not cls.node:
+                m = cls
+            reach = stop_reachable(program, m)
+            released: dict[str, set[str]] = {}
+            unlinked_anywhere: dict[str, ast.AST] = {}
+            for mname, fn in m.methods.items():
+                aliases = method_aliases(fn)
+                for a in attr_calls(fn, "unlink", aliases):
+                    unlinked_anywhere.setdefault(
+                        a, next((n for n in ast.walk(fn)
+                                 if isinstance(n, ast.Call)
+                                 and isinstance(n.func, ast.Attribute)
+                                 and n.func.attr == "unlink"), fn))
+                if mname not in reach:
+                    continue
+                for verb in ("close", "unlink", "detach", "shutdown",
+                             "terminate"):
+                    for a in attr_calls(fn, verb, aliases):
+                        released.setdefault(a, set()).add(verb)
+                for a in _callee_released_attrs(fn):
+                    released.setdefault(a, set()).update(
+                        ("close", "unlink"))
+            model[cname] = {
+                "mod": mod, "cls": m, "attrs": attrs,
+                "local_sites": local_sites, "released": released,
+                "unlinked": unlinked_anywhere,
+                "atexit": _class_atexit(m),
+            }
+    program._cache["resource_model"] = model
+    return model
+
+
+def _check_local(mod: ModuleInfo, fn, findings: list,
+                 sites: list | None = None) -> None:
+    """Function-local acquisitions: with-managed, released in-function,
+    or escaping — anything else is a leak-by-construction."""
+    if sites is None:
+        sites = []
+        for node in ast.walk(fn):
+            kind = _acquisition_kind(mod, node)
+            if kind is None or _under_with(mod, node):
+                continue
+            stmt = _enclosing_stmt(mod, node)
+            name = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if _self_attr(tgt) is not None:
+                    continue
+                if isinstance(tgt, ast.Name):
+                    if _local_self_stores(fn, tgt.id):
+                        continue
+                    name = tgt.id
+                else:
+                    continue  # container store: census territory
+            elif not isinstance(stmt, (ast.Expr, ast.Return)):
+                continue
+            sites.append((fn, node, kind, name))
+    for owner_fn, node, kind, name in sites:
+        if name is None:
+            # Anonymous: `return open(p)` escapes; a bare-Expr
+            # acquisition can never be released.
+            stmt = _enclosing_stmt(mod, node)
+            if isinstance(stmt, ast.Return) or \
+                    isinstance(mod.parents.get(node), (ast.Return,
+                                                       ast.Yield)):
+                continue
+            if isinstance(stmt, ast.Expr) and stmt.value is node:
+                findings.append(mod.finding(
+                    RULE, node,
+                    f"{kind} acquired and immediately dropped — nothing "
+                    f"holds a reference to release it"))
+            continue
+        verbs = _RELEASE[kind]
+        if kind == "shm-attach" and _local_released(owner_fn, name,
+                                                    {"unlink"}):
+            findings.append(mod.finding(
+                RULE, node,
+                f"attached SharedMemory '{name}' is unlinked in this "
+                f"scope — only the creator may unlink (creator-pid "
+                f"contract); attachers close()"))
+        if _local_released(owner_fn, name, verbs):
+            if kind == "shm-create" and not _local_released(
+                    owner_fn, name, {"unlink"}) and not \
+                    _local_escapes(owner_fn, name):
+                findings.append(mod.finding(
+                    RULE, node,
+                    f"created SharedMemory '{name}' is closed but never "
+                    f"unlinked here and never escapes — the segment "
+                    f"outlives the process"))
+            continue
+        if _local_escapes(owner_fn, name):
+            continue
+        findings.append(mod.finding(
+            RULE, node,
+            f"{kind} '{name}' is never released in this function and "
+            f"never escapes it — close it (with-statement, explicit "
+            f"close, or hand it to an owner with a stop path)"))
+
+
+def check(program: Program) -> list[Finding]:
+    findings: list[Finding] = []
+    model = build_resource_model(program)
+    for cname, info in sorted(model.items()):
+        mod = info["mod"]
+        released, unlinked = info["released"], info["unlinked"]
+        for attr, (kind, node, meth) in sorted(info["attrs"].items()):
+            got = released.get(attr, set())
+            if kind == "shm-attach" and attr in unlinked:
+                findings.append(mod.finding(
+                    RULE, unlinked[attr],
+                    f"{cname} attaches SharedMemory '{attr}' but calls "
+                    f"unlink() on it — only the creator may unlink "
+                    f"(creator-pid contract); attachers close()"))
+            if info["atexit"]:
+                continue
+            if not got & _RELEASE[kind]:
+                findings.append(mod.finding(
+                    RULE, node,
+                    f"{kind} '{attr}' of {cname} has no reachable "
+                    f"release ({'/'.join(sorted(_RELEASE[kind]))}) on "
+                    f"any close()/stop()/__exit__ path"))
+            elif kind == "shm-create" and "unlink" not in got:
+                findings.append(mod.finding(
+                    RULE, node,
+                    f"created SharedMemory '{attr}' of {cname} is "
+                    f"closed but never unlinked on any stop path — the "
+                    f"creator owns the unlink (the pid-keyed reaper is "
+                    f"a crash backstop, not a release path)"))
+        if info["local_sites"]:
+            by_fn: dict[int, list] = {}
+            for site in info["local_sites"]:
+                by_fn.setdefault(id(site[0]), []).append(site)
+            for sites in by_fn.values():
+                _check_local(mod, sites[0][0], findings, sites)
+    for mod in program.modules:
+        for fn in module_model(mod).functions.values():
+            _check_local(mod, fn, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings

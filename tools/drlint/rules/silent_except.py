@@ -1,0 +1,125 @@
+"""silent-except: broad handlers must be LOUD — raise, log, count, or
+carry a justification.
+
+The repo's failure-path convention (the demote ladder: "permanent,
+with one log") says a broad `except` is only acceptable when the
+failure leaves a trace. This pass enforces it for every BROAD handler
+in package code — bare `except:`, `except Exception`, `except
+BaseException`, alone or in a tuple. Narrow typed handlers
+(`except KeyError:`) are deliberate by construction and exempt, as is
+the module-level import-guard idiom (a `try` whose body is all
+imports: the fallback IS the handling).
+
+A broad handler is loud when its body
+
+- re-raises (`raise` / `raise Typed(...) from e`) anywhere, or
+- calls a logging/telemetry name (`print`, `log.warning`,
+  `_OBS.count`, `self._warn`, traceback printers, ...), or
+- bumps a counter (`self.stat_drops += 1`-shaped AugAssign), or
+- USES the caught exception (`as e` then `e` read anywhere — routing
+  the error to a waiter, `r["error"] = e`, is a demotion with a
+  paper trail, not a swallow).
+
+Anything else needs `# drlint: disable=silent-except(<justification>)`
+with a justification of >= 10 chars — the bare form without one does
+NOT suppress (core.JUSTIFIED_RULES), so the finding keeps pointing at
+the handler until someone writes down why silence is the design.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Finding, ModuleInfo
+
+RULE = "silent-except"
+
+_PKG = "distributed_reinforcement_learning_tpu"
+
+_BROAD = {"Exception", "BaseException"}
+
+# Callee tails that count as "leaves a trace". Matched on the FINAL
+# attribute/name of the call — `self._obs.count(...)`, `log.warning`,
+# `traceback.print_exc`, bare `print` all qualify.
+LOUD_NAMES = frozenset({
+    "print", "print_exc", "print_exception", "format_exc",
+    "warn", "warning", "_warn", "error", "exception", "critical",
+    "info", "debug", "log", "log_once",
+    "count", "gauge", "observe", "inc", "increment", "record",
+    "abort", "fail", "demote", "bump", "_bump",
+})
+
+
+def _in_package(path: str) -> bool:
+    return _PKG in path.replace("\\", "/").split("/")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = list(t.elts)
+    else:
+        names = [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True
+    return False
+
+
+def _import_guard(try_node: ast.Try) -> bool:
+    return bool(try_node.body) and all(
+        isinstance(s, (ast.Import, ast.ImportFrom)) for s in try_node.body)
+
+
+def _call_tail(call: ast.Call) -> str | None:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _is_loud(handler: ast.ExceptHandler) -> bool:
+    caught = handler.name  # `except Exception as e` -> 'e'
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call) and \
+                    _call_tail(node) in LOUD_NAMES:
+                return True
+            if isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, (ast.Attribute, ast.Subscript)):
+                return True  # self.drops += 1 / self.stats[k] += 1
+            if caught and isinstance(node, ast.Name) and \
+                    node.id == caught and isinstance(node.ctx, ast.Load):
+                return True  # the error is routed, not dropped
+    return False
+
+
+def check(mod: ModuleInfo) -> list[Finding]:
+    if not _in_package(mod.path):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        guard = _import_guard(node)
+        for handler in node.handlers:
+            if guard or not _is_broad(handler):
+                continue
+            if _is_loud(handler):
+                continue
+            what = "bare except" if handler.type is None else "broad handler"
+            findings.append(mod.finding(
+                RULE, handler,
+                f"{what} swallows the error silently — re-raise, log, "
+                f"count, or justify with "
+                f"# drlint: disable=silent-except(<why>)"))
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings

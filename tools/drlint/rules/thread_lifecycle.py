@@ -1,0 +1,385 @@
+"""thread-lifecycle: every spawned thread must have a provable end.
+
+A `threading.Thread(...)` construction site is judged by who OWNS the
+thread:
+
+- **class-owned** (`self.X = Thread(...)`, or a local later stored via
+  `self._threads.append(t)` / `self.X = t`, including list literals and
+  comprehensions): the owning class must either
+
+  1. reach `X.join()` from a stop entry (`close`/`stop`/`shutdown`/
+     `drain`/`__exit__`/... — see rules/_lifecycle.py), resolved
+     transitively through same-class calls and through the snapshot
+     idiom (`threads = list(self._threads); for t in threads:
+     t.join()`), over the inheritance-merged class model; or
+  2. mark the thread `daemon=True` AND expose a stop latch — a
+     stop-reachable method that sets an event/condition
+     (`self._ev.set()`, `notify_all()`) or flips a flag attribute to a
+     constant — so daemonhood is a documented design, not an excuse.
+
+  A `start()` with neither is a finding.
+
+- **function-local**: a non-daemon local thread must be `.join()`ed in
+  the same function (directly or via a `for t in threads:` loop); a
+  local that escapes (returned, yielded, passed onward) is somebody
+  else's to prove and is skipped. Local daemon threads are accepted:
+  with no owner object there is no close() to outlive.
+
+Separately, the pass flags the deadlock shape the runtime sanitizer
+can only catch after the fact: a `.join()` of an owned thread reached
+while the caller HOLDS one of the class's sanitized locks — the joined
+thread typically needs that lock to finish, so the join can never
+return. (The repo convention is snapshot-under-lock, join-outside.)
+
+Name-coarse and zero-noise by the same contract as lock-order: a
+finding requires a PROVEN unjoined non-daemon thread or an
+unlatched daemon; anything unresolvable contributes silence, and the
+runtime leak census (rt/census.py) covers the remainder empirically.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.drlint.core import Finding, ModuleInfo, Program
+from tools.drlint.rules._lifecycle import (
+    attr_calls,
+    is_stop_entry,
+    merged,
+    method_aliases,
+    stop_reachable,
+)
+from tools.drlint.rules._locks import (
+    HeldWalker,
+    _self_attr,
+    module_model,
+)
+
+RULE = "thread-lifecycle"
+
+_THREAD_CHAIN = "threading.Thread"
+_LATCH_CALLS = ("set", "notify", "notify_all", "cancel", "put", "put_nowait")
+
+
+def _is_thread_ctor(mod: ModuleInfo, node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        mod.resolve_chain(node.func) == _THREAD_CHAIN
+
+
+def _ctor_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _enclosing_stmt(mod: ModuleInfo, node: ast.AST) -> ast.stmt | None:
+    cur: ast.AST | None = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = mod.parents.get(cur)
+    return cur  # type: ignore[return-value]
+
+
+def _local_stores(fn: ast.AST, name: str) -> set[str]:
+    """Self attrs the local `name` is stored into within `fn`:
+    `self.X = name` and `self.C.append/add(name)`."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and node.value.id == name:
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    out.add(attr)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("append", "add") and \
+                node.args and isinstance(node.args[0], ast.Name) and \
+                node.args[0].id == name:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+def _local_escapes(fn: ast.AST, name: str) -> bool:
+    """True when the local thread leaves this function: returned,
+    yielded, or passed as an argument to anything that is not the
+    thread's own method call or a `self`-container append."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Return, ast.Yield)) and \
+                isinstance(node.value, ast.Name) and node.value.id == name:
+            return True
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                if isinstance(recv, ast.Name) and recv.id == name:
+                    continue  # t.start()/t.join() — not an escape
+                if node.func.attr in ("append", "add") and \
+                        _self_attr(recv) is not None:
+                    continue  # self.C.append(t) — ownership transfer
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    return True
+    return False
+
+
+def _joined_locals(fn: ast.AST) -> set[str]:
+    """Local names provably joined in `fn`: direct `t.join()` receivers
+    plus list names whose `for t in threads:` loop var is joined."""
+    direct: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join" and \
+                isinstance(node.func.value, ast.Name):
+            direct.add(node.func.value.id)
+    out = set(direct)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor)) and \
+                isinstance(node.target, ast.Name) and \
+                isinstance(node.iter, ast.Name) and \
+                node.target.id in direct:
+            out.add(node.iter.id)
+    return out
+
+
+def _set_daemon_after(fn: ast.AST, name: str | None, attr: str | None) -> bool:
+    """`t.daemon = True` / `self.X.daemon = True` after construction."""
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Assign) and
+                isinstance(node.value, ast.Constant) and
+                node.value.value is True):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and tgt.attr == "daemon":
+                recv = tgt.value
+                if name is not None and isinstance(recv, ast.Name) and \
+                        recv.id == name:
+                    return True
+                if attr is not None and _self_attr(recv) == attr:
+                    return True
+    return False
+
+
+def _class_sites(mod: ModuleInfo, cls_node: ast.ClassDef):
+    """Thread ctor sites in a class's own methods, classified:
+    yields (method_fn, call, kind, name) with kind in
+    {'attr', 'local', 'escape'} — 'attr' name is the owning self
+    attribute, 'local' the local variable."""
+    for meth in cls_node.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(meth):
+            if not _is_thread_ctor(mod, node):
+                continue
+            stmt = _enclosing_stmt(mod, node)
+            kind, name = "escape", None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    kind, name = "attr", attr
+                elif isinstance(tgt, ast.Name):
+                    stores = _local_stores(meth, tgt.id)
+                    if stores:
+                        kind, name = "attr", sorted(stores)[0]
+                    else:
+                        kind, name = "local", tgt.id
+            elif isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    isinstance(stmt.value.func, ast.Attribute) and \
+                    stmt.value.func.attr in ("append", "add"):
+                attr = _self_attr(stmt.value.func.value)
+                if attr is not None:
+                    kind, name = "attr", attr
+            yield meth, node, kind, name
+
+
+def _stop_latch_attrs(cls, reach: set[str]) -> set[str]:
+    """Attrs signalled from a stop-reachable method: `self.Y.set()` /
+    `notify_all()` / queue puts, or `self.Y = <constant>` flag flips."""
+    out: set[str] = set()
+    for mname in reach:
+        fn = cls.methods.get(mname)
+        if fn is None:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _LATCH_CALLS:
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    out.add(attr)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant):
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None:
+                        out.add(attr)
+    return out
+
+
+def build_thread_model(program: Program) -> dict[str, dict]:
+    """Per owning class: thread attrs, provably-joined attrs, stop-latch
+    presence, ctor sites. Shared (via Program._cache) by the lint pass
+    and by --reconcile's lifecycle diff."""
+    cached = program._cache.get("thread_model")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    model: dict[str, dict] = {}
+    for mod in program.modules:
+        for cname, cls in module_model(mod).classes.items():
+            sites = list(_class_sites(mod, cls.node))
+            if not sites:
+                continue
+            m = merged(program, cname)
+            if m is None or m.node is not cls.node:
+                m = cls  # shadowed duplicate name: judge it standalone
+            reach = stop_reachable(program, m)
+            joined: set[str] = set()
+            for mname in reach:
+                fn = m.methods.get(mname)
+                if fn is not None:
+                    joined |= attr_calls(fn, "join", method_aliases(fn))
+            latches = _stop_latch_attrs(m, reach)
+            attrs = sorted({n for _, _, k, n in sites if k == "attr"})
+            model.setdefault(cname, {
+                "mod": mod, "cls": m, "attrs": attrs,
+                "joined": joined, "latches": latches, "sites": sites,
+            })
+    program._cache["thread_model"] = model
+    return model
+
+
+class _JoinUnderLock(HeldWalker):
+    """Flags `.join()` on an owned thread while a sanitized lock of the
+    same class is held — the join-deadlock shape."""
+
+    def __init__(self, mod: ModuleInfo, cls, thread_attrs: set[str],
+                 aliases: dict[str, str], findings: list):
+        self.mod, self.cls = mod, cls
+        self.thread_attrs = thread_attrs
+        self.aliases = aliases
+        self.findings = findings
+
+    def lock_of(self, expr: ast.AST):
+        attr = _self_attr(expr)
+        if attr is not None and self.cls.canon(attr) in \
+                {self.cls.canon(a) for a in self.cls.lock_attrs}:
+            return (self.cls.name, self.cls.canon(attr))
+        return None
+
+    def handle_node(self, node: ast.AST, held: tuple) -> None:
+        if not held or not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute) or \
+                node.func.attr != "join":
+            return
+        recv = node.func.value
+        attr = _self_attr(recv)
+        if attr is None and isinstance(recv, ast.Name):
+            attr = self.aliases.get(recv.id)
+        if attr in self.thread_attrs:
+            self.findings.append(self.mod.finding(
+                RULE, node,
+                f"joins thread '{attr}' while holding "
+                f"{', '.join(f'{o}.{n}' for o, n in held)} — the thread "
+                f"may need that lock to exit; snapshot under the lock, "
+                f"join outside it"))
+
+
+def _check_function_local(mod: ModuleInfo, fn, findings: list) -> None:
+    """Locals of one function scope (module function or method):
+    non-daemon local threads must join in-function; threads stored to
+    `self` (attr-owned — judged at class level) and escapes are
+    skipped."""
+    joined = _joined_locals(fn)
+    for node in ast.walk(fn):
+        if not _is_thread_ctor(mod, node):
+            continue
+        stmt = _enclosing_stmt(mod, node)
+        name = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+            if _self_attr(tgt) is not None:
+                continue  # self.X = Thread(...): class-owned
+            if isinstance(tgt, ast.Name):
+                name = tgt.id
+                if _local_stores(fn, name):
+                    continue  # stored to self later: class-owned
+        elif isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Call) and \
+                isinstance(stmt.value.func, ast.Attribute) and \
+                stmt.value.func.attr in ("append", "add"):
+            recv = stmt.value.func.value
+            if _self_attr(recv) is not None:
+                continue  # self.C.append(Thread(...)): class-owned
+            if isinstance(recv, ast.Name):
+                name = recv.id  # local list collects the threads
+        daemon = _ctor_daemon(node) or \
+            (name is not None and _set_daemon_after(fn, name, None))
+        if daemon:
+            continue
+        if name is None:
+            findings.append(mod.finding(
+                RULE, node,
+                "non-daemon thread constructed without a binding — "
+                "nothing can ever join it"))
+            continue
+        if name in joined or _local_escapes(fn, name):
+            continue
+        findings.append(mod.finding(
+            RULE, node,
+            f"non-daemon thread '{name}' is never joined in this "
+            f"function and never escapes it — join it (or pass "
+            f"ownership to a class with a stop path)"))
+
+
+def check(program: Program) -> list[Finding]:
+    findings: list[Finding] = []
+    model = build_thread_model(program)
+    for cname, info in sorted(model.items()):
+        mod, m = info["mod"], info["cls"]
+        joined, latches = info["joined"], info["latches"]
+        local_seen: set[int] = set()
+        for meth, call, kind, name in info["sites"]:
+            daemon = _ctor_daemon(call) or _set_daemon_after(
+                meth, name if kind == "local" else None,
+                name if kind == "attr" else None)
+            if kind == "attr":
+                if name in joined:
+                    continue
+                if daemon and latches:
+                    continue
+                if daemon:
+                    findings.append(mod.finding(
+                        RULE, call,
+                        f"daemon thread '{name}' of {cname} has no stop "
+                        f"latch: no close()/stop() path sets an event or "
+                        f"flag it watches, and it is never joined"))
+                else:
+                    findings.append(mod.finding(
+                        RULE, call,
+                        f"thread '{name}' of {cname} has no reachable "
+                        f".join() on any close()/stop()/__exit__ path "
+                        f"(and is not a latched daemon)"))
+            elif kind == "local":
+                if daemon:
+                    continue
+                if id(meth) not in local_seen:
+                    local_seen.add(id(meth))
+                    _check_function_local(mod, meth, findings)
+            # kind == 'escape': unprovable ownership — census covers it.
+        # Deadlock shape: joins under a sanitized lock, on any method.
+        thread_attrs = set(info["attrs"])
+        if thread_attrs and m.lock_attrs:
+            for fn in m.methods.values():
+                walker = _JoinUnderLock(mod, m, thread_attrs,
+                                        method_aliases(fn), findings)
+                walker.visit(fn, ())
+    # Module-level functions.
+    for mod in program.modules:
+        for fn in module_model(mod).functions.values():
+            _check_function_local(mod, fn, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings
