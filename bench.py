@@ -75,7 +75,7 @@ _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
                  "codec_verdict", "weights_verdict", "weights_shard_verdict",
                  "replay_verdict", "inference_verdict", "chaos_verdict",
                  "actor_pipeline_verdict", "learner_verdict",
-                 "device_path_verdict")
+                 "device_path_verdict", "admission_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -2303,6 +2303,242 @@ def bench_replay_compare(n_unrolls: int = 192, unrolls_per_put: int = 8,
     print(f"[bench] replay_compare: mono {best_m['frames_per_s']:,.0f} "
           f"f/s vs sharded {best_s['frames_per_s']:,.0f} f/s "
           f"-> {out['verdict']}", file=sys.stderr)
+    return out
+
+
+_ADMISSION_CHILD = r"""
+import json
+import sys
+from collections import namedtuple
+
+import numpy as np
+
+from distributed_reinforcement_learning_tpu.data import admission
+from distributed_reinforcement_learning_tpu.runtime.transport import TransportClient
+
+host, port, n_unrolls, upp, steps, obs_dim = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]))
+ApexBatch = namedtuple("ApexBatch", ["state", "next_state", "previous_action",
+                                     "action", "reward", "done"])
+rng = np.random.RandomState(0)
+trees = []
+for i in range(upp):
+    # Mixed-value traffic: reward scale cycles so unroll priorities
+    # straddle the fleet mean and the admission ladder has both sides
+    # to act on (uniform-priority traffic would make FULL/subsample
+    # degenerate).
+    scale = 1.0 if i % 4 == 0 else 0.05
+    trees.append(ApexBatch(
+        state=rng.rand(steps, obs_dim).astype(np.float32),
+        next_state=rng.rand(steps, obs_dim).astype(np.float32),
+        previous_action=rng.randint(0, 2, steps).astype(np.int32),
+        action=rng.randint(0, 2, steps).astype(np.int32),
+        reward=(scale * rng.randn(steps)).astype(np.float32),
+        done=(rng.rand(steps) < 0.1)))
+client = TransportClient(host, port, busy_timeout=120.0)
+ctrl = admission.configure(client, "apex", seed=7)
+sent = 0
+while sent < n_unrolls:
+    chunk = trees[: min(upp, n_unrolls - sent)]
+    got = client.put_trajectories(chunk)
+    assert got == len(chunk), f"dropped {len(chunk) - got} unrolls"
+    sent += got
+client.close()
+snap = ctrl.snapshot() if ctrl is not None else {}
+print(json.dumps({
+    "stamped": ctrl is not None,
+    "wire_unrolls": client.stats["unrolls_sent"],
+    "admission_dropped": client.stats["unrolls_admission_dropped"],
+    "sent_transitions": snap.get("sent_transitions",
+                                 client.stats["unrolls_sent"] * steps),
+    "subsample_dropped": snap.get("subsample_dropped_transitions", 0),
+    "dropped_mass": snap.get("dropped_mass", 0.0),
+    "pending_folded": (ctrl.pending_folded_mass() if ctrl is not None
+                       else 0.0)}))
+print("ADMISSION_CHILD_DONE")
+"""
+
+
+def bench_admission_compare(n_unrolls: int = 192, unrolls_per_put: int = 8,
+                            steps: int = 32, obs_dim: int = 64,
+                            num_shards: int = 2, reps: int = 1) -> dict:
+    """Two-process A/B of SAMPLE-AT-SOURCE (data/admission.py): actors
+    that stamp actor-computed initial priorities into the wire blob
+    (`DRL_ACTOR_PRIORITY=1` in the child) vs the baseline fleet whose
+    blobs the learner's ingest threads must score (`=0`). Identical
+    unrolls PUT over loopback TCP into an identical sharded service
+    while the learner trains; the adjudicated number is learner
+    ingest-CPU-per-accepted-transition (DutyMeter cumulative busy
+    seconds over shard-stored transitions) — exactly the work the stamp
+    exists to move off the learner box.
+
+    A third leg ("admitted") adds priority-mass admission under a
+    pinned pressure override (`DRL_ADMISSION_PRESSURE=0.75` — the bench
+    learner is never genuinely saturated, so the ladder is driven
+    explicitly) and reports accepted-transitions-per-KB: the wire/ingest
+    efficiency bought by thinning low-priority traffic at the source.
+    Admission stays OPT-IN regardless (verdict note): a synthetic
+    window cannot adjudicate "matched return", which is the honest bar
+    for a knob that reshapes the training distribution.
+
+    The committed `benchmarks/admission_verdict.json` carries the
+    decision `data/admission.actor_priority_enabled()` consults, at the
+    repo's >= 1.2x bar."""
+    from collections import namedtuple
+
+    import jax
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.agents.apex import (
+        ApexAgent, ApexConfig)
+    from distributed_reinforcement_learning_tpu.data import codec
+    from distributed_reinforcement_learning_tpu.data.replay_service import (
+        ShardedReplayService)
+    from distributed_reinforcement_learning_tpu.runtime import apex_runner
+    from distributed_reinforcement_learning_tpu.runtime.replay_shard import (
+        ReplayIngestFifo)
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        TransportServer, _make_queue)
+    from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
+
+    acfg = ApexConfig(obs_shape=(obs_dim,), num_actions=2)
+    agent = ApexAgent(acfg)  # ONE jit cache shared by all variants
+    rng = np.random.RandomState(0)
+    cls = namedtuple("ApexBatch", ["state", "next_state", "previous_action",
+                                   "action", "reward", "done"])
+
+    def warm_unrolls(count):
+        out = []
+        for _ in range(count):
+            out.append(bytes(codec.encode(cls(
+                state=rng.rand(steps, obs_dim).astype(np.float32),
+                next_state=rng.rand(steps, obs_dim).astype(np.float32),
+                previous_action=rng.randint(0, 2, steps).astype(np.int32),
+                action=rng.randint(0, 2, steps).astype(np.int32),
+                reward=rng.randn(steps).astype(np.float32),
+                done=rng.rand(steps) < 0.1))))
+        return out
+
+    def run_variant(child_env: dict) -> dict:
+        queue = _make_queue(64)
+        svc = ShardedReplayService(num_shards, 16384, mode="transition",
+                                   scorer="td_proxy", seed=0)
+        fifo = ReplayIngestFifo(svc, queue)
+        weights = WeightStore()
+        learner = apex_runner.ApexLearner(
+            agent, queue, weights, batch_size=32, replay_capacity=16384,
+            rng=jax.random.PRNGKey(0), replay_service=svc)
+        # Warm + compile OUTSIDE the timed window (plain blobs: the
+        # decode/layout caches are shared by both ingest paths).
+        for blob in warm_unrolls(12):
+            fifo.ingest_blob(blob)
+        assert learner.train() is not None
+        server = TransportServer(fifo, weights, host="127.0.0.1",
+                                 port=_free_port()).start()
+
+        def stored() -> int:
+            return sum(s.mass_count()[1] for s in svc.shards)
+
+        base_blobs = svc.ingested_blobs()
+        base_stored = stored()
+        base_cpu = fifo.duty.total()
+        base_bytes = fifo.admission_stats()["ingest_bytes"]
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _ADMISSION_CHILD, "127.0.0.1",
+             str(server.port), str(n_unrolls), str(unrolls_per_put),
+             str(steps), str(obs_dim)],
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "DRL_REPLAY_SCORER": "td_proxy", **child_env},
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            # Clock from the FIRST arrival (child startup excluded).
+            # The serve thread ingests synchronously before each PUT
+            # reply, so child exit == every accepted blob is in replay.
+            while svc.ingested_blobs() == base_blobs:
+                if proc.poll() is not None and proc.returncode != 0:
+                    raise RuntimeError(
+                        f"child died: {proc.stderr.read()[-500:]}")
+                time.sleep(0.001)
+            t0 = time.perf_counter()
+            while proc.poll() is None:
+                # Train continuously: the number measured is ingest cost
+                # UNDER training load, like replay_compare.
+                learner.ingest_many(timeout=0.0)
+                learner.train()
+            elapsed = time.perf_counter() - t0
+            assert proc.returncode == 0, proc.stderr.read()[-500:]
+            child_out = proc.stdout.read()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            server.stop()
+            queue.close()
+        child = {}
+        for ln in child_out.splitlines():
+            try:
+                child = json.loads(ln)
+                break
+            except json.JSONDecodeError:
+                continue
+        accepted = stored() - base_stored
+        cpu_s = fifo.duty.total() - base_cpu
+        wire_bytes = fifo.admission_stats()["ingest_bytes"] - base_bytes
+        stats = fifo.admission_stats()
+        out = {
+            "accepted_transitions": accepted,
+            "ingest_cpu_s": round(cpu_s, 4),
+            "ingest_cpu_us_per_transition": round(
+                cpu_s * 1e6 / max(accepted, 1), 3),
+            "wire_bytes": wire_bytes,
+            "transitions_per_kb": round(accepted / max(wire_bytes / 1024, 1e-9), 3),
+            "elapsed_s": round(elapsed, 3),
+            "stamped_blobs": stats["stamped_blobs"],
+            "scored_blobs": stats["scored_blobs"],
+            "folded_mass": round(stats["folded_mass"], 6),
+            "child": child,
+        }
+        svc.close()
+        learner.close()
+        return out
+
+    out: dict = {
+        "n_unrolls": n_unrolls, "steps": steps,
+        "note": ("real two-process A/B: child PUTs identical unrolls over "
+                 "loopback TCP while the learner trains; 'scored' pays "
+                 "decode+TD-score on the learner's serve thread, 'stamped' "
+                 "fast-accepts actor-computed priorities, 'admitted' adds "
+                 "priority-mass thinning under a pinned 0.75 pressure")}
+    best: dict[str, dict] = {}
+    legs = [("scored", {"DRL_ACTOR_PRIORITY": "0", "DRL_ADMISSION": "0"}),
+            ("stamped", {"DRL_ACTOR_PRIORITY": "1", "DRL_ADMISSION": "0"}),
+            ("admitted", {"DRL_ACTOR_PRIORITY": "1", "DRL_ADMISSION": "1",
+                          "DRL_ADMISSION_PRESSURE": "0.75"})]
+    for _ in range(reps):
+        for name, env in legs:
+            r = run_variant(env)
+            if (name not in best
+                    or r["ingest_cpu_us_per_transition"]
+                    < best[name]["ingest_cpu_us_per_transition"]):
+                best[name] = r
+    out.update(best)
+    ratio = (best["scored"]["ingest_cpu_us_per_transition"]
+             / max(best["stamped"]["ingest_cpu_us_per_transition"], 1e-9))
+    out["scored_vs_stamped_cpu"] = round(ratio, 2)
+    out["admitted_vs_scored_transitions_per_kb"] = round(
+        best["admitted"]["transitions_per_kb"]
+        / max(best["scored"]["transitions_per_kb"], 1e-9), 2)
+    out["auto_enable"] = ratio >= 1.2  # the repo's adjudication bar
+    out["admission_auto_enable"] = False  # opt-in by design (docstring)
+    out["verdict"] = (
+        f"actor stamps cut learner ingest CPU/transition {ratio:.2f}x: "
+        + ("auto-on" if out["auto_enable"] else "opt-in")
+        + f"; admission {out['admitted_vs_scored_transitions_per_kb']:.2f}x "
+          "transitions/KB, opt-in (return-match not benchable)")
+    print(f"[bench] admission_compare: scored "
+          f"{best['scored']['ingest_cpu_us_per_transition']:.1f} us/tr vs "
+          f"stamped {best['stamped']['ingest_cpu_us_per_transition']:.1f} "
+          f"us/tr -> {out['verdict']}", file=sys.stderr)
     return out
 
 
@@ -4653,6 +4889,7 @@ def _run_cpu_fallback() -> dict | None:
         "BENCH_KERNEL_BATCH": env.get("BENCH_KERNEL_BATCH", "32"),
         "BENCH_APEX_INGEST": "0",
         "BENCH_R2D2": "0", "BENCH_APEX": "0", "BENCH_XIMPALA": "0",
+        "BENCH_ADMISSION": "0",
     })
     try:
         proc = subprocess.run(
@@ -5079,6 +5316,20 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["replay_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] replay_compare failed: {e}", file=sys.stderr)
+
+    # Two-process sample-at-source A/B (the auto-enable adjudication
+    # for actor-side priority stamping + priority-mass admission,
+    # data/admission.py).
+    if os.environ.get("BENCH_ADMISSION", "1") == "1" and \
+            _ok("admission_compare", 150):
+        try:
+            r = bench_admission_compare()
+            extra["admission_compare"] = r
+            if "verdict" in r:
+                extra["admission_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["admission_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] admission_compare failed: {e}", file=sys.stderr)
 
     # Two-process host-vs-device sample-path A/B (the auto-enable
     # adjudication for the fused device-resident sample path,

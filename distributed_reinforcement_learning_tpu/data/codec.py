@@ -65,6 +65,19 @@ def _namedtuple_cls(name: str, fields: tuple[str, ...]):
 _MAGIC = 0x445254A1  # "DRT" + version 1
 _ALIGN = 64
 
+# Stamp extension frame (ISSUE 18 sample-at-source): a self-delimiting
+# prefix `[u32 ext_magic][u32 version][u32 ext_len][ext json]` carried
+# IN FRONT of an unmodified codec blob. The per-blob priority summary
+# must NOT ride the codec header json — the decode layout cache is
+# keyed on exact header bytes, and per-blob content there would turn
+# every lookup into a miss. The frame layout itself is pinned forever;
+# `version` only versions the json semantics, so any reader can skip an
+# extension it does not understand and fall through to the plain blob
+# (forward compat: a v2 stamp decodes on a v1 learner as unstamped).
+_EXT_MAGIC = 0x445254E5
+_EXT_VERSION = 1
+_EXT_HDR = 12  # magic + version + ext_len
+
 # Below this, a 4-d uint8 leaf is not worth the per-call plane compare.
 _DEDUP_MIN_BYTES = 4096
 _PACK_FSTACK = "fstack"  # the one packing scheme: frame-stack delta planes
@@ -546,6 +559,93 @@ def _note_dedup(saved: int) -> None:
     _CACHES.bump_dedup(saved)
 
 
+# -- stamp extension ----------------------------------------------------------
+
+
+def stamp_frame(stamp: dict) -> bytes:
+    """Serialize a priority-summary dict into the extension frame bytes
+    (see `_EXT_MAGIC`). The frame is sent as a separate wire part in
+    front of the blob (`runtime/transport.py` payload-parts path) or
+    concatenated by `stamp_blob` where the consumer needs one buffer."""
+    body = json.dumps(stamp, separators=(",", ":")).encode()
+    return (_EXT_MAGIC.to_bytes(4, "little")
+            + _EXT_VERSION.to_bytes(4, "little")
+            + len(body).to_bytes(4, "little") + body)
+
+
+def stamp_blob(blob, stamp: dict) -> np.ndarray:
+    """Prepend a stamp extension frame to a codec blob -> one contiguous
+    uint8 buffer (the shm ring path moves single buffers)."""
+    frame = stamp_frame(stamp)
+    view = memoryview(blob).cast("B")
+    out = np.empty(len(frame) + len(view), np.uint8)
+    mv = memoryview(out)
+    mv[:len(frame)] = frame
+    mv[len(frame):] = view
+    return out
+
+
+def split_stamp(buf) -> tuple[dict | None, "memoryview"]:
+    """-> (stamp | None, inner blob view).
+
+    Unstamped buffers return `(None, view)` untouched. A stamped buffer
+    with the CURRENT extension version returns its parsed summary dict;
+    an UNKNOWN (greater) version returns `(None, inner)` — the frame is
+    self-delimiting, so old readers skip what they cannot interpret and
+    treat the blob as plain (rolling-upgrade contract, pinned by
+    tests/test_admission.py). Only true corruption raises: an extension
+    frame whose declared length overruns the buffer, or whose json does
+    not parse — those are poison, not version skew."""
+    view = memoryview(buf).cast("B")
+    if len(view) < _EXT_HDR or int.from_bytes(view[0:4], "little") != _EXT_MAGIC:
+        return None, view
+    version = int.from_bytes(view[4:8], "little")
+    ext_len = int.from_bytes(view[8:12], "little")
+    end = _EXT_HDR + ext_len
+    if end > len(view):
+        raise ValueError("corrupt stamp extension: length overruns buffer")
+    inner = view[end:]
+    if version != _EXT_VERSION:
+        return None, inner  # future stamp: skip, decode inner as plain
+    try:
+        stamp = json.loads(bytes(view[_EXT_HDR:end]))
+    except ValueError as e:
+        raise ValueError(f"corrupt stamp extension: {e}") from e
+    if not isinstance(stamp, dict):
+        raise ValueError("corrupt stamp extension: summary not a dict")
+    return stamp, inner
+
+
+def _skip_ext(view: memoryview) -> memoryview:
+    """Drop a leading stamp extension frame, any version (decode paths
+    are stamp-transparent: the summary is ingest metadata, the tree is
+    the inner blob). Malformed frames pass through untouched and fail
+    at the blob magic check, exactly like any other junk bytes."""
+    if len(view) >= _EXT_HDR and int.from_bytes(view[0:4], "little") == _EXT_MAGIC:
+        end = _EXT_HDR + int.from_bytes(view[8:12], "little")
+        if end <= len(view):
+            return view[end:]
+    return view
+
+
+def strip_stamp(blob):
+    """Drop a leading stamp extension frame (any version), returning the
+    inner plain blob; an unstamped buffer is returned AS-IS (same
+    object, no copy). Blob-native queues route through this — their
+    batch-gather assumes the blob starts at the codec magic."""
+    view = memoryview(blob).cast("B")
+    inner = _skip_ext(view)
+    return blob if len(inner) == len(view) else inner
+
+
+def is_stamped(buf) -> bool:
+    """True when this buffer carries a stamp extension frame (any
+    version — use `split_stamp` to learn whether it is readable)."""
+    view = memoryview(buf).cast("B")
+    return (len(view) >= _EXT_HDR
+            and int.from_bytes(view[0:4], "little") == _EXT_MAGIC)
+
+
 # -- decode -------------------------------------------------------------------
 
 
@@ -570,6 +670,7 @@ def parse_layout(blob: bytes | memoryview) -> tuple[Any, list[dict], int]:
 
 
 def _layout_plan(view: memoryview, cache: bool | None = None) -> _DecodePlan:
+    view = _skip_ext(view)
     if int.from_bytes(view[0:4], "little") != _MAGIC:
         raise ValueError("bad magic: not a codec blob")
     header_len = int.from_bytes(view[4:8], "little")
@@ -638,6 +739,17 @@ def is_packed(blob: bytes | memoryview) -> bool:
     return _layout_plan(memoryview(blob)).packed
 
 
+def check_blob(blob) -> None:
+    """Raise ValueError unless the header parses and the payload extent
+    fits — WITHOUT decoding. The stamped sequence ingest stores blobs
+    for deferred decode (`data/replay_service.LazyBlob`), so poison must
+    fail here on the ingest thread, not at sample time on the learner."""
+    view = _skip_ext(memoryview(blob).cast("B"))
+    plan = _layout_plan(view)
+    if plan.payload_start + plan.payload_nbytes > len(view):
+        raise ValueError("truncated codec blob payload")
+
+
 def unpack_blob(blob):
     """Dedup-packed blob -> plain-layout blob; a plain blob is returned
     AS-IS (same object, no copy). `fifo.blob_ingest` routes every wire
@@ -650,7 +762,8 @@ def unpack_blob(blob):
     "pack") merely takes the exact parse below; malformed bytes pass
     through untouched, exactly like the pre-dedup ingest, and fail at
     decode time."""
-    view = memoryview(blob)
+    outer = memoryview(blob).cast("B")
+    view = _skip_ext(outer)
     if len(view) < 8 or int.from_bytes(view[0:4], "little") != _MAGIC:
         return blob
     header_len = int.from_bytes(view[4:8], "little")
@@ -659,7 +772,24 @@ def unpack_blob(blob):
     plan = _layout_plan(view)
     if not plan.packed:
         return blob
-    return encode(decode(blob))
+    plain = encode(decode(view))
+    if len(view) != len(outer):  # stamped: keep the ext frame intact in
+        #   front of the repacked inner blob (the stamp is ingest
+        #   metadata about the SAME logical trajectory)
+        return _reframe(outer, view, plain)
+    return plain
+
+
+def _reframe(outer: memoryview, inner: memoryview, plain) -> np.ndarray:
+    """Re-attach `outer`'s leading extension frame bytes to a repacked
+    inner blob (frame bytes copied verbatim — version-agnostic)."""
+    frame_len = len(outer) - len(inner)
+    pv = memoryview(plain).cast("B")
+    out = np.empty(frame_len + len(pv), np.uint8)
+    mv = memoryview(out)
+    mv[:frame_len] = outer[:frame_len]
+    mv[frame_len:] = pv
+    return out
 
 
 def decode(blob: bytes | memoryview, copy: bool = False,
@@ -676,7 +806,7 @@ def decode(blob: bytes | memoryview, copy: bool = False,
     schema per run, so the layout cache is a pure win there regardless
     of the committed trajectory-path verdict.
     """
-    view = memoryview(blob)
+    view = _skip_ext(memoryview(blob).cast("B"))
     plan = _layout_plan(view, cache)
     payload_start = plan.payload_start
     src = view

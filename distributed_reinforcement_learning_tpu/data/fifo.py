@@ -65,7 +65,13 @@ def blob_ingest(queue: Any) -> tuple[Any, Any]:
     if hasattr(queue, "ingest_blob"):
         return (lambda blob: blob), queue.ingest_blob
     if hasattr(queue, "put_bytes"):
-        return codec.unpack_blob, queue.put_bytes
+        # strip_stamp first: a priority-stamped wire blob (ISSUE 18,
+        # data/admission.py) carries an extension frame the native
+        # batch-gather must never see; the monolithic consumer behind a
+        # blob-native queue re-scores at ingest anyway, so the stamp is
+        # dead weight here. decode() below is stamp-transparent itself.
+        return (lambda blob: codec.unpack_blob(codec.strip_stamp(blob))), \
+            queue.put_bytes
     return (lambda blob: codec.decode(blob, copy=True)), queue.put
 
 

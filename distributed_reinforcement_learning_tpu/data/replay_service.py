@@ -141,6 +141,50 @@ def make_scorer(name: str) -> Callable[[Any, bool], np.ndarray] | None:
     raise ValueError(f"unknown replay scorer {name!r} (one of: max, td_proxy)")
 
 
+# -- deferred-decode items ----------------------------------------------------
+
+
+class LazyBlob:
+    """A sequence-mode replay item stored as its (owned) wire blob.
+
+    The sample-at-source fast accept (ISSUE 18): when the stamp already
+    carries the sequence priority, an opaque-item backend has no reason
+    to decode on the ingest thread at all — the blob is stored as-is and
+    decoded ONCE at first materialization (sample gather or snapshot),
+    which runs on the learner/checkpoint thread. Bytes are copied at
+    construction: wire receive buffers are reused per connection.
+
+    Materialization is deliberately lock-free: `_tree` is published
+    before `_blob` is dropped, so a concurrent materializer either sees
+    the tree or re-decodes the same bytes to an equal tree — duplicate
+    work, never a torn read (decode is pure).
+    """
+
+    __slots__ = ("_blob", "_tree")
+
+    def __init__(self, blob):
+        self._blob = bytes(memoryview(blob))
+        self._tree = None
+
+    def materialize(self):
+        tree = self._tree
+        if tree is not None:
+            return tree
+        blob = self._blob
+        if blob is None:  # lost a materialize race: the tree is set
+            return self._tree
+        from distributed_reinforcement_learning_tpu.data import codec
+
+        tree = codec.decode(blob, copy=True, cache=True)
+        self._tree = tree
+        self._blob = None  # decode owns its arrays; drop the bytes
+        return tree
+
+
+def _materialize(item):
+    return item.materialize() if isinstance(item, LazyBlob) else item
+
+
 # -- one shard ----------------------------------------------------------------
 
 
@@ -222,6 +266,63 @@ class ReplayShard:
                 errors = np.full(n, self._max_error, np.float64)
             else:
                 self._max_error = max(self._max_error, float(errors.max()))
+            n = self._insert_locked(errors, tree, per_transition)
+            self.ingested_blobs += 1
+            self.ingested_items += n
+        return n
+
+    def ingest_stamped(self, errors, tree: Any = None, blob=None) -> int:
+        """Insert with ACTOR-stamped initial priorities
+        (data/admission.py), skipping this shard's scorer pass entirely.
+
+        `errors` are error-domain float64 — the stamp's values, which
+        are bit-equal to what `self.scorer` would have produced (or
+        Horvitz-Thompson-corrected under admission subsampling).
+        Transition mode requires the decoded `tree` (array backends
+        gather per field) and validates its leading axis against the
+        stamp length; sequence mode takes the decoded tree OR the raw
+        `blob` — an opaque-item backend stores a `LazyBlob` and defers
+        decode to first materialization. Raises ValueError on any
+        stamp/tree mismatch so the caller can fall back to the scoring
+        path (`ingest`)."""
+        per_transition = self.mode == "transition"
+        errors = np.asarray(errors, np.float64).reshape(-1)
+        if errors.size == 0:
+            raise ValueError("stamped ingest: empty priority list")
+        if per_transition:
+            if tree is None:
+                raise ValueError(
+                    "stamped ingest: transition mode needs the decoded tree")
+            n_tree = int(np.asarray(_first_leaf(tree)).shape[0])
+            if n_tree != errors.size:
+                raise ValueError(
+                    f"stamped ingest: {errors.size} priorities for "
+                    f"{n_tree} transitions")
+        else:
+            if errors.size != 1:
+                raise ValueError(
+                    "stamped ingest: sequence mode takes ONE priority, "
+                    f"got {errors.size}")
+            if tree is None:
+                if blob is None:
+                    raise ValueError("stamped ingest: need a tree or a blob")
+                from distributed_reinforcement_learning_tpu.data import codec
+
+                with self._lock:  # backend binding is guarded; the flag
+                    stacked = getattr(  # itself is construction-time
+                        self.backend, "stacked_samples", False)
+                if stacked:
+                    # Stacked backends store per-field arrays — no
+                    # opaque slot to defer into; decode here (still off
+                    # the scorer pass).
+                    tree = codec.decode(blob, copy=True, cache=True)
+                else:
+                    codec.check_blob(blob)  # poison fails HERE, not at
+                    tree = LazyBlob(blob)   # sample-time materialization
+        with self._lock:
+            if self.dead:
+                raise RuntimeError(f"replay shard {self.shard_id} is dead")
+            self._max_error = max(self._max_error, float(errors.max()))
             n = self._insert_locked(errors, tree, per_transition)
             self.ingested_blobs += 1
             self.ingested_items += n
@@ -309,7 +410,13 @@ class ReplayShard:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return self.backend.snapshot()
+            snap = self.backend.snapshot()
+        items = snap.get("items")
+        if items is not None:
+            # Materialize deferred blobs outside the shard lock — a
+            # snapshot must persist decoded trees, not wire bytes.
+            snap["items"] = [_materialize(it) for it in items]
+        return snap
 
     def restore_part(self, priorities, items) -> None:
         with self._lock:
@@ -542,7 +649,9 @@ class ShardedReplayService:
             batch = (parts[0] if len(parts) == 1 else
                      jax.tree.map(lambda *xs: np.concatenate(xs), *parts))
         else:
-            batch = [item for part in parts for item in part]
+            # Deferred-decode items (stamped sequence ingest) decode
+            # here, on the learner thread, outside every shard lock.
+            batch = [_materialize(item) for part in parts for item in part]
         if _OBS.enabled:
             _OBS.gauge("replay_shard/sample_ms",
                        (time.perf_counter() - t0) * 1e3)

@@ -125,11 +125,17 @@ class ReplayIngestFifo:
         "_by_thread": "_lock",
         "_next": "_lock",
         "_demoted": "_lock",
+        "_plain_threads": "_lock",
+        "stamped_blobs": "_lock",
+        "scored_blobs": "_lock",
+        "folded_mass": "_lock",
+        "ingest_bytes": "_lock",
     }
 
     surface_name = "replay_shards"  # fleet supervisor watch label
 
     def __init__(self, service, fallback_queue):
+        from distributed_reinforcement_learning_tpu.data.admission import DutyMeter
         from distributed_reinforcement_learning_tpu.data.fifo import blob_ingest
         from distributed_reinforcement_learning_tpu.runtime.fleet import RetryLadder
 
@@ -140,6 +146,17 @@ class ReplayIngestFifo:
         self._by_thread: dict[int, Any] = {}
         self._next = 0
         self._demoted = False
+        # Sample-at-source (ISSUE 18): threads whose connection sent an
+        # unstamped / unusable-stamp blob latch to learner-side scoring
+        # PERMANENTLY (mixed fleets, rolling upgrades: one sniff per
+        # connection, then the plain path with zero per-blob overhead).
+        self._plain_threads: set[int] = set()
+        self.stamped_blobs = 0
+        self.scored_blobs = 0
+        self.folded_mass = 0.0  # transformed-domain mass folded from
+        #   actor-side admission drops (conservation ledger's far end)
+        self.ingest_bytes = 0  # raw wire-blob bytes offered to ingest
+        self.duty = DutyMeter()  # ingest busy fraction -> PUT-reply pressure
         # Revive accounting burns a ladder slot on SUCCESS too, so the
         # budget can exhaust while sharded ingest is healthy — the
         # default "demotion is now permanent" would be wrong then.
@@ -212,12 +229,82 @@ class ReplayIngestFifo:
         failure INSIDE the shard (scoring/backend) marks that shard
         dead and drops the blob — it is never retried on a survivor,
         so one bad input cannot cascade through the fleet. Once every
-        shard is dead, blobs go to the monolithic fallback queue."""
+        shard is dead, blobs go to the monolithic fallback queue.
+
+        Sample-at-source fast accept: a blob carrying a CURRENT-version
+        priority stamp whose scorer/mode match this service skips the
+        shard's scoring pass (`ingest_stamped`) — and, for sequence
+        shards on opaque-item backends, decode itself is deferred to
+        first sample. A malformed stamp frame is poison; an unstamped
+        or future-version blob latches this thread's connection to the
+        plain scoring path permanently (`_plain_threads`)."""
+        import time as _time
+
+        with self._lock:
+            self.ingest_bytes += len(blob)
+        t0 = _time.perf_counter()
+        try:
+            return self._ingest_inner(blob, timeout)
+        finally:
+            self.duty.note(_time.perf_counter() - t0)
+
+    def _ingest_inner(self, blob, timeout: float | None) -> bool:
         shard = self._shard_for_thread()
         if shard is None:  # demoted: the monolithic path owns ingest
             return self._fb_put(self._fb_prepare(blob), timeout=timeout)
         from distributed_reinforcement_learning_tpu.data import codec
 
+        stamp = None
+        ident = threading.get_ident()
+        with self._lock:
+            plain = ident in self._plain_threads
+        if not plain and codec.is_stamped(blob):
+            try:
+                stamp, blob = codec.split_stamp(blob)
+            except ValueError:  # corrupt extension frame: poison
+                self._warn("corrupt stamp extension dropped (poison PUT?)")
+                if _OBS.enabled:
+                    _OBS.count("replay_shard/poison_blobs")
+                return True
+            if stamp is not None:
+                stamp = self._usable_stamp(stamp, shard)
+        if stamp is None and not plain:
+            # Unstamped, future-version, or mismatched-config blob:
+            # this connection speaks the plain protocol from now on.
+            with self._lock:
+                self._plain_threads.add(ident)
+        if stamp is not None:
+            folded = float(stamp.get("folded", 0.0) or 0.0)
+            try:
+                if shard.mode == "sequence":
+                    n = shard.ingest_stamped(stamp["pri"], blob=blob)
+                else:
+                    tree = codec.decode(blob, copy=True, cache=True)
+                    n = shard.ingest_stamped(stamp["pri"], tree=tree)
+            except ValueError:
+                # Stamp/tree mismatch (e.g. priority count vs leading
+                # axis): distrust the stamp, score learner-side.
+                stamp = None
+            except Exception:  # noqa: BLE001 — shard-internal failure:
+                import traceback  # fail LOUDLY, contain it to THIS shard
+
+                self._warn(
+                    f"shard {shard.shard_id} stamped ingest failed; "
+                    f"marking dead\n{traceback.format_exc(limit=2)}")
+                self.service.note_shard_death(shard)
+                return True
+            else:
+                with self._lock:
+                    self.stamped_blobs += 1
+                    if folded:
+                        self.folded_mass += folded
+                if _OBS.enabled:
+                    _OBS.count("replay_shard/ingested_items", n)
+                    _OBS.count("replay_shard/ingested_blobs")
+                    _OBS.count("admission/ingest_stamped")
+                    if folded:
+                        _OBS.count("admission/folded_mass", folded)
+                return True
         try:
             # decode(cache=True): shard ingest sees one stable schema
             # per run, so the layout cache is forced like the weight
@@ -238,10 +325,51 @@ class ReplayIngestFifo:
                 f"{traceback.format_exc(limit=2)}")
             self.service.note_shard_death(shard)
             return True  # blob dropped (at-most-once), never re-routed
+        with self._lock:
+            self.scored_blobs += 1
         if _OBS.enabled:
             _OBS.count("replay_shard/ingested_items", n)
             _OBS.count("replay_shard/ingested_blobs")
+            _OBS.count("admission/ingest_scored")
         return True
+
+    def _usable_stamp(self, stamp: dict, shard) -> dict | None:
+        """Validate a parsed stamp against this service's configuration:
+        the scorer and shard mode must MATCH for the stamped priorities
+        to mean what learner-side scoring would have computed. A
+        mismatch (mis-configured actor) is not poison — the blob is
+        fine, only the stamp is distrusted."""
+        scorer_name = getattr(self.service, "scorer_name", None)
+        if (stamp.get("scorer") != scorer_name
+                or stamp.get("mode") != shard.mode
+                or not isinstance(stamp.get("pri"), list)
+                or not stamp["pri"]):
+            return None
+        return stamp
+
+    def ingest_pressure(self) -> int:
+        """Learner ingest pressure, 0..1000 permille, appended to PUT
+        replies (`runtime/transport.py`) to drive actor-side admission:
+        the ingest threads' busy fraction (`DutyMeter` — sharded ingest
+        never blocks, so CPU duty IS the saturation signal), or the
+        fallback queue's fill once demoted."""
+        p = self.duty.value()
+        with self._lock:
+            demoted = self._demoted
+        if demoted:
+            cap = getattr(self.fallback, "capacity", 0)
+            if cap:
+                p = max(p, min(1.0, self.fallback.size() / cap))
+        return int(round(p * 1000))
+
+    def admission_stats(self) -> dict:
+        """Stamped-vs-scored tallies + the folded-mass ledger's learner
+        end (obs_report 'Ingest admission', tests)."""
+        with self._lock:
+            return {"stamped_blobs": self.stamped_blobs,
+                    "scored_blobs": self.scored_blobs,
+                    "folded_mass": self.folded_mass,
+                    "ingest_bytes": self.ingest_bytes}
 
     def _warn(self, msg: str) -> None:
         import sys

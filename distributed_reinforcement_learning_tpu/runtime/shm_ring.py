@@ -681,6 +681,11 @@ class RingQueue(_LockedStatsMixin, ShmReattachMixin):
 
     _GUARDED_BY = {"stats": "_stats_lock", "_ring": "_lock",
                    "_closed": "_lock", "_stale": "_lock"}
+    _NOT_GUARDED = {
+        "_admission": "set once by the owning actor runner "
+                      "(set_admission) before the publish thread starts; "
+                      "read-only on the put paths thereafter",
+    }
 
     surface_name = "ring"  # fleet heartbeat registration label
 
@@ -697,8 +702,19 @@ class RingQueue(_LockedStatsMixin, ShmReattachMixin):
         self._lock = threading.Lock()
         self._ladder = RetryLadder(f"ring-{self._name}")
         self.stats = {"unrolls_sent": 0, "bytes_sent": 0, "tcp_fallbacks": 0,
-                      "reattaches": 0}
+                      "reattaches": 0, "unrolls_admission_dropped": 0}
         self._stats_lock = threading.Lock()
+        self._admission = None  # data/admission.AdmissionController —
+        #   set once by the owning runner before the publish thread
+        #   starts (see set_admission), read-only on put paths after
+
+    def set_admission(self, controller) -> None:
+        """Attach an actor-side admission controller
+        (data/admission.AdmissionController): ring PUTs score + stamp
+        each unroll. The ring has no reply channel, so pressure only
+        moves via the `DRL_ADMISSION_PRESSURE` override here; the
+        demote-to-TCP path falls back to plain (learner-scored) PUTs."""
+        self._admission = controller
 
     @property
     def attached(self) -> bool:
@@ -778,8 +794,10 @@ class RingQueue(_LockedStatsMixin, ShmReattachMixin):
         try:
             # Same dedup gating as the TCP client's trajectory PUTs: the
             # drainer's blob_ingest reconstructs before the queue.
-            self._put_blob(ring,
-                           codec.encode(item, dedup=codec.obs_dedup_enabled()))
+            blob = self._admitted_blob(item, codec)
+            if blob is None:  # dropped at source (mass folded)
+                return True
+            self._put_blob(ring, blob)
             return True
         except (RingClosed, ValueError):
             # ValueError = blob too large for this ring's capacity: TCP
@@ -794,15 +812,36 @@ class RingQueue(_LockedStatsMixin, ShmReattachMixin):
         if ring is None:
             return self._client.put_trajectories(items)
         sent = 0
-        dedup = codec.obs_dedup_enabled()
         for item in items:
             try:
-                self._put_blob(ring, codec.encode(item, dedup=dedup))
+                blob = self._admitted_blob(item, codec)
+                if blob is None:  # dropped at source (mass folded)
+                    sent += 1
+                    continue
+                self._put_blob(ring, blob)
                 sent += 1
             except (RingClosed, ValueError):  # dead ring / oversize blob
                 self._demote()
                 return sent + self._client.put_trajectories(items[sent:])
         return sent
+
+    def _admitted_blob(self, item: Any, codec):
+        """Encode one unroll for the ring, applying admission + the
+        priority stamp when a controller is attached. None = the
+        controller dropped the unroll whole."""
+        ctrl = self._admission
+        dedup = codec.obs_dedup_enabled()
+        if ctrl is None:
+            return codec.encode(item, dedup=dedup)
+        decision = ctrl.admit(item)
+        if not decision.send:
+            self._bump("unrolls_admission_dropped")
+            return None
+        tree = item if decision.tree is None else decision.tree
+        blob = codec.stamp_blob(codec.encode(tree, dedup=dedup),
+                                decision.stamp)
+        ctrl.note_wire(len(blob), decision)
+        return blob
 
     def size(self) -> int:
         return self._client.queue_size()

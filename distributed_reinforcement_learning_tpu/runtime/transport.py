@@ -640,6 +640,22 @@ class TransportServer(_LockedStatsMixin):
             _OBS.count(f"staleness_bucket/{stale_bucket(staleness)}",
                        accepted)
 
+    def _pressure_permille(self) -> int:
+        """Learner ingest pressure for PUT replies, 0..1000.
+
+        Sharded ingest facades expose their own meter
+        (`ReplayIngestFifo.ingest_pressure` — busy fraction; depth is
+        always 0 there); bounded queues fall back to fill fraction,
+        the signal their blocking-put backpressure already implies."""
+        queue = self.queue
+        meter = getattr(queue, "ingest_pressure", None)
+        if meter is not None:
+            return max(0, min(1000, int(meter())))
+        capacity = getattr(queue, "capacity", 0)
+        if capacity:
+            return int(min(1.0, queue.size() / capacity) * 1000)
+        return 0
+
     def _serve_inner(self, conn: socket.socket) -> None:
         rbuf = _ConnRecvBuf()  # reused across this connection's requests
         # Newest weight version this peer confirmed holding (via
@@ -661,24 +677,33 @@ class TransportServer(_LockedStatsMixin):
                 elif op == OP_PUT_TRAJ:
                     # Replying only after acceptance is the actors'
                     # backpressure (reference: blocking enqueue op,
-                    # buffer_queue.py:398-414).
+                    # buffer_queue.py:398-414). The reply carries the
+                    # learner's ingest pressure (u16 permille) — the
+                    # feedback edge of actor-side admission
+                    # (data/admission.py); pre-pressure clients ignore
+                    # the payload.
                     ok = self._enqueue(payload)
                     self._bump("unrolls_accepted" if ok else "busy_replies")
                     if _OBS.enabled:
                         self._observe_put(1 if ok else 0, conn_version)
-                    _send_msg(conn, ST_OK if ok else ST_BUSY)
+                    _send_msg(conn, ST_OK if ok else ST_BUSY,
+                              _U16.pack(self._pressure_permille()))
                 elif op == OP_PUT_TRAJ_N:
                     # The batched PUT: K unrolls in one round trip. The
-                    # reply carries the accepted count; a partial accept
-                    # (bounded queue refused the tail) is the batched
-                    # analogue of ST_BUSY and the client retries the rest.
+                    # reply carries the accepted count (then the ingest
+                    # pressure, appended — clients parse with
+                    # unpack_from so later fields never break them); a
+                    # partial accept (bounded queue refused the tail) is
+                    # the batched analogue of ST_BUSY and the client
+                    # retries the rest.
                     accepted, n_in = self._enqueue_many(payload)
                     self._bump("unrolls_accepted", accepted)
                     if accepted < n_in:
                         self._bump("partial_accepts")
                     if _OBS.enabled:
                         self._observe_put(accepted, conn_version)
-                    _send_msg(conn, ST_OK, _I64.pack(accepted))
+                    _send_msg(conn, ST_OK, _I64.pack(accepted),
+                              _U16.pack(self._pressure_permille()))
                 elif op == OP_GET_WEIGHTS:
                     # Versions are snapshot IDENTITIES across the wire,
                     # not an ordering: a restarted learner republishes
@@ -801,6 +826,11 @@ class TransportClient(_LockedStatsMixin):
         "_sock": "_lock",
         "stats": "_stats_lock",
     }
+    _NOT_GUARDED = {
+        "_admission": "set once by the owning actor runner "
+                      "(set_admission) before the publish thread starts; "
+                      "read-only on the PUT paths thereafter",
+    }
 
     def __init__(
         self,
@@ -817,11 +847,13 @@ class TransportClient(_LockedStatsMixin):
         self.busy_timeout = busy_timeout
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
+        self._admission = None  # data/admission.AdmissionController
         # Per-actor observability (read by the actor loop's periodic stat
         # line; fairness evidence for the 20-actor topology demo).
         self.stats = {"unrolls_sent": 0, "busy_waits": 0,
                       "partial_accepts": 0, "weight_pulls": 0,
-                      "acts": 0, "act_busy_waits": 0}
+                      "acts": 0, "act_busy_waits": 0,
+                      "unrolls_admission_dropped": 0}
         self._stats_lock = threading.Lock()
         # Jittered act-busy backoff: deterministic seeds would march a
         # fleet of rejected actors back in lockstep (the thundering herd
@@ -899,6 +931,13 @@ class TransportClient(_LockedStatsMixin):
             raise TransportError(f"op {op} failed on the learner side")
         return resp
 
+    def set_admission(self, controller) -> None:
+        """Attach an actor-side admission controller
+        (data/admission.AdmissionController): PUT paths score + stamp
+        each unroll and feed reply pressure back to it. Call before the
+        publish thread starts (see _NOT_GUARDED)."""
+        self._admission = controller
+
     def put_trajectory(self, tree: Any) -> bool:
         """Ship one trajectory; blocks (via ST_BUSY retries) while the
         learner's bounded queue is full — the reference's blocking-enqueue
@@ -910,17 +949,37 @@ class TransportClient(_LockedStatsMixin):
         learner (queue permanently full) must surface as TransportError so
         the actor-side elastic-recovery grace deadline owns the failure,
         instead of this loop blocking the actor forever."""
-        # Trajectory PUTs are the dedup-eligible wire traffic (frame-stacked
-        # observation leaves); weights/inference encodes stay plain.
-        blob = codec.encode(tree, dedup=codec.obs_dedup_enabled())
+        ctrl = self._admission
+        payload: Any
+        if ctrl is not None:
+            decision = ctrl.admit(tree)
+            if not decision.send:  # dropped at source; mass folded into
+                self._bump("unrolls_admission_dropped")  # the next stamp
+                return True
+            if decision.tree is not None:
+                tree = decision.tree
+            # Stamp frame as a separate send part: the blob bytes are
+            # untouched (zero-copy on the wire path).
+            payload = [codec.stamp_frame(decision.stamp),
+                       codec.encode(tree, dedup=codec.obs_dedup_enabled())]
+            ctrl.note_wire(len(payload[0]) + len(payload[1]), decision)
+        else:
+            # Trajectory PUTs are the dedup-eligible wire traffic
+            # (frame-stacked observation leaves); weights/inference
+            # encodes stay plain.
+            payload = codec.encode(tree, dedup=codec.obs_dedup_enabled())
         busy_since: float | None = None
         while True:
             try:
-                status, _ = self._exchange(OP_PUT_TRAJ, blob, retry=True, resend=False)
+                status, resp = self._exchange(OP_PUT_TRAJ, payload, retry=True, resend=False)
             except TransportError:
                 if self._is_down():  # reconnect failed: learner is gone
                     raise
                 return False
+            if ctrl is not None and len(resp) >= _U16.size:
+                # Ingest-pressure feedback rides every PUT reply
+                # (ST_BUSY included — that IS maximal pressure).
+                ctrl.observe_pressure(_U16.unpack_from(resp, 0)[0])
             if status == ST_OK:
                 self._bump("unrolls_sent")
                 return True
@@ -950,10 +1009,33 @@ class TransportClient(_LockedStatsMixin):
         Semantics match put_trajectory: at-most-once per blob (a dropped
         connection loses the in-flight batch, returns the count shipped
         so far), bounded ST-BUSY-equivalent retries of the NOT-enqueued
-        tail on partial acceptance.
+        tail on partial acceptance. Unrolls the admission controller
+        drops at source count as accepted in the return value — they
+        were disposed of by design, not refused.
         """
+        ctrl = self._admission
         dedup = codec.obs_dedup_enabled()
-        blobs = [codec.encode(t, dedup=dedup) for t in trees]
+        dropped = 0
+        if ctrl is not None:
+            blobs = []
+            for t in trees:
+                decision = ctrl.admit(t)
+                if not decision.send:
+                    dropped += 1
+                    continue
+                sent_tree = t if decision.tree is None else decision.tree
+                # One contiguous buffer per unroll: pack_batch frames
+                # each blob by length, stamp included.
+                blob = codec.stamp_blob(
+                    codec.encode(sent_tree, dedup=dedup), decision.stamp)
+                ctrl.note_wire(len(blob), decision)
+                blobs.append(blob)
+            if dropped:
+                self._bump("unrolls_admission_dropped", dropped)
+            if not blobs:
+                return dropped
+        else:
+            blobs = [codec.encode(t, dedup=dedup) for t in trees]
         sent = 0
         busy_since: float | None = None
         while sent < len(blobs):
@@ -963,12 +1045,17 @@ class TransportClient(_LockedStatsMixin):
             except TransportError:
                 if self._is_down():  # reconnect failed: learner is gone
                     raise
-                return sent  # batch fate unknown: drop, never duplicate
+                return sent + dropped  # batch fate unknown: drop, never duplicate
             if status == ST_CLOSED:
                 raise TransportError("learner closed the data plane")
             if status != ST_OK:
                 raise TransportError("put_trajectories failed on the learner side")
-            accepted = _I64.unpack(resp)[0]
+            # unpack_from, never strict unpack: the reply grows trailing
+            # fields (pressure today) and must keep parsing on clients
+            # that predate them.
+            accepted = _I64.unpack_from(resp, 0)[0]
+            if ctrl is not None and len(resp) >= _I64.size + _U16.size:
+                ctrl.observe_pressure(_U16.unpack_from(resp, _I64.size)[0])
             sent += accepted
             self._bump("unrolls_sent", accepted)
             if sent < len(blobs):
@@ -983,7 +1070,7 @@ class TransportClient(_LockedStatsMixin):
                         f"learner queue busy for >{self.busy_timeout:.0f}s")
                 if accepted:
                     busy_since = now  # progress resets the wedge clock
-        return sent
+        return sent + dropped
 
     def get_weights_if_newer(self, have_version: int) -> tuple[Any, int] | None:
         t0 = time.perf_counter()  # unconditional: enablement can race the
@@ -1126,6 +1213,11 @@ class RemoteQueue:
 
     def __init__(self, client: TransportClient):
         self._client = client
+
+    def set_admission(self, controller) -> None:
+        """Delegate to the client: its PUT paths own scoring/stamping
+        (data/admission.py)."""
+        self._client.set_admission(controller)
 
     def put(self, item: Any, timeout: float | None = None) -> bool:
         return self._client.put_trajectory(item)  # False = dropped (at-most-once)
@@ -2117,6 +2209,19 @@ def run_role(
         # pending over the replicas, permanent demote of dead ones, the
         # learner's in-process service as fallback. Without it, the
         # single-endpoint learner service (pre-replica topologies).
+        # Sample-at-source (data/admission.py): score + stamp initial
+        # priorities on this side of the wire, and thin low-priority
+        # unrolls under learner backpressure. One controller per actor,
+        # shared with the pipeline publisher's queue below (the folded-
+        # mass ledger and the pressure EWMA must be one account).
+        from distributed_reinforcement_learning_tpu.data import admission
+
+        admission_ctrl = admission.configure(actor_queue, algo,
+                                             seed=seed + 1 + task)
+        if admission_ctrl is not None:
+            print(f"[actor {task}] actor-side priority stamping on "
+                  f"(scorer={admission_ctrl.scorer_name}, "
+                  f"admission={'on' if admission.admission_enabled() else 'off'})")
         remote: Any = None
         if remote_act:
             infer_addrs = [a for a in
@@ -2148,9 +2253,15 @@ def run_role(
         if (actor_pipeline.pipeline_enabled()
                 and type(actor_queue) is RemoteQueue):
             pub_client = TransportClient(server_ip, port)
+            pub_queue = RemoteQueue(pub_client)
+            if admission_ctrl is not None:
+                # SAME controller as the step-loop queue: stamping and
+                # the folded-mass ledger follow the unrolls to whichever
+                # client ships them.
+                pub_queue.set_admission(admission_ctrl)
             actor = actor_pipeline.maybe_wrap(
                 actor, label=f"actor {task}",
-                publisher_queue=RemoteQueue(pub_client))
+                publisher_queue=pub_queue)
         else:
             actor = actor_pipeline.maybe_wrap(actor, label=f"actor {task}")
         if pub_client is not None and not isinstance(

@@ -294,7 +294,8 @@ def build_report(tdir: str, merge: bool = True) -> str:
             if name.startswith(("staleness_bucket/", "codec/", "board/",
                                 "replay_shard/", "inference/",
                                 "remote_act/", "wshard/", "weights/",
-                                "fleet/", "pipe/", "devpath/")):
+                                "fleet/", "pipe/", "devpath/",
+                                "admission/")):
                 continue  # rendered as their own sections below
             any_counter = True
             out(f"  {shard_label(shard):<14} {name:<28} "
@@ -471,6 +472,55 @@ def build_report(tdir: str, merge: bool = True) -> str:
         out("")
         out("-- Replay shards (ingest-time prioritization) --")
         lines.extend(shard_lines)
+
+    # Sample-at-source admission (data/admission.py): actor-side stamp/
+    # subsample/drop ladder + the learner-side fast-accept split. Bytes
+    # saved is the actors' estimate of wire traffic the ladder avoided
+    # (subsample: payload-proportional; whole drops: full-unroll EWMA).
+    # Section only appears when a run stamped or fast-accepted blobs.
+    adm_lines: list[str] = []
+    for shard in shards:
+        rates = shard.counter_rates()
+
+        def total(name: str) -> float:
+            return rates.get(name, {}).get("total", 0)
+
+        stamped = total("admission/stamped_puts")
+        if stamped > 0:  # actor side
+            dropped_u = total("admission/dropped_unrolls")
+            sub_puts = total("admission/subsampled_puts")
+            sub_t = total("admission/subsample_dropped_transitions")
+            mass = total("admission/dropped_mass")
+            sent_b = total("admission/wire_bytes_sent")
+            saved_b = total("admission/wire_bytes_saved")
+            press = shard.gauge_stats("admission/pressure")
+            press_part = (f"pressure {press['last']:.2f} "
+                          f"(peak {press['max']:.2f})  "
+                          if press is not None else "")
+            adm_lines.append(
+                f"  {shard_label(shard)}: stamped {stamped:.0f} puts "
+                f"({sub_puts:.0f} subsampled, -{sub_t:.0f} transitions; "
+                f"{dropped_u:.0f} unrolls dropped whole, "
+                f"mass {mass:.1f} folded)  {press_part}")
+            if sent_b > 0 or saved_b > 0:
+                pct = (100 * saved_b / (sent_b + saved_b)
+                       if sent_b + saved_b > 0 else 0.0)
+                adm_lines.append(
+                    f"  {shard_label(shard)}: wire {sent_b / 1e6:.1f} MB sent, "
+                    f"~{saved_b / 1e6:.1f} MB saved at source ({pct:.0f}%)")
+        fast = total("admission/ingest_stamped")
+        plain = total("admission/ingest_scored")
+        if fast + plain > 0:  # learner side
+            folded = total("admission/folded_mass")
+            adm_lines.append(
+                f"  {shard_label(shard)}: ingest fast-accepted {fast:.0f} "
+                f"stamped blobs, scored {plain:.0f} plain "
+                f"({100 * fast / (fast + plain):.0f}% skipped scoring; "
+                f"folded mass {folded:.1f} drained)")
+    if adm_lines:
+        out("")
+        out("-- Ingest admission (sample-at-source) --")
+        lines.extend(adm_lines)
 
     # Device sample path (data/device_path.py): the fused gather ->
     # H2D -> scanned-learn pipeline on the learner shard. Depth gauge

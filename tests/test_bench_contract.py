@@ -358,6 +358,70 @@ class TestReplayCompare:
         assert shard_count() == 0
 
 
+class TestAdmissionCompare:
+    """bench_admission_compare: the two-process scored-vs-stamped
+    sample-at-source A/B whose verdict gates data/admission's
+    auto-enable. Driven directly at a tiny config (CPU, real child over
+    loopback TCP) — the committed adjudication numbers live in
+    benchmarks/admission_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        bench = _load_bench()
+        r = bench.bench_admission_compare(n_unrolls=24, unrolls_per_put=8,
+                                          steps=16, obs_dim=16, reps=1)
+        for leg in ("scored", "stamped", "admitted"):
+            assert r[leg]["accepted_transitions"] > 0, r
+            assert r[leg]["ingest_cpu_us_per_transition"] > 0
+            assert r[leg]["wire_bytes"] > 0
+        # Each leg really took its intended ingest path.
+        assert r["scored"]["stamped_blobs"] == 0
+        assert r["stamped"]["stamped_blobs"] == 24
+        assert r["admitted"]["child"]["subsample_dropped"] > 0  # thinned
+        # Conservation: the child's dropped mass is the learner's folded
+        # mass plus the controller's undrained ledger.
+        child = r["admitted"]["child"]
+        assert abs(child["dropped_mass"] - (r["admitted"]["folded_mass"]
+                                            + child["pending_folded"])) < 1e-9
+        assert r["scored_vs_stamped_cpu"] > 0
+        assert r["auto_enable"] == (r["scored_vs_stamped_cpu"] >= 1.2)
+        assert r["admission_auto_enable"] is False  # opt-in by design
+        assert r["verdict"].startswith("actor stamps ") and (
+            "auto-on" in r["verdict"] or "opt-in" in r["verdict"])
+
+    def test_compact_line_carries_admission_verdict_key(self):
+        bench = _load_bench()
+        assert "admission_verdict" in bench._COMPACT_KEYS
+
+    def test_committed_verdict_file_consistent(self, monkeypatch):
+        """The committed adjudication parses, and the gates follow it
+        when the env knobs are unset (env force > committed verdict >
+        off)."""
+        monkeypatch.delenv("DRL_ACTOR_PRIORITY", raising=False)
+        monkeypatch.delenv("DRL_ADMISSION", raising=False)
+        verdict = json.loads(
+            (REPO / "benchmarks" / "admission_verdict.json").read_text())
+        assert isinstance(verdict["actor_priority_auto_enable"], bool)
+        assert isinstance(verdict["admission_auto_enable"], bool)
+        assert verdict["ratio_runs"] and verdict["bar"] == 1.2
+        from distributed_reinforcement_learning_tpu.data import admission
+
+        admission.refresh_flags()
+        try:
+            assert (admission.actor_priority_enabled()
+                    is verdict["actor_priority_auto_enable"])
+            assert (admission.admission_enabled()
+                    is verdict["admission_auto_enable"])
+            monkeypatch.setenv("DRL_ACTOR_PRIORITY", "1")
+            monkeypatch.setenv("DRL_ADMISSION", "1")
+            admission.refresh_flags()
+            assert admission.actor_priority_enabled()  # env force wins
+            assert admission.admission_enabled()
+        finally:
+            monkeypatch.undo()
+            admission.refresh_flags()
+
+
 class TestDevicePathCompare:
     """bench_device_path_compare: the host-vs-fused sample-path A/B
     whose verdict gates data/device_path's auto-enable. Driven directly

@@ -668,6 +668,7 @@ class TestCliAndTreeGate:
             "runtime/publishing.py": 1,  # empty-map documentation form
             "runtime/inference.py": 1,
             "runtime/serving.py": 1,     # ContinuousInferenceServer
+            "data/admission.py": 2,      # DutyMeter + AdmissionController
             "data/fifo.py": 1,
             "data/replay.py": 3,         # Native/Array backends + doc note
             "data/replay_service.py": 2,  # ReplayShard + ShardedReplayService
