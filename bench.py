@@ -75,7 +75,8 @@ _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
                  "codec_verdict", "weights_verdict", "weights_shard_verdict",
                  "replay_verdict", "inference_verdict", "chaos_verdict",
                  "actor_pipeline_verdict", "learner_verdict",
-                 "device_path_verdict", "admission_verdict")
+                 "device_path_verdict", "admission_verdict",
+                 "collective_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -3021,6 +3022,183 @@ def bench_learner_compare(seats: int = 2, sync: str = "allreduce",
     return out
 
 
+def bench_collective_compare(shape: str = "xformer", rounds: int = 10,
+                             warmup: int = 2) -> dict:
+    """In-process two-seat A/B of the partition-aware learner collective
+    (parallel/collective.py): the xformer-shaped gradient pytree
+    (`_shard_bench_params` — the ~19 MB policy scale the partitioned
+    exchange exists for) flattened to the tier's flat vector and
+    exchanged over loopback TCP between two HostCollective seats, three
+    ways — the legacy whole-vector f32 ring, the partition-aware f32
+    exchange (replicated segments ring, pipe/model classes
+    owner-scoped), and the same plan bf16-encoded (data/bf16.py RNE
+    codec, f32 master accumulation). Reports median wall-clock per round
+    and wire bytes per round by spec class. `quant_auto_enable` follows
+    the repo's 1.2x wall-clock rule (bf16 vs f32 under the SAME plan);
+    the byte cut is recorded either way — on a loopback container the
+    wire is memcpy-cheap, so an honest negative ships bf16 opt-in with
+    the byte economics on record for real-NIC hosts. A fourth
+    measurement prices DRL_COLL_OVERLAP the same way: the bf16 exchange
+    pipelined against a calibrated simulated backward (one round in
+    flight, delayed apply — runtime/learner_tier.py's worker) vs the
+    same work run serially."""
+    import threading as _threading
+
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.parallel.collective import (
+        HostCollective)
+    from distributed_reinforcement_learning_tpu.parallel.partition import (
+        build_exchange_plan)
+    from distributed_reinforcement_learning_tpu.runtime.learner_tier import (
+        flatten_tree)
+
+    params = _shard_bench_params(shape)
+    vec0, _ = flatten_tree(params)
+    plan_f32 = build_exchange_plan(params, quant="f32")
+    plan_bf16 = build_exchange_plan(params, quant="bf16")
+    addrs = [f"127.0.0.1:{_free_port()}" for _ in range(2)]
+    colls = [HostCollective(r, addrs) for r in range(2)]
+    for c in colls:
+        c.wait_s = 30.0
+        c.start()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if all(colls[r].probe_peer(1 - r, timeout=1.0) for r in range(2)):
+            break
+        time.sleep(0.1)
+
+    def run_rounds(plan, n: int) -> list:
+        times: list = []
+
+        def seat(rank):
+            v = vec0 + np.float32(rank)
+            for _ in range(n):
+                t0 = time.perf_counter()
+                colls[rank].allreduce_mean(v, plan=plan)
+                if rank == 0:
+                    times.append((time.perf_counter() - t0) * 1e3)
+
+        ths = [_threading.Thread(target=seat, args=(r,)) for r in range(2)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120.0)
+        if any(t.is_alive() for t in ths):
+            raise RuntimeError("collective variant wedged mid-round")
+        return times
+
+    def class_bytes() -> dict:
+        s = colls[0].snapshot_stats()
+        return {k: s[k] for k in s if k.startswith("coll_bytes")} | {
+            "bytes_sent": s["bytes_sent"]}
+
+    out: dict = {"shape": shape, "rounds": rounds,
+                 "vector_mb": round(vec0.nbytes / 2**20, 2),
+                 "plan_classes": plan_f32.classes}
+    variants = (("ring_f32", None), ("part_f32", plan_f32),
+                ("part_bf16", plan_bf16))
+    try:
+        for name, plan in variants:
+            run_rounds(plan, warmup)
+            before = class_bytes()
+            times = run_rounds(plan, rounds)
+            after = class_bytes()
+            per_class = {k: (after[k] - before[k]) // rounds
+                         for k in after if after[k] != before[k]}
+            out[name] = {
+                "round_ms_p50": round(float(np.median(times)), 2),
+                "round_ms_max": round(float(np.max(times)), 2),
+                "bytes_per_round": int((after["bytes_sent"]
+                                        - before["bytes_sent"]) // rounds),
+                "bytes_by_class": {k: int(v) for k, v in per_class.items()
+                                   if k != "bytes_sent"},
+            }
+
+        byte_cut = 1.0 - (out["part_bf16"]["bytes_per_round"]
+                          / max(out["part_f32"]["bytes_per_round"], 1))
+        ratio_quant = (out["part_f32"]["round_ms_p50"]
+                       / max(out["part_bf16"]["round_ms_p50"], 1e-9))
+
+        # Overlap A/B: exchange pipelined against a calibrated simulated
+        # backward (busy f32 matmuls ~ one round's wall clock) vs serial.
+        bw_ms = out["part_bf16"]["round_ms_p50"]
+        a = np.random.RandomState(0).standard_normal((256, 256)).astype(
+            np.float32)
+        t0 = time.perf_counter()
+        a @ a
+        unit_ms = max((time.perf_counter() - t0) * 1e3, 1e-3)
+        reps_per_bw = max(1, int(bw_ms / unit_ms))
+
+        def backward():
+            for _ in range(reps_per_bw):
+                a @ a  # noqa: B018 — busy work standing in for backward
+
+        def overlap_variant(pipelined: bool) -> float:
+            def seat0():
+                if not pipelined:
+                    for _ in range(rounds):
+                        backward()
+                        colls[0].allreduce_mean(vec0, plan=plan_bf16)
+                    return
+                worker_in: list = []
+                sem = _threading.Semaphore(0)
+                done = _threading.Semaphore(0)
+
+                def worker():
+                    for _ in range(rounds):
+                        sem.acquire()
+                        colls[0].allreduce_mean(worker_in.pop(), plan=plan_bf16)
+                        done.release()
+
+                w = _threading.Thread(target=worker)
+                w.start()
+                for i in range(rounds):
+                    worker_in.append(vec0)
+                    sem.release()  # round i exchanges while we backward
+                    backward()
+                    done.acquire()  # delayed apply: join round i
+                w.join(timeout=60.0)
+
+            def seat1():
+                for _ in range(rounds):
+                    colls[1].allreduce_mean(vec0, plan=plan_bf16)
+
+            t0 = time.perf_counter()
+            ths = [_threading.Thread(target=f) for f in (seat0, seat1)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=120.0)
+            if any(t.is_alive() for t in ths):
+                raise RuntimeError("overlap variant wedged mid-round")
+            return (time.perf_counter() - t0) * 1e3 / rounds
+
+        serial_ms = overlap_variant(False)
+        overlapped_ms = overlap_variant(True)
+        ratio_overlap = serial_ms / max(overlapped_ms, 1e-9)
+    finally:
+        for c in colls:
+            c.close()
+
+    out["byte_cut"] = round(byte_cut, 4)
+    out["quant_ratio"] = round(ratio_quant, 2)
+    out["quant_auto_enable"] = ratio_quant >= 1.2
+    out["overlap"] = {"simulated_backward_ms": round(bw_ms, 2),
+                      "serial_step_ms": round(serial_ms, 2),
+                      "overlapped_step_ms": round(overlapped_ms, 2)}
+    out["overlap_ratio"] = round(ratio_overlap, 2)
+    out["overlap_auto_enable"] = ratio_overlap >= 1.2
+    out["verdict"] = (
+        f"partitioned collective @ {shape}: bf16 cuts "
+        f"{byte_cut:.0%} wire bytes/round, {ratio_quant:.2f}x round "
+        f"wall-clock ({'auto-on' if out['quant_auto_enable'] else 'opt-in'}); "
+        f"overlap {ratio_overlap:.2f}x step wall-clock "
+        f"({'auto-on' if out['overlap_auto_enable'] else 'opt-in'})")
+    print(f"[bench] collective_compare: {out['verdict']}", file=sys.stderr)
+    return out
+
+
 # Child processes for bench_inference_compare. The REPLICA child is one
 # act-serving process of the inference tier (runtime/serving.py): it
 # pulls weights from the parent's transport server, warms the bucketed
@@ -5357,6 +5535,21 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["learner_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] learner_compare failed: {e}", file=sys.stderr)
+
+    # Partition-aware collective A/B (the bf16/overlap adjudication for
+    # the learner tier's gradient exchange, parallel/collective.py):
+    # ring vs partitioned vs bf16-encoded rounds at the xformer gradient
+    # shape, plus the backward-overlap pipeline.
+    if os.environ.get("BENCH_COLLECTIVE", "1") == "1" and _ok(
+            "collective_compare", 60):
+        try:
+            r = bench_collective_compare()
+            extra["collective_compare"] = r
+            if "verdict" in r:
+                extra["collective_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["collective_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] collective_compare failed: {e}", file=sys.stderr)
 
     # Multi-process chaos drill (the elastic-fleet adjudication,
     # runtime/fleet.py): kill+respawn the learner mid-window, assert

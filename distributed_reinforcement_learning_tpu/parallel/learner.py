@@ -121,6 +121,27 @@ class ShardedLearner:
             out_shardings=out_shardings,
             donate_argnums=(0,),
         )
+        # Split learn step on the mesh (learner-tier allreduce seam):
+        # gradients come OUT in the params' sharding — the host-side
+        # partition plan (parallel/partition.py) then exchanges each
+        # spec class owner-scoped instead of ring-reducing the full
+        # vector. apply_grads does NOT donate state, mirroring the
+        # agents' own split jits (the tier holds state across the
+        # exchange). Only the replay families' (state, batch,
+        # is_weight) arity carries the seam.
+        if (num_data_args == 2 and hasattr(agent, "_grads")
+                and hasattr(agent, "_apply_grads")):
+            params_sh = self.state_sharding.params
+            self.grads = jax.jit(
+                agent._grads,
+                in_shardings=(self.state_sharding,) + (self._data_sh,) * 2,
+                out_shardings=(params_sh, self._repl, self._repl),
+            )
+            self.apply_grads = jax.jit(
+                agent._apply_grads,
+                in_shardings=(self.state_sharding, params_sh, self._repl),
+                out_shardings=(self.state_sharding, self._repl),
+            )
         # K-step scanned learn over [K, B, ...] stacks (agents/common
         # scan_learn): the scan carries the sharded TrainState, each
         # iteration's batch slice shards its B dim over `data`. Only the

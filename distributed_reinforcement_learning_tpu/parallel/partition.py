@@ -144,6 +144,47 @@ class ShardPlan:
         return list(self.shards)
 
 
+def build_exchange_plan(params: Any,
+                        rules: Sequence[tuple[str, P]] | None = None,
+                        quant: str = "f32", overlap: int = 0,
+                        tail: int = 0):
+    """Classify every leaf of `params` (a gradient-shaped pytree) into
+    its partition-spec class and lay the classes out over the learner
+    tier's FLAT vector — a `parallel/collective.ExchangePlan`.
+
+    Order alignment is the load-bearing part: the tier's
+    `flatten_tree` walks `jax.tree.flatten` order, while the rules
+    match `/`-joined names from the codec's canonical flatten. So the
+    per-leaf class is computed name-keyed (`named_tree_map`) into a
+    same-shaped tree, and THAT tree is `jax.tree.flatten`ed — the
+    class list comes out in exactly the order the flat vector
+    concatenates leaves, whatever the two flattens' relative key
+    ordering. `tail` appends that many replicated elements for the
+    values the tier rides on the vector's tail (the loss float).
+
+    Two seats building a plan from the same params schema, rules, and
+    config produce byte-identical entries and therefore the same
+    `plan_hash` — the agreement HELLO pins (tested at k=2/k=3)."""
+    import jax
+
+    from distributed_reinforcement_learning_tpu.parallel.collective import (
+        ExchangePlan,
+    )
+
+    if rules is None:
+        rules = default_partition_rules()
+    keyed = named_tree_map(
+        lambda name, leaf: (spec_key(leaf_spec(rules, name, leaf)),
+                            int(np.asarray(leaf).size)),
+        params)
+    entries, _ = jax.tree.flatten(
+        keyed, is_leaf=lambda x: isinstance(x, tuple))
+    entries = list(entries)
+    if tail:
+        entries.append((REPLICATED_KEY, int(tail)))
+    return ExchangePlan(entries, quant=quant, overlap=overlap)
+
+
 def shard_plan(params: Any,
                rules: Sequence[tuple[str, P]] | None = None) -> ShardPlan:
     """Split `params` into partition-keyed shards (sorted keys, so two

@@ -13,9 +13,17 @@ module turns `--mode learner` into one SEAT of an N-seat tier:
   its own train loop;
 - train steps exchange gradients through a host-side collective
   (`parallel/collective.py`) in one of two modes (`DRL_LEARNER_SYNC`):
-  `allreduce` — lockstep ring allreduce of the per-seat gradients
-  (mean), numerically the union-batch gradient, requiring the agent's
-  split learn step (`agent.grads`/`agent.apply_grads`, ApexAgent) —
+  `allreduce` — lockstep gradient exchange (mean), numerically the
+  union-batch gradient, requiring the split learn step
+  (`agent.grads`/`agent.apply_grads` on a plain seat,
+  `ShardedLearner.grads`/`apply_grads` on a mesh-sharded one). By
+  default the exchange is PARTITION-AWARE: attach classifies every
+  gradient leaf through `parallel/partition.py`, replicated segments
+  ride the ring, model/expert/pipe-sharded classes go owner-scoped,
+  optionally bf16-encoded (`DRL_COLL_QUANT`) and overlapped with the
+  next step's backward (`DRL_COLL_OVERLAP`); the plan hash rides the
+  HELLOs, and disagreement refuses loudly. `DRL_COLL_PARTITION=0`
+  restores the whole-vector f32 ring byte-for-byte —
   or `async` — IMPACT-style (arXiv:1912.00167) bounded-staleness
   parameter merging: seats train free-running and every
   `DRL_LEARNER_MERGE_STEPS` steps push their params to peers and
@@ -47,6 +55,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import threading
 import time
 from typing import Any
@@ -139,6 +148,110 @@ def stale_max() -> int:
     return _env_int("DRL_LEARNER_STALE_MAX", 4, floor=0)
 
 
+# -- partition-aware collective gates ------------------------------------------
+
+_COLL_VERDICT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "benchmarks", "collective_verdict.json")
+
+_coll_flag_lock = threading.Lock()
+_coll_flags: dict[str, Any] = {"partition": None, "quant": None,
+                               "overlap": None}
+
+
+def _coll_verdict() -> dict:
+    try:
+        with open(_COLL_VERDICT_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _coll_resolve(name: str, compute) -> Any:
+    with _coll_flag_lock:
+        cached = _coll_flags[name]
+    if cached is not None:
+        return cached
+    value = compute()
+    with _coll_flag_lock:
+        _coll_flags[name] = value
+    return value
+
+
+def coll_partition() -> bool:
+    """DRL_COLL_PARTITION=0 forces every allreduce round through the
+    legacy whole-vector f32 ring (byte-for-byte today's path), =1 forces
+    the partition-aware exchange on; unset defaults ON — attach builds a
+    plan whenever the learner exposes a params schema, and a seat with
+    no schema falls back to the ring regardless. Resolved once per
+    process; `refresh_coll_flags()` re-reads (tests/bench)."""
+
+    def compute():
+        env = os.environ.get("DRL_COLL_PARTITION", "").strip().lower()
+        if env in ("1", "true", "yes", "on"):
+            return True
+        if env in ("0", "false", "no", "off"):
+            return False
+        return True
+
+    return _coll_resolve("partition", compute)
+
+
+def coll_quant() -> str:
+    """Gradient transport encoding for partitioned rounds: "f32" (the
+    default) or "bf16" (half the wire bytes through the shared RNE
+    codec, f32 master accumulation). `DRL_COLL_QUANT` forces a mode
+    (`1` means bf16, `0` f32); unset defers to the committed
+    `collective_verdict.json` adjudication (`quant_auto_enable`) — the
+    repo's 1.2x rule. The mode is folded into the plan hash, so seats
+    resolving differently refuse loudly instead of merging mixed
+    encodings."""
+
+    def compute():
+        env = os.environ.get("DRL_COLL_QUANT", "").strip().lower()
+        if env in ("bf16", "1", "true", "yes", "on"):
+            return "bf16"
+        if env in ("f32", "0", "false", "no", "off"):
+            return "f32"
+        return ("bf16" if _coll_verdict().get("quant_auto_enable", False)
+                else "f32")
+
+    return _coll_resolve("quant", compute)
+
+
+def coll_overlap() -> int:
+    """Bounded in-flight exchange depth (`DRL_COLL_OVERLAP`): 0 (the
+    default) runs the exchange inline in the learn step; 1 hands it to
+    the tier's collective worker so round t's wire time overlaps round
+    t+1's backward (delayed apply — one-step-stale pipelined SGD, the
+    same staleness class the async mode already tolerates). Unset
+    defers to the committed verdict (`overlap_auto_enable`). Depth is
+    capped at 1: a deeper pipeline multiplies gradient staleness for no
+    additional overlap (one exchange already hides behind one
+    backward). Folded into the plan hash like the quant mode."""
+
+    def compute():
+        env = os.environ.get("DRL_COLL_OVERLAP", "").strip()
+        if env:
+            try:
+                return min(1, max(0, int(env)))
+            except ValueError as e:
+                raise ValueError(
+                    f"DRL_COLL_OVERLAP must be an integer, got {env!r}"
+                ) from e
+        return 1 if _coll_verdict().get("overlap_auto_enable", False) else 0
+
+    return _coll_resolve("overlap", compute)
+
+
+def refresh_coll_flags() -> None:
+    """Drop the cached gate resolutions (tests/bench re-resolve under a
+    mutated environment or verdict)."""
+    with _coll_flag_lock:
+        for key in _coll_flags:
+            _coll_flags[key] = None
+
+
 # -- gradient pytree <-> flat f32 vector --------------------------------------
 
 
@@ -205,6 +318,14 @@ class LearnerTier:
                     "only",
         "_sweeper": "start()/close() lifecycle handle, controlling "
                     "thread only",
+        "_plan": "attach()-time exchange layout, read-only afterwards "
+                 "(learn + collective-worker threads)",
+        "_coll_worker": "attach()/close() lifecycle handle, controlling "
+                        "thread only",
+        "_coll_in": "queue.Queue — internally locked",
+        "_coll_out": "queue.Queue — internally locked",
+        "_inflight": "learn-thread-only overlap credit (one exchange "
+                     "in flight at most)",
     }
 
     def __init__(self, rank: int, addrs: list[str], sync: str | None = None,
@@ -241,9 +362,15 @@ class LearnerTier:
         # per-sender freshness clock (see _maybe_async_merge).
         self._merge_seen: dict[int, tuple[int, int]] = {}
         self._learner = None
+        self._plan = None
+        self._coll_worker: threading.Thread | None = None
+        self._coll_in: queue.Queue = queue.Queue(maxsize=1)
+        self._coll_out: queue.Queue = queue.Queue(maxsize=1)
+        self._inflight = False
         self.stats = {"rounds": 0, "round_retries": 0, "round_giveups": 0,
                       "promotions": 0, "merge_rounds": 0,
-                      "merges_applied": 0, "merges_skipped_stale": 0}
+                      "merges_applied": 0, "merges_skipped_stale": 0,
+                      "overlap_rounds": 0}
         self._stats_lock = threading.Lock()
         self._stop = threading.Event()
         self._sweeper: threading.Thread | None = None
@@ -274,12 +401,19 @@ class LearnerTier:
             self.collective._note_dead(rank)
         if pending:
             self._check_membership()
+        # Plan negotiation rides the HELLOs just exchanged: every live
+        # peer has reported its partition-plan hash by now, and a clash
+        # is a LOUD refusal (PlanMismatch) — two seats quietly running
+        # different layouts/encodings would merge garbage.
+        self.collective.check_plan_agreement()
         return not pending
 
     def close(self) -> None:
         self._stop.set()
         if self._sweeper is not None:
             self._sweeper.join(timeout=2.0)
+        if self._coll_worker is not None:
+            self._coll_worker.join(timeout=2.0)
         self.collective.close()
 
     # -- stats -------------------------------------------------------------
@@ -413,8 +547,13 @@ class LearnerTier:
     def attach(self, learner) -> None:
         """Wire the tier into a prioritized-replay learner: wrap its
         `_learn` with the collective exchange. `allreduce` needs the
-        agent's split learn step (`grads`/`apply_grads` — ApexAgent);
-        `async` wraps any `_learn`-shaped learner.
+        split learn step — `agent.grads`/`apply_grads` (ApexAgent) on a
+        single-device seat, `ShardedLearner.grads`/`apply_grads` (the
+        pjit pair) on a mesh-sharded one; `async` wraps any
+        `_learn`-shaped learner. Attaching also builds the partition
+        plan (`_build_plan`) and pins it into the collective's HELLO
+        negotiation — seats with differing plans refuse loudly at
+        `await_peers`.
 
         Host-loop contract under `allreduce`: the collective couples
         the seats' TRAIN cadences, so the driving loop must BOUND how
@@ -457,24 +596,42 @@ class LearnerTier:
             # scan call; a prefetcher keeps stacking K).
         if self.sync == "allreduce":
             agent = learner.agent
-            if getattr(learner, "_sharded", None) is not None:
-                # The mesh-sharded learn step (ShardedLearner) and the
-                # tier's grads/apply split are different planes:
-                # silently replacing the pjit step with plain jits
-                # would bypass the device sharding AND gather the
-                # model-sharded gradients to host every step.
-                raise ValueError(
-                    "DRL_LEARNER_SYNC=allreduce cannot wrap a "
-                    "mesh-sharded learner (ShardedLearner) — run tier "
-                    "seats single-device, or use DRL_LEARNER_SYNC="
-                    "async (which wraps the sharded step unchanged)")
-            if not (hasattr(agent, "grads") and hasattr(agent, "apply_grads")):
+            sharded = getattr(learner, "_sharded", None)
+            if sharded is not None:
+                # Mesh-sharded seat: run the split learn step THROUGH
+                # the pjit wiring (ShardedLearner.grads/apply_grads, the
+                # same in/out shardings as its fused learn) so device
+                # sharding is preserved; the host exchange then routes
+                # each gradient leaf by its partition class (replicated
+                # -> ring, model/expert/pipe -> owner-scoped star) via
+                # the plan built below.
+                if not (hasattr(sharded, "grads")
+                        and hasattr(sharded, "apply_grads")):
+                    raise ValueError(
+                        "DRL_LEARNER_SYNC=allreduce needs the split "
+                        "learn step on the mesh learner "
+                        "(ShardedLearner.grads/apply_grads — the "
+                        "replay-family (state, batch, is_weight) "
+                        "arity); this ShardedLearner lacks it. Use "
+                        "DRL_LEARNER_SYNC=async for this family.")
+                grads_fn, apply_fn = sharded.grads, sharded.apply_grads
+            elif hasattr(agent, "grads") and hasattr(agent, "apply_grads"):
+                grads_fn, apply_fn = agent.grads, agent.apply_grads
+            else:
                 raise ValueError(
                     f"DRL_LEARNER_SYNC=allreduce needs the split learn "
                     f"step (agent.grads/apply_grads — ApexAgent); "
                     f"{type(agent).__name__} lacks it. Use "
                     f"DRL_LEARNER_SYNC=async for this family.")
-            learner._learn = self._make_allreduce_learn(agent)
+            self._plan = self._build_plan(learner)
+            if self._plan is not None:
+                self.collective.set_plan(self._plan)
+                if self._plan.overlap and self._coll_worker is None:
+                    self._coll_worker = threading.Thread(
+                        target=self._coll_loop, daemon=True,
+                        name=f"tier-coll-{self.rank}")
+                    self._coll_worker.start()
+            learner._learn = self._make_allreduce_learn(grads_fn, apply_fn)
         else:
             learner._learn = self._make_async_learn(learner._learn)
             if hasattr(learner, "_learn_many"):
@@ -483,6 +640,30 @@ class LearnerTier:
                 # merging reaches every train call.
                 learner._learn_many = self._make_async_learn(
                     learner._learn_many)
+
+    def _build_plan(self, learner):
+        """ExchangePlan from the learner's concrete params schema (the
+        gradient tree mirrors it leaf-for-leaf), or None — no schema /
+        partition gate off — meaning every round rides the legacy
+        whole-vector ring. The one-time np.asarray per leaf is the
+        deliberate host materialization: the plan needs real
+        shapes/sizes, and a mesh learner's params gather once at
+        attach, never per round. `tail=1` is the loss float the learn
+        wrap rides on the vector's end."""
+        if not coll_partition():
+            return None
+        state = getattr(learner, "state", None)
+        params = getattr(state, "params", None)
+        if params is None:
+            return None
+        import jax
+
+        from distributed_reinforcement_learning_tpu.parallel.partition import (
+            build_exchange_plan)
+
+        host = jax.tree.map(np.asarray, params)  # drlint: disable=host-sync
+        return build_exchange_plan(host, quant=coll_quant(),
+                                   overlap=coll_overlap(), tail=1)
 
     def _merged_rounds(self, vec: np.ndarray) -> np.ndarray:
         """One allreduce with membership-churn retries: an aborted round
@@ -493,12 +674,19 @@ class LearnerTier:
         fixed attempt count burns out in milliseconds of NAKs and
         strands the seats in different epochs. Past one wait budget of
         churn, this step trains on local gradients (solo fallback for
-        the step; the next round re-pairs at (epoch, seq=0))."""
+        the step; the next round re-pairs at (epoch, seq=0)). A
+        `PlanMismatch` is NOT retried — mismatched seats must refuse,
+        not spin."""
         self._bump("rounds")
         deadline = time.monotonic() + self.collective.wait_s
         while True:
             try:
-                return self.collective.allreduce_mean(vec)
+                t0 = time.perf_counter()
+                merged = self.collective.allreduce_mean(vec, plan=self._plan)
+                if _OBS.enabled:
+                    _OBS.gauge("tier/coll_round_ms",
+                               (time.perf_counter() - t0) * 1e3)
+                return merged
             except (RoundAborted, PeerLost):
                 self._bump("round_retries")
                 self._check_membership()
@@ -509,23 +697,75 @@ class LearnerTier:
                     return vec.astype(np.float32, copy=True)
                 time.sleep(0.1)  # let the slower survivors re-form
 
-    def _make_allreduce_learn(self, agent):
+    def _make_allreduce_learn(self, grads_fn, apply_fn):
+        overlap = self._plan is not None and self._plan.overlap > 0
+
         def tier_learn(state, batch, is_weight):
-            grads, td, loss = agent.grads(state, batch, is_weight)
+            grads, td, loss = grads_fn(state, batch, is_weight)
             gvec, meta = flatten_tree(grads)
             # Loss rides the vector's tail so the merged metrics carry
             # the tier-mean loss for free (one extra f32).
             vec = np.concatenate([gvec, np.float32([loss]).ravel()])
+            if overlap:
+                return self._overlap_step(state, vec, meta, loss, td,
+                                          apply_fn)
             t0 = time.perf_counter()
             merged = self._merged_rounds(vec)
             if _OBS.enabled:
                 _OBS.gauge("tier/round_ms", (time.perf_counter() - t0) * 1e3)
             mgrads = unflatten_tree(merged[:-1], meta)
-            state2, metrics = agent.apply_grads(state, mgrads,
-                                                np.float32(merged[-1]))
+            state2, metrics = apply_fn(state, mgrads,
+                                       np.float32(merged[-1]))
             return state2, td, metrics
 
         return tier_learn
+
+    # -- backward-overlapped rounds ----------------------------------------
+
+    def _overlap_step(self, state, vec, meta, loc_loss, td, apply_fn):
+        """One pipelined learn step: hand THIS round's vector to the
+        collective worker, apply the PREVIOUS round's merged gradients
+        (already exchanged while this step's backward ran). The first
+        call primes the pipeline — nothing merged yet, so the state
+        returns unchanged (metrics carry the local loss only) and
+        every seat stays bit-identical: only merged vectors, identical
+        on every seat, are ever applied. Exchange failures surface
+        HERE, on the learn thread, loudly (the worker forwards its
+        exception), so a PlanMismatch still refuses instead of
+        training on silently-unmerged gradients."""
+        prev = None
+        if self._inflight:
+            t0 = time.perf_counter()
+            prev = self._coll_out.get()
+            if _OBS.enabled:
+                _OBS.gauge("tier/coll_wait_ms",
+                           (time.perf_counter() - t0) * 1e3)
+            if isinstance(prev, BaseException):
+                self._inflight = False
+                raise prev
+        self._coll_in.put(vec)
+        self._inflight = True
+        self._bump("overlap_rounds")
+        if prev is None:
+            return state, td, {"loss": np.float32(loc_loss)}
+        state2, metrics = apply_fn(state, unflatten_tree(prev[:-1], meta),
+                                   np.float32(prev[-1]))
+        return state2, td, metrics
+
+    def _coll_loop(self) -> None:
+        """Collective-worker thread: drains one in-flight vector at a
+        time through `_merged_rounds` (bounded depth 1 by the
+        learn-side credit). Exceptions travel to the learn thread via
+        the result slot — the worker never dies silently."""
+        while not self._stop.is_set():
+            try:
+                vec = self._coll_in.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._coll_out.put(self._merged_rounds(vec))
+            except BaseException as e:  # noqa: BLE001 — forwarded, re-raised
+                self._coll_out.put(e)   # on the learn thread
 
     def _make_async_learn(self, orig_learn):
         # Signature-agnostic: the learner families' `_learn` arities

@@ -50,7 +50,8 @@ _SPARK = " .:-=+*#%@"
 
 # Gauges whose per-flush mean SERIES the report renders (sparklines);
 # every other gauge folds into a constant-size running aggregate.
-_SERIES_GAUGES = ("transport/queue_depth", "ring/depth")
+_SERIES_GAUGES = ("transport/queue_depth", "ring/depth",
+                  "tier/coll_round_ms")
 # Gauges needing the fallback per-window histogram (pre-exact-counter
 # shards): per-record (mean, n) folds straight into bucket counts.
 _STALE_GAUGE = "learner/weight_staleness"
@@ -695,6 +696,39 @@ def build_report(tdir: str, merge: bool = True) -> str:
                 f"    async merges {merges:.0f} applied / "
                 f"{total('tier/merges_skipped_stale'):.0f} dropped stale "
                 f"({total('tier/merge_rounds'):.0f} rounds)")
+
+        # Partition-aware collective (parallel/collective.py plan
+        # rounds): bytes/round by spec class, round latency p50/p99
+        # over the per-flush means, and the overlap ratio (share of
+        # exchange time hidden behind the backward — 1 when the learn
+        # thread never waited on the in-flight round).
+        part = total("tier/coll_rounds_part")
+        if part:
+            by_class = []
+            for cls in ("rep", "model", "expert", "pipe", "other"):
+                b = total(f"tier/coll_bytes_{cls}")
+                if b:
+                    by_class.append(f"{cls} {b / part / 1024:.1f}KB")
+            tier_lines.append(
+                f"    partitioned rounds {part:.0f} "
+                f"({total('tier/coll_quant_rounds'):.0f} bf16)  "
+                f"bytes/round: {'  '.join(by_class) or 'n/a'}")
+            series = shard.series.get("tier/coll_round_ms", [])
+            if series:
+                import numpy as _np
+
+                tier_lines.append(
+                    f"    coll round p50 {_np.percentile(series, 50):.2f}ms"
+                    f"  p99 {_np.percentile(series, 99):.2f}ms "
+                    f"({len(series)} windows)")
+            wait = shard.gauge_stats("tier/coll_wait_ms")
+            rnd = shard.gauge_stats("tier/coll_round_ms")
+            if wait is not None and rnd is not None and rnd["mean"] > 0:
+                hidden = max(0.0, 1.0 - wait["mean"] / rnd["mean"])
+                tier_lines.append(
+                    f"    overlap: {total('tier/overlap_rounds'):.0f} "
+                    f"pipelined steps  wait mean {wait['mean']:.2f}ms  "
+                    f"ratio {hidden:.0%} of exchange hidden")
     if tier_lines:
         out("")
         out("-- Learner tier (seats + collective) --")

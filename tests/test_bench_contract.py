@@ -45,7 +45,7 @@ _TRIMMED = {
     "BENCH_WEIGHTS_SHARD": "0", "BENCH_REPLAY": "0", "BENCH_INFER": "0",
     "BENCH_CHAOS": "0", "BENCH_ACTOR": "0",
     "BENCH_LEARNER": "0", "BENCH_SEAT_DRILL": "0",
-    "BENCH_DEVICE_PATH": "0",
+    "BENCH_DEVICE_PATH": "0", "BENCH_COLLECTIVE": "0",
 }
 
 
@@ -530,6 +530,68 @@ class TestLearnerCompare:
         assert seat_count() == 3  # env force wins over the verdict
         monkeypatch.setenv("DRL_LEARNER_SEATS", "0")
         assert seat_count() == 0
+
+
+class TestCollectiveCompare:
+    """bench_collective_compare: the ring-vs-partitioned-vs-bf16
+    gradient-exchange A/B whose verdict gates the DRL_COLL_QUANT /
+    DRL_COLL_OVERLAP defaults (runtime/learner_tier.py). Driven
+    directly at the small cnn shape — the committed xformer-scale
+    adjudication lives in benchmarks/collective_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        bench = _load_bench()
+        r = bench.bench_collective_compare(shape="cnn", rounds=3, warmup=1)
+        for side in ("ring_f32", "part_f32", "part_bf16"):
+            assert r[side]["round_ms_p50"] > 0, r
+            assert r[side]["round_ms_max"] >= r[side]["round_ms_p50"]
+            assert r[side]["bytes_per_round"] > 0
+        # The partitioned variants really routed by class; the plan-less
+        # ring has no class counters to report.
+        assert r["ring_f32"]["bytes_by_class"] == {}
+        assert r["part_f32"]["bytes_by_class"], r
+        # bf16 must halve the wire bytes exactly (u16 vs f32 words).
+        assert (r["part_bf16"]["bytes_per_round"] * 2
+                == r["part_f32"]["bytes_per_round"])
+        assert r["byte_cut"] >= 0.45
+        assert r["quant_auto_enable"] == (r["quant_ratio"] >= 1.2)
+        assert r["overlap_auto_enable"] == (r["overlap_ratio"] >= 1.2)
+        assert r["verdict"].startswith("partitioned collective ")
+
+    def test_compact_line_carries_collective_verdict_key(self):
+        bench = _load_bench()
+        assert "collective_verdict" in bench._COMPACT_KEYS
+        # The trimmed env the failure-mode subprocess tests run under
+        # must gate this (multi-collective, timed) section off.
+        assert _TRIMMED["BENCH_COLLECTIVE"] == "0"
+
+    def test_committed_verdict_file_consistent(self, monkeypatch):
+        """The committed adjudication parses, meets the byte-cut
+        acceptance bar, and the learner-tier gates follow it when the
+        env knobs are unset (env force > committed verdict > off)."""
+        verdict = json.loads(
+            (REPO / "benchmarks" / "collective_verdict.json").read_text())
+        assert isinstance(verdict["quant_auto_enable"], bool)
+        assert isinstance(verdict["overlap_auto_enable"], bool)
+        assert verdict["bar"] == 1.2
+        assert verdict["byte_cut"] >= 0.45  # the acceptance criterion
+        assert verdict["quant_ratio_runs"] and verdict["overlap_ratio_runs"]
+        from distributed_reinforcement_learning_tpu.runtime import (
+            learner_tier)
+
+        for key in ("DRL_COLL_PARTITION", "DRL_COLL_QUANT",
+                    "DRL_COLL_OVERLAP"):
+            monkeypatch.delenv(key, raising=False)
+        learner_tier.refresh_coll_flags()
+        try:
+            assert learner_tier.coll_partition() is True  # default ON
+            assert (learner_tier.coll_quant() == "bf16") \
+                is verdict["quant_auto_enable"]
+            assert (learner_tier.coll_overlap() == 1) \
+                is verdict["overlap_auto_enable"]
+        finally:
+            learner_tier.refresh_coll_flags()
 
 
 class TestInferenceCompare:

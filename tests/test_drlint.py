@@ -677,8 +677,15 @@ class TestCliAndTreeGate:
             #                              SPSC queue + atomic cfg swap)
             "data/native.py": 1,
             "parallel/collective.py": 3,  # Membership + endpoint
-            #                               + HostCollective
-            "runtime/learner_tier.py": 1,  # LearnerTier
+            #                               + HostCollective (whose map
+            #                               grew the plan-negotiation
+            #                               state: _peer_plans /
+            #                               _plan_hash / _plan_warned)
+            "runtime/learner_tier.py": 1,  # LearnerTier (its
+            #                                _NOT_GUARDED census covers
+            #                                the collective-worker
+            #                                handoff: _coll_in/_coll_out
+            #                                queues + _inflight credit)
             "runtime/fleet.py": 3,       # RetryLadder + FleetSupervisor
             #                              + HeartbeatLoop
             "runtime/actor_pipeline.py": 2,  # UnrollPublisher +

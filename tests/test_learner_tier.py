@@ -24,6 +24,7 @@ The acceptance pins of ISSUE 14:
 from __future__ import annotations
 
 import json
+import os
 import socket
 import subprocess
 import sys
@@ -340,7 +341,8 @@ class TestAllreduceEquivalence:
             t.collective.wait_s = 20.0
             t.start()
         try:
-            fns = [t._make_allreduce_learn(agent) for t in tiers]
+            fns = [t._make_allreduce_learn(agent.grads, agent.apply_grads)
+                   for t in tiers]
             states = [agent.sync_target(
                 agent.init_state(jax.random.PRNGKey(0))) for _ in range(2)]
 
@@ -362,6 +364,101 @@ class TestAllreduceEquivalence:
         finally:
             for t in tiers:
                 t.close()
+
+    @pytest.mark.skipif(
+        os.environ.get("DRL_SANITIZE") == "1"
+        and os.environ.get("DRL_RUN_SANITIZE_MESH") != "1",
+        reason="sanitized lock factories make two THREADS of pjit-mesh "
+               "dispatch pathologically slow inside jax internals (both "
+               "seats park in grads_fn, the collective idle — verified "
+               "by faulthandler stacks); the tier's own concurrency "
+               "surface is sanitized by every other suite test. "
+               "DRL_RUN_SANITIZE_MESH=1 forces.")
+    def test_mesh_seats_track_union_pjit_learner(self, monkeypatch):
+        """The tentpole's positive mesh contract (replacing the old
+        attach-time refusal): a mesh-sharded seat (ShardedLearner at
+        model_parallel=2) ATTACHES under allreduce, the negotiated plan
+        carries a model-sharded class, and three tier-wrapped steps on
+        each half-batch keep the two seats bit-identical to each other
+        and within the documented tolerance of the UNION-BATCH pjit
+        learner (rtol 1e-3 / atol 1e-6 after 3 Adam steps — the same
+        pin as the single-device tier). Both sides compile the same
+        GSPMD layout, so the pin isolates exactly what the tier adds:
+        the owner-scoped partitioned exchange."""
+        import jax
+
+        from distributed_reinforcement_learning_tpu.parallel import (
+            ShardedLearner, make_mesh)
+        from distributed_reinforcement_learning_tpu.runtime import (
+            learner_tier as lt)
+
+        monkeypatch.setenv("DRL_COLL_PARTITION", "1")
+        monkeypatch.setenv("DRL_COLL_QUANT", "f32")
+        monkeypatch.setenv("DRL_COLL_OVERLAP", "0")
+        lt.refresh_coll_flags()
+
+        agent, _, union, halves, isw = _apex_fixture()
+        mesh = make_mesh(8, model_parallel=2)
+        sl = ShardedLearner(agent, mesh, num_data_args=2, num_aux_outputs=2)
+        b = len(isw) // 2
+
+        def fresh_state():
+            return sl.place_state(agent.sync_target(
+                agent.init_state(jax.random.PRNGKey(0))))
+
+        s = fresh_state()
+        for _ in range(3):
+            s, _, _ = sl.learn(s, *sl.shard_batch((union, isw)))
+        union_params = jax.tree.map(np.asarray, s.params)
+
+        class MeshSeat:
+            def __init__(self):
+                self.agent = agent
+                self._sharded = sl
+                self.state = fresh_state()
+                self._learn = agent._learn  # seam attach() rebinds
+
+        addrs = _addrs(2)
+        tiers = [LearnerTier(r, addrs, sync="allreduce",
+                             probe_interval_s=60.0) for r in range(2)]
+        seats = [MeshSeat() for _ in range(2)]
+        for t, l in zip(tiers, seats):
+            t.collective.wait_s = 20.0
+            t.start()
+            t.attach(l)
+        try:
+            # The negotiated plan: same hash on both seats, and the
+            # model-sharded gradient class is in it.
+            assert tiers[0]._plan is not None
+            assert tiers[0]._plan.plan_hash == tiers[1]._plan.plan_hash
+            assert "-,model" in tiers[0]._plan.classes
+            for t in tiers:
+                assert t.await_peers(20.0)
+
+            def seat(r):
+                l = seats[r]
+                st = l.state
+                for _ in range(3):
+                    st, _, _ = l._learn(
+                        st, *sl.shard_batch((halves[r], isw[:b])))
+                return st
+
+            res = _run_threads([lambda r=r: seat(r) for r in range(2)],
+                               timeout=120.0)
+            p0 = jax.tree.map(np.asarray, res[0].params)
+            p1 = jax.tree.map(np.asarray, res[1].params)
+            jax.tree.map(
+                lambda a, c: np.testing.assert_array_equal(a, c), p0, p1)
+            jax.tree.map(
+                lambda a, c: np.testing.assert_allclose(
+                    a, c, rtol=1e-3, atol=1e-6), p0, union_params)
+            # The sharded class really went owner-scoped, not ring.
+            assert tiers[0].collective.stat("coll_rounds_part") == 3
+            assert tiers[0].collective.stat("coll_bytes_model") > 0
+        finally:
+            for t in tiers:
+                t.close()
+            lt.refresh_coll_flags()
 
 
 class TestLearnerTier:
@@ -558,7 +655,9 @@ class TestLearnerTier:
     def test_attach_contract(self):
         """allreduce needs the split learn step; updates_per_call is
         forced to 1; a learner without `_learn` is rejected; a
-        mesh-sharded learner is refused (different scale-out plane)."""
+        mesh-sharded learner attaches through its ShardedLearner's
+        pjit grads/apply_grads pair — and is refused ONLY when that
+        split seam is missing (the non-replay arity)."""
         addrs = _addrs(2)
         tier = LearnerTier(0, addrs, sync="allreduce", probe_interval_s=60.0)
 
@@ -575,15 +674,28 @@ class TestLearnerTier:
         with pytest.raises(ValueError, match="allreduce"):
             tier.attach(NoSplit())
 
-        class Meshy:
+        class MeshyNoSplit:
             class agent:  # noqa: N801 — stub
                 grads = apply_grads = staticmethod(lambda *a: a)
 
             _learn = staticmethod(lambda *a: a)
-            _sharded = object()  # ShardedLearner marker
+            _sharded = object()  # ShardedLearner WITHOUT grads/apply_grads
 
-        with pytest.raises(ValueError, match="mesh-sharded"):
-            tier.attach(Meshy())
+        with pytest.raises(ValueError, match="ShardedLearner"):
+            tier.attach(MeshyNoSplit())
+
+        class ShardedStub:  # the pjit split seam, as parallel/learner builds it
+            grads = staticmethod(lambda *a: a)
+            apply_grads = staticmethod(lambda *a: a)
+
+        class Meshy:
+            agent = object()  # the tier must NOT fall back to the agent
+            _learn = staticmethod(lambda *a: a)
+            _sharded = ShardedStub()
+
+        m = Meshy()
+        tier.attach(m)  # positive contract: mesh seat attaches
+        assert m._learn is not Meshy._learn  # wrapped
 
         class K8:
             class agent:  # noqa: N801 — stub
