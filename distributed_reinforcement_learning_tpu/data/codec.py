@@ -743,9 +743,14 @@ def check_blob(blob) -> None:
     """Raise ValueError unless the header parses and the payload extent
     fits — WITHOUT decoding. The stamped sequence ingest stores blobs
     for deferred decode (`data/replay_service.LazyBlob`), so poison must
-    fail here on the ingest thread, not at sample time on the learner."""
+    fail here on the ingest thread, not at sample time on the learner.
+
+    cache=True for the same reason ingest's `decode` forces it: every
+    caller is an ingest/promote path that sees one stable schema per
+    run, and an uncached header parse costs ~3x the whole fast-accept
+    it is guarding."""
     view = _skip_ext(memoryview(blob).cast("B"))
-    plan = _layout_plan(view)
+    plan = _layout_plan(view, cache=True)
     if plan.payload_start + plan.payload_nbytes > len(view):
         raise ValueError("truncated codec blob payload")
 

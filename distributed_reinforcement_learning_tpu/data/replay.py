@@ -99,6 +99,15 @@ def _snapshot_items(snap: dict) -> list[Any]:
     return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
 
 
+def priority_transform(errors) -> np.ndarray:
+    """TD error -> sum-tree leaf priority, `(|err| + EPS) ** ALPHA` —
+    the one transform every backend applies on add/update (the tiered
+    store in data/replay_spill.py shares it so its leaf domain matches
+    the all-RAM backends exactly)."""
+    return (np.abs(np.asarray(errors, np.float64))
+            + PrioritizedReplay.EPS) ** PrioritizedReplay.ALPHA
+
+
 class PrioritizedReplay:
     """The reference's `Memory` surface: add / sample / update.
 
@@ -526,12 +535,18 @@ class ArrayPrioritizedReplay:
 
 
 def make_replay(capacity: int, beta: float = 0.4, backend: str = "auto",
-                seed: int = 0):
+                seed: int = 0, spill=None, mode: str = "transition"):
     """Pick the replay implementation: 'python', 'native', 'array', or
     'auto' (= structure-of-arrays over the C++ tree when the native lib
     builds, else the pure-Python Memory). `seed` fixes the backend's
     default sampling stream (callers passing their own rng to sample()
-    are unaffected)."""
+    are unaffected). A non-None `spill` (a `replay_spill.SpillConfig`)
+    overrides `backend` with the tiered hot/cold store — the disk tier
+    is a storage property, orthogonal to the sum-tree implementation."""
+    if spill is not None:
+        from distributed_reinforcement_learning_tpu.data.replay_spill import TieredStore
+
+        return TieredStore(capacity, spill, mode=mode, beta=beta, seed=seed)
     if backend == "python":
         return PrioritizedReplay(capacity, beta, seed=seed)
     if backend == "native":

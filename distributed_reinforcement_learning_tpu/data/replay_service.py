@@ -59,6 +59,7 @@ from typing import Any, Callable
 import numpy as np
 
 from distributed_reinforcement_learning_tpu.data.replay import make_replay
+from distributed_reinforcement_learning_tpu.data.replay_spill import ColdStoreEmpty
 from distributed_reinforcement_learning_tpu.observability import TELEMETRY as _OBS
 
 # -- packed sample indexes ----------------------------------------------------
@@ -182,7 +183,9 @@ class LazyBlob:
 
 
 def _materialize(item):
-    return item.materialize() if isinstance(item, LazyBlob) else item
+    # Duck-typed: LazyBlob here, and the spill tier's cold-segment
+    # snapshot refs (data/replay_spill._SegmentRef) resolve the same way.
+    return item.materialize() if hasattr(item, "materialize") else item
 
 
 # -- one shard ----------------------------------------------------------------
@@ -214,10 +217,15 @@ class ReplayShard:
         "ingested_items": "_lock",
         "updates_applied": "_lock",
     }
+    _NOT_GUARDED = {
+        "tier_kick": "set once by the owning service before any "
+                     "maintenance runs (None on standalone shards); "
+                     "called to wake the router for a pending promote",
+    }
 
     def __init__(self, shard_id: int, capacity: int, mode: str = "transition",
                  scorer: Callable[[Any, bool], np.ndarray] | None = None,
-                 backend: str = "auto", seed: int = 0):
+                 backend: str = "auto", seed: int = 0, spill=None):
         if mode not in ("transition", "sequence"):
             raise ValueError(f"unknown shard mode {mode!r}")
         self.shard_id = shard_id
@@ -226,9 +234,15 @@ class ReplayShard:
         self.scorer = scorer
         self._backend_kind = backend
         self._seed = seed
+        self._spill = spill.for_shard(shard_id) if spill is not None else None
         self._lock = threading.Lock()
+        # Signaled by tier_step() commits; tiered sampling waits on it
+        # (bounded) when a gather draws cold segments still promoting.
+        self._tier_cv = threading.Condition(self._lock)
+        self.tier_kick: Callable[[], None] | None = None
         self.backend = make_replay(capacity, backend=backend,
-                                   seed=seed + 101 * shard_id)
+                                   seed=seed + 101 * shard_id,
+                                   spill=self._spill, mode=mode)
         self.epoch = 0
         self.dead = False
         self._max_error = 1.0  # error-domain running max (transform is monotone)
@@ -371,10 +385,29 @@ class ReplayShard:
                                                            np.ndarray, int]:
         """-> (items_or_stacked, tree_idxs, raw priorities, epoch): this
         shard's slice of a gather. Raw (already-transformed) priorities,
-        NOT IS weights — the service computes those globally."""
+        NOT IS weights — the service computes those globally.
+
+        Tiered backends complete in steps: a draw landing on a cold
+        segment queues it and the gather WAITS (bounded, on `_tier_cv`,
+        which releases the shard lock) for the router/ingest threads to
+        promote — the learn thread itself never touches disk. In steady
+        state the draw-ahead prefetch window means promotes already
+        overlap the previous train step and the wait is a no-op."""
         with self._lock:
-            out = self.backend.sample_with_priorities(n, rng)
-            return (*out, self.epoch)
+            backend = self.backend
+            step = getattr(backend, "sample_step", None)
+            if step is None:
+                out = backend.sample_with_priorities(n, rng)
+                return (*out, self.epoch)
+            deadline = time.monotonic() + self._spill.wait_s
+            while True:
+                out = step(n, rng, force=time.monotonic() >= deadline)
+                if out is not None:
+                    return (*out, self.epoch)
+                kick = self.tier_kick
+                if kick is not None:
+                    kick()  # shard lock -> service _work; never reversed
+                self._tier_cv.wait(timeout=0.05)
 
     # -- update router side ------------------------------------------------
 
@@ -400,10 +433,22 @@ class ReplayShard:
     def restart(self) -> None:
         """Fresh backend under a new epoch: in-flight updates against the
         old contents are dropped by the epoch check, and everything
-        re-ingested starts at max-priority — nothing can be starved."""
+        re-ingested starts at max-priority — nothing can be starved. A
+        tiered backend's spill directory is wiped (`fresh=True`): restart
+        is the post-death clean slate, distinct from process-restart
+        RECOVERY, which reattaches the manifest at construction."""
         with self._lock:
+            old = self.backend
+            if hasattr(old, "close"):
+                old.close()  # in-flight tier jobs no-op their commits
+            spill = self._spill
+            if spill is not None:
+                from dataclasses import replace as _dc_replace
+
+                spill = _dc_replace(spill, fresh=True)
             self.backend = make_replay(self.capacity, backend=self._backend_kind,
-                                       seed=self._seed + 101 * self.shard_id)
+                                       seed=self._seed + 101 * self.shard_id,
+                                       spill=spill, mode=self.mode)
             self.epoch = (self.epoch + 1) & int(_EPOCH_MASK)
             self.dead = False
             self._max_error = 1.0
@@ -431,6 +476,55 @@ class ReplayShard:
             if self.mode == "sequence":
                 self.ingested_blobs += len(items)
             self.ingested_items += len(items)
+
+    # -- tier maintenance (ingest + router threads) ------------------------
+
+    def tier_step(self) -> bool:
+        """Run ONE unit of spill-tier maintenance (promote a sampled-cold
+        segment, spill a cold-mass victim, unlink, or sync the manifest).
+        Plan and commit bracket the shard lock; the file I/O in between
+        holds NO lock — this is the only place replay bytes touch disk,
+        and it rides the ingest/router threads, never the learn thread.
+        Returns True when a job ran (callers loop while True)."""
+        with self._lock:
+            backend = self.backend
+            plan = getattr(backend, "plan_tier_work", None)
+            job = plan() if plan is not None and not self.dead else None
+        if job is None:
+            return False
+        job.run_io()
+        manifest = None
+        events: list[tuple[str, float]] = []
+        with self._lock:
+            if self.backend is backend:  # restart() swapped the store:
+                manifest = backend.commit_tier_work(job)  # stale job's
+                events = backend.take_obs()               # commit no-ops
+                self._tier_cv.notify_all()
+        if manifest is not None:
+            backend.write_manifest(manifest)
+        if events and _OBS.enabled:
+            sid = self.shard_id
+            for name, value in events:
+                if name.endswith(("_bytes",)):
+                    _OBS.count(f"replay_spill/{sid}/{name}", int(value))
+                    _OBS.count(
+                        f"replay_spill/{sid}/"
+                        f"{name.replace('_bytes', '_segments')}", 1)
+                elif name == "promote_wait_ms":
+                    _OBS.gauge(f"replay_spill/{sid}/promote_wait_ms", value)
+                else:
+                    _OBS.count(f"replay_spill/{sid}/{name}", int(value))
+        return True
+
+    def tier_pending(self) -> bool:
+        with self._lock:
+            pending = getattr(self.backend, "tier_pending", None)
+            return pending is not None and pending()
+
+    def tier_stats(self) -> dict | None:
+        with self._lock:
+            stats = getattr(self.backend, "tier_stats", None)
+            return stats() if stats is not None else None
 
 
 def _first_leaf(tree: Any):
@@ -515,12 +609,13 @@ class ShardedReplayService:
     _NOT_GUARDED = {
         "shards": "fixed fan-out list assigned once in __init__ and never "
                   "rebound; each ReplayShard synchronizes itself",
+        "_tiered": "set once in __init__ (spill tier on/off), never rebound",
     }
 
     def __init__(self, num_shards: int, capacity: int,
                  mode: str = "transition", scorer: str = "max",
                  backend: str = "auto", beta: float = 0.4, seed: int = 0,
-                 max_pending_updates: int = 256):
+                 max_pending_updates: int = 256, spill=None):
         if not 1 <= num_shards <= MAX_SHARDS:
             raise ValueError(f"num_shards must be in [1, {MAX_SHARDS}]")
         per_shard = max(1, capacity // num_shards)
@@ -528,9 +623,15 @@ class ShardedReplayService:
         self.scorer_name = scorer or "max"
         self.shards = [
             ReplayShard(i, per_shard, mode=mode, scorer=score_fn,
-                        backend=backend, seed=seed)
+                        backend=backend, seed=seed, spill=spill)
             for i in range(num_shards)
         ]
+        self._tiered = spill is not None
+        if self._tiered:
+            for shard in self.shards:
+                # Tiered gathers that draw cold segments wake the router
+                # immediately instead of riding out its idle tick.
+                shard.tier_kick = self._tier_kick
         self.mode = mode
         self.stacked_samples = bool(
             getattr(self.shards[0].backend, "stacked_samples", False))
@@ -635,13 +736,41 @@ class ShardedReplayService:
         parts: list[Any] = []
         idx_parts: list[np.ndarray] = []
         prio_parts: list[np.ndarray] = []
-        for shard, k in zip(self.shards, alloc):
+        shortfall = 0
+        served: list[tuple[ReplayShard, float]] = []
+        for shard, k, mass in zip(self.shards, alloc, masses):
             if k == 0:
                 continue
-            items, idxs, prios, epoch = shard.sample_with_priorities(int(k), rng)
+            try:
+                items, idxs, prios, epoch = shard.sample_with_priorities(
+                    int(k), rng)
+            except ColdStoreEmpty:
+                # All-cold tiered shard (restart recovery, promotes still
+                # in flight): redistribute its slice below rather than
+                # failing the whole gather.
+                shortfall += int(k)
+                continue
+            served.append((shard, float(mass)))
             parts.append(items)
             idx_parts.append(pack_index(shard.shard_id, epoch, idxs))
             prio_parts.append(prios)
+        if shortfall and served:
+            shard = max(served, key=lambda sm: sm[1])[0]
+            try:
+                items, idxs, prios, epoch = shard.sample_with_priorities(
+                    shortfall, rng)
+            except ColdStoreEmpty:
+                shard = None
+            if shard is not None:
+                shortfall = 0
+                parts.append(items)
+                idx_parts.append(pack_index(shard.shard_id, epoch, idxs))
+                prio_parts.append(prios)
+        if not parts or shortfall:
+            # A short batch would change train-step shapes; a transient
+            # skip is the contract the learners already honor.
+            raise ReplayServiceEmpty(
+                "cold-only tiered shards (promotes in flight)")
         priorities = np.concatenate(prio_parts)
         packed = np.concatenate(idx_parts)
         weights = merge_is_weights(priorities, global_total, global_count, beta)
@@ -681,24 +810,63 @@ class ShardedReplayService:
             self._work.notify()
 
     def _route_loop(self) -> None:
+        tier_busy = False
         while True:
             with self._work:
-                while not self._pending and not self._closed:
+                if not self._pending and not self._closed and not tier_busy:
                     # Bounded wait (drlint blocking-under-lock): the
-                    # predicate is re-checked each wakeup, so a notify
+                    # predicate is re-checked each iteration, so a notify
                     # lost to a close/enqueue race delays the router by
                     # at most one tick instead of parking it forever.
-                    self._work.wait(timeout=0.5)
+                    # Tiered services also ride this tick for spill-tier
+                    # maintenance, so sampling kicks `_work` directly.
+                    self._work.wait(timeout=0.05 if self._tiered else 0.5)
                 if self._closed and not self._pending:
                     return
-                packed, errs = self._pending.popleft()
-                self._applying = True
-            try:
-                self._apply_update(packed, errs)
-            finally:
-                with self._work:
-                    self._applying = False
-                    self._work.notify_all()
+                batch = self._pending.popleft() if self._pending else None
+                if batch is not None:
+                    self._applying = True
+            if batch is not None:
+                try:
+                    self._apply_update(*batch)
+                finally:
+                    with self._work:
+                        self._applying = False
+                        self._work.notify_all()
+            tier_busy = bool(self._tier_tick()) if self._tiered else False
+
+    def _tier_kick(self) -> None:
+        with self._work:
+            self._work.notify()
+
+    def _tier_tick(self) -> int:
+        """Run up to a few spill/promote/manifest jobs per shard (each
+        shard's plan picks its own priority order); returns jobs done so
+        the router skips its idle wait while a backlog remains."""
+        done = 0
+        for shard in self.shards:
+            for _ in range(4):
+                if not shard.tier_step():
+                    break
+                done += 1
+        return done
+
+    def flush_tier(self, timeout: float | None = 10.0) -> bool:
+        """Drive spill-tier maintenance to quiescence on the CALLING
+        thread (tests / benches / checkpoint barriers): safe alongside
+        the router — every job is planned and committed under its
+        shard's lock, so two maintenance threads interleave cleanly."""
+        if not self._tiered:
+            return True
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            busy = self._tier_tick()
+            if not busy and not any(s.tier_pending() for s in self.shards):
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            if not busy:
+                time.sleep(0.005)  # a router-held job is finishing its IO
 
     def _apply_update(self, packed: np.ndarray, errs: np.ndarray) -> None:
         shard_ids, epochs, idxs = unpack_index(packed)
@@ -781,8 +949,20 @@ class ShardedReplayService:
     def shard_stats(self) -> list[dict]:
         return [s.stats() for s in self.shards]
 
+    def tier_stats(self) -> list[dict] | None:
+        """Per-shard spill-tier stats, or None when the tier is off."""
+        if not self._tiered:
+            return None
+        return [s.tier_stats() or {} for s in self.shards]
+
     def close(self) -> None:
         with self._work:
             self._closed = True
             self._work.notify_all()
         self._router.join(timeout=2.0)
+        for shard in self.shards:
+            with shard._lock:
+                backend = shard.backend
+            backend_close = getattr(backend, "close", None)
+            if backend_close is not None:
+                backend_close()

@@ -45,6 +45,9 @@ _VERDICT_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "benchmarks", "replay_verdict.json")
 
+_SPILL_VERDICT_PATH = os.path.join(
+    os.path.dirname(_VERDICT_PATH), "replay_spill_verdict.json")
+
 _DEFAULT_SHARDS = 2  # auto-enabled count when the verdict carries none
 
 
@@ -84,8 +87,46 @@ def shard_count(verdict_path: str = _VERDICT_PATH) -> int:
 _ALGO_MODE = {"apex": "transition", "r2d2": "sequence", "xformer": "sequence"}
 
 
+def spill_auto_enabled(verdict_path: str = _SPILL_VERDICT_PATH) -> bool:
+    """The spill-tier gate: `DRL_REPLAY_SPILL=0` forces off, `=1` forces
+    on; unset defers to the committed `replay_spill_compare` verdict
+    (bench.py): the tier ships enabled-by-default only if the A/B showed
+    >= 4x stored-transitions-per-GB-RAM at sample-throughput parity."""
+    env = os.environ.get("DRL_REPLAY_SPILL", "").strip()
+    if env:
+        return env != "0"
+    try:
+        with open(verdict_path) as f:
+            return bool(json.load(f).get("auto_enable", False))
+    except (OSError, ValueError):
+        return False
+
+
+def spill_config(spill_dir: str | None = None):
+    """-> a `SpillConfig` from the DRL_REPLAY_SPILL* knobs (None when
+    the gate resolves off). The directory prefers, in order: the
+    `DRL_REPLAY_SPILL_DIR` override, the caller's `spill_dir` (run_role
+    passes a checkpoint-dir sibling so a learner RESTART finds and
+    recovers the manifested segments), and a fresh tempdir (no recovery
+    across restarts, but the tier still works)."""
+    if not spill_auto_enabled():
+        return None
+    from distributed_reinforcement_learning_tpu.data.replay_spill import SpillConfig
+
+    directory = os.environ.get("DRL_REPLAY_SPILL_DIR", "").strip() or spill_dir
+    if not directory:
+        import tempfile
+
+        directory = tempfile.mkdtemp(prefix="drl_replay_spill_")
+    hot_mb = float(os.environ.get("DRL_REPLAY_SPILL_HOT_MB", "") or 256.0)
+    seg = int(os.environ.get("DRL_REPLAY_SPILL_SEG", "") or 512)
+    return SpillConfig(directory=directory,
+                       hot_bytes=int(hot_mb * 1024 * 1024),
+                       seg_items=max(1, seg))
+
+
 def build_service(algo: str, rt, num_shards: int | None = None,
-                  seed: int = 0):
+                  seed: int = 0, spill_dir: str | None = None):
     """-> a `ShardedReplayService` for a prioritized-replay learner
     process, or None when sharding is off / the algo has no replay.
 
@@ -104,7 +145,8 @@ def build_service(algo: str, rt, num_shards: int | None = None,
 
     scorer = os.environ.get("DRL_REPLAY_SCORER", "max").strip() or "max"
     return ShardedReplayService(n, rt.replay_capacity, mode=mode,
-                                scorer=scorer, seed=seed)
+                                scorer=scorer, seed=seed,
+                                spill=spill_config(spill_dir))
 
 
 class ReplayIngestFifo:
@@ -304,6 +346,10 @@ class ReplayIngestFifo:
                     _OBS.count("admission/ingest_stamped")
                     if folded:
                         _OBS.count("admission/folded_mass", folded)
+                # Spill-tier maintenance rides the thread that already
+                # did the insert (no-op for untiered shards): the learn
+                # thread never touches disk.
+                shard.tier_step()
                 return True
         try:
             # decode(cache=True): shard ingest sees one stable schema
@@ -331,6 +377,7 @@ class ReplayIngestFifo:
             _OBS.count("replay_shard/ingested_items", n)
             _OBS.count("replay_shard/ingested_blobs")
             _OBS.count("admission/ingest_scored")
+        shard.tier_step()  # spill-tier maintenance on the insert thread
         return True
 
     def _usable_stamp(self, stamp: dict, shard) -> dict | None:
@@ -395,7 +442,7 @@ class ReplayIngestFifo:
 def register_telemetry(service) -> None:
     """Per-shard fill / priority-mass / counter providers (polled from
     the telemetry flush thread; obs_report renders them as the 'Replay
-    shards' section)."""
+    shards' section, plus 'Tiered replay' when the spill tier is on)."""
     for i, shard in enumerate(service.shards):
         _OBS.sample(f"replay_shard/{i}/fill",
                     lambda s=shard: s.stats()["fill"])
@@ -407,3 +454,18 @@ def register_telemetry(service) -> None:
         _OBS.sample(f"replay_shard/{i}/updates_applied",
                     lambda s=shard: s.stats()["updates_applied"],
                     kind="counter")
+        if shard.tier_stats() is None:
+            continue
+
+        def _tier(s=shard, key=""):
+            st = s.tier_stats()
+            return float(st.get(key, 0)) if st else 0.0
+
+        for key in ("hot_items", "cold_items", "hot_bytes", "disk_bytes",
+                    "ram_bytes", "queue_depth"):
+            _OBS.sample(f"replay_spill/{i}/{key}",
+                        lambda s=shard, k=key: _tier(s, k))
+        for key in ("spilled_segments", "promoted_segments", "crc_dropped",
+                    "forced_pads"):
+            _OBS.sample(f"replay_spill/{i}/{key}_total",
+                        lambda s=shard, k=key: _tier(s, k), kind="counter")

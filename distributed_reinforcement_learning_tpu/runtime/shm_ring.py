@@ -84,6 +84,10 @@ from distributed_reinforcement_learning_tpu.runtime.transport import _LockedStat
 _MAGIC = 0x52494E47  # "RING"
 _VERSION = 1
 _PID_OFF = 24  # creator pid u64 — shared with the weight-board layouts
+_PRESSURE_OFF = 32  # learner admission pressure, u32 permille (consumer
+#   writes, producer reads): ring PUTs have no reply payload, so the
+#   live backpressure signal TCP actors get on every PUT reply
+#   (runtime/transport.py) rides the shared header instead.
 _HEAD_OFF = 64
 _TAIL_OFF = 128
 _PCLOSED_OFF = 192
@@ -252,6 +256,7 @@ class ShmRing:
         ring._write_u64(_TAIL_OFF, 0)
         ring._write_u32(_PCLOSED_OFF, 0)
         ring._write_u32(_CCLOSED_OFF, 0)
+        ring._write_u32(_PRESSURE_OFF, 0)
         ring._write_u32(4, _VERSION)
         ring._write_u32(0, _MAGIC)
         return ring
@@ -300,6 +305,18 @@ class ShmRing:
     @property
     def consumer_closed(self) -> bool:
         return self._read_u32(_CCLOSED_OFF) != 0
+
+    def set_pressure(self, permille: int) -> None:
+        """Consumer-side: publish the learner's live ingest pressure
+        (0..1000 permille) into the shared header — the ring's
+        equivalent of the u16 the TCP server appends to PUT replies.
+        Single writer (the drain thread), word-sized: tearing-free."""
+        self._write_u32(_PRESSURE_OFF, max(0, min(1000, int(permille))))
+
+    def pressure(self) -> int:
+        """Producer-side: the last pressure permille the consumer
+        published (0 until it ever does)."""
+        return int(self._read_u32(_PRESSURE_OFF))
 
     def used_bytes(self) -> int:
         """Bytes in flight (includes framing/padding) — the `ring/depth`
@@ -584,10 +601,24 @@ class RingDrainer(_LockedStatsMixin):
         return self
 
     def _drain_loop(self, ring: ShmRing) -> None:
+        import time as _time
+
         from distributed_reinforcement_learning_tpu.data.fifo import blob_ingest
 
         prepare, put = blob_ingest(self.queue)
+        # Backpressure parity with TCP actors: the sharded-ingest facade
+        # exposes the learner's live pressure permille (the value the
+        # TCP server appends to PUT replies); publish it through the
+        # ring header so co-hosted producers run the SAME admission
+        # ladder. Throttled — a header word per ~100ms, not per blob.
+        pressure = getattr(self.queue, "ingest_pressure", None)
+        last_pub = 0.0
         while not self._stop.is_set():
+            if pressure is not None:
+                now = _time.monotonic()
+                if now - last_pub >= 0.1:
+                    last_pub = now
+                    ring.set_pressure(pressure())
             try:
                 blob = ring.get_blob(timeout=0.2)
             except RingClosed as e:  # corrupt record: drop the ring, the
@@ -711,9 +742,12 @@ class RingQueue(_LockedStatsMixin, ShmReattachMixin):
     def set_admission(self, controller) -> None:
         """Attach an actor-side admission controller
         (data/admission.AdmissionController): ring PUTs score + stamp
-        each unroll. The ring has no reply channel, so pressure only
-        moves via the `DRL_ADMISSION_PRESSURE` override here; the
-        demote-to-TCP path falls back to plain (learner-scored) PUTs."""
+        each unroll, and each PUT feeds the controller the learner's
+        live pressure permille from the ring header's pressure word
+        (published by the drain thread) — the same admission ladder TCP
+        actors drive from PUT-reply pressure. `DRL_ADMISSION_PRESSURE`
+        still overrides both; the demote-to-TCP path falls back to
+        plain (learner-scored) PUTs."""
         self._admission = controller
 
     @property
@@ -791,6 +825,10 @@ class RingQueue(_LockedStatsMixin, ShmReattachMixin):
         ring = self._ring_ref()
         if ring is None:
             return self._client.put_trajectory(item)
+        if self._admission is not None:
+            # Header pressure word -> admission ladder (the ring-path
+            # mirror of the TCP client's PUT-reply observe_pressure).
+            self._admission.observe_pressure(ring.pressure())
         try:
             # Same dedup gating as the TCP client's trajectory PUTs: the
             # drainer's blob_ingest reconstructs before the queue.
@@ -811,6 +849,8 @@ class RingQueue(_LockedStatsMixin, ShmReattachMixin):
         ring = self._ring_ref()
         if ring is None:
             return self._client.put_trajectories(items)
+        if self._admission is not None:
+            self._admission.observe_pressure(ring.pressure())
         sent = 0
         for item in items:
             try:

@@ -1945,7 +1945,14 @@ def run_role(
         # learner keeps draining it — normally idle).
         from distributed_reinforcement_learning_tpu.runtime import replay_shard
 
-        replay_service = replay_shard.build_service(algo, rt, seed=seed)
+        # The spill tier anchors its segment manifests next to the
+        # checkpoints (when checkpointing is on): a restarted learner
+        # recovers the spilled experience from the same durable root it
+        # resumes weights from.
+        spill_dir = (os.path.join(checkpoint_dir, "replay_spill")
+                     if checkpoint_dir else None)
+        replay_service = replay_shard.build_service(algo, rt, seed=seed,
+                                                    spill_dir=spill_dir)
         ingest_queue: Any = queue
         if replay_service is not None:
             ingest_queue = replay_shard.ReplayIngestFifo(replay_service, queue)

@@ -4,7 +4,7 @@
 # checks + the leak census — a leaked thread/segment/socket in the
 # smoke suite is a finding and fails here), and the tier-1 pointer.
 # Fast by design — the full gates (whole-tree lint, scripts/sanitize.sh
-# over all thirteen suites, tier-1) stay with CI.
+# over all fourteen suites, tier-1) stay with CI.
 #
 #   scripts/check.sh             # lint vs HEAD + sanitize smoke
 #   scripts/check.sh BASE        # lint vs another git base ref
@@ -36,4 +36,4 @@ rm -f "$ART"
 echo "== tier-1 =="
 echo "not run here (minutes); the gate is:"
 echo "  JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'"
-echo "full sanitizer pass: scripts/sanitize.sh (thirteen suites + reconcile)"
+echo "full sanitizer pass: scripts/sanitize.sh (fourteen suites + reconcile)"

@@ -48,10 +48,12 @@ from distributed_reinforcement_learning_tpu.observability.trace import load_trac
 
 _SPARK = " .:-=+*#%@"
 
-# Gauges whose per-flush mean SERIES the report renders (sparklines);
-# every other gauge folds into a constant-size running aggregate.
+# Gauges whose per-flush mean SERIES the report renders (sparklines or
+# percentiles); every other gauge folds into a constant-size running
+# aggregate. Suffixes cover per-shard-id names (replay_spill/<sid>/...).
 _SERIES_GAUGES = ("transport/queue_depth", "ring/depth",
                   "tier/coll_round_ms")
+_SERIES_SUFFIXES = ("/promote_wait_ms",)
 # Gauges needing the fallback per-window histogram (pre-exact-counter
 # shards): per-record (mean, n) folds straight into bucket counts.
 _STALE_GAUGE = "learner/weight_staleness"
@@ -130,7 +132,7 @@ class ShardAgg:
             if agg is None:
                 agg = self.gauges[name] = GaugeAgg()
             agg.add(record)
-            if name in _SERIES_GAUGES:
+            if name in _SERIES_GAUGES or name.endswith(_SERIES_SUFFIXES):
                 self.series.setdefault(name, []).append(record["mean"])
             if name == _STALE_GAUGE:
                 value = record["mean"]
@@ -293,9 +295,9 @@ def build_report(tdir: str, merge: bool = True) -> str:
     for shard in shards:
         for name, stats in sorted(shard.counter_rates().items()):
             if name.startswith(("staleness_bucket/", "codec/", "board/",
-                                "replay_shard/", "inference/",
-                                "remote_act/", "wshard/", "weights/",
-                                "fleet/", "pipe/", "devpath/",
+                                "replay_shard/", "replay_spill/",
+                                "inference/", "remote_act/", "wshard/",
+                                "weights/", "fleet/", "pipe/", "devpath/",
                                 "admission/")):
                 continue  # rendered as their own sections below
             any_counter = True
@@ -473,6 +475,68 @@ def build_report(tdir: str, merge: bool = True) -> str:
         out("")
         out("-- Replay shards (ingest-time prioritization) --")
         lines.extend(shard_lines)
+
+    # Tiered replay spill (data/replay_spill.py): per-shard hot/cold
+    # fill, RAM vs on-disk footprint, spill/promote traffic, and the
+    # promote-wait latency parked cold draws paid before the pump
+    # delivered their segment. Section only appears when a run had the
+    # spill tier on (DRL_REPLAY_SPILL / committed verdict).
+    spill_lines: list[str] = []
+    for shard in shards:
+        per = sorted(
+            n.split("/")[1] for n in shard.gauges
+            if n.startswith("replay_spill/") and n.endswith("/hot_items"))
+        rates = shard.counter_rates()
+        for sid in per:
+
+            def last(key, sid=sid, shard=shard):
+                stats = shard.gauge_stats(f"replay_spill/{sid}/{key}")
+                return stats["last"] if stats is not None else 0.0
+
+            def total(key, sid=sid, rates=rates):
+                # The sampled cumulative tally (`*_total`, survives a
+                # flush-thread gap) wins; the event-driven counter of
+                # the same stem is the pre-sampling fallback.
+                entry = (rates.get(f"replay_spill/{sid}/{key}_total")
+                         or rates.get(f"replay_spill/{sid}/{key}") or {})
+                return entry.get("total", 0)
+
+            hot, cold = last("hot_items"), last("cold_items")
+            spill_lines.append(
+                f"  {shard_label(shard)} shard {sid}: hot {hot:.0f} / "
+                f"cold {cold:.0f} items "
+                f"({100 * hot / max(hot + cold, 1):.0f}% resident)  "
+                f"ram {last('ram_bytes') / 2**20:.1f} MB  "
+                f"disk {last('disk_bytes') / 2**30:.2f} GB  "
+                f"tier queue {last('queue_depth'):.0f}")
+            sp = rates.get(f"replay_spill/{sid}/spilled_bytes", {})
+            pr = rates.get(f"replay_spill/{sid}/promoted_bytes", {})
+            spill_lines.append(
+                f"    spilled {total('spilled_segments'):.0f} segments "
+                f"({sp.get('total', 0) / 2**20:.1f} MB, "
+                f"{sp.get('rate', 0) / 2**20:.2f} MB/s)  "
+                f"promoted {total('promoted_segments'):.0f} "
+                f"({pr.get('total', 0) / 2**20:.1f} MB, "
+                f"{pr.get('rate', 0) / 2**20:.2f} MB/s)  "
+                f"crc-dropped {total('crc_dropped'):.0f}  "
+                f"forced pads {total('forced_pads'):.0f}")
+            series = shard.series.get(
+                f"replay_spill/{sid}/promote_wait_ms", [])
+            wait = shard.gauge_stats(f"replay_spill/{sid}/promote_wait_ms")
+            if wait is not None:
+                pct = ""
+                if series:
+                    import numpy as _np
+
+                    pct = (f"p50 {_np.percentile(series, 50):.2f}ms  "
+                           f"p99 {_np.percentile(series, 99):.2f}ms  ")
+                spill_lines.append(
+                    f"    promote wait {pct}max {wait['max']:.2f}ms  "
+                    f"({wait['n']} promotes)")
+    if spill_lines:
+        out("")
+        out("-- Tiered replay (hot/cold spill) --")
+        lines.extend(spill_lines)
 
     # Sample-at-source admission (data/admission.py): actor-side stamp/
     # subsample/drop ladder + the learner-side fast-accept split. Bytes

@@ -1,12 +1,12 @@
 #!/usr/bin/env bash
 # Runtime concurrency sanitizer driver (docs/static_analysis.md
-# "Runtime sanitizer"). Runs the thirteen concurrency suites under
+# "Runtime sanitizer"). Runs the fourteen concurrency suites under
 # DRL_SANITIZE=1 so every package lock/_GUARDED_BY attr/blocking call
 # is checked live — and, via the leak census, every thread/shm
 # segment/socket the runtime acquires is tracked to its release — then
 # reconciles the JSONL artifact against the static models:
 #
-#   scripts/sanitize.sh              # thirteen suites + reconcile
+#   scripts/sanitize.sh              # fourteen suites + reconcile
 #   scripts/sanitize.sh OUT_DIR      # keep the artifact in OUT_DIR
 #
 # Exit nonzero when any suite fails, any runtime finding was recorded
@@ -40,6 +40,7 @@ SUITES=(
   tests/test_device_path.py
   tests/test_admission.py
   tests/test_collective_partition.py
+  tests/test_replay_spill.py
 )
 
 env JAX_PLATFORMS=cpu DRL_SANITIZE=1 DRL_SANITIZE_OUT="$ART" \

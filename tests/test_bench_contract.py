@@ -44,6 +44,7 @@ _TRIMMED = {
     "BENCH_TRANSPORT": "0", "BENCH_CODEC": "0", "BENCH_WEIGHTS": "0",
     "BENCH_WEIGHTS_SHARD": "0", "BENCH_REPLAY": "0", "BENCH_INFER": "0",
     "BENCH_CHAOS": "0", "BENCH_ACTOR": "0",
+    "BENCH_ADMISSION": "0", "BENCH_REPLAY_SPILL": "0",
     "BENCH_LEARNER": "0", "BENCH_SEAT_DRILL": "0",
     "BENCH_DEVICE_PATH": "0", "BENCH_COLLECTIVE": "0",
 }
@@ -358,6 +359,70 @@ class TestReplayCompare:
         assert shard_count() == 0
 
 
+class TestReplaySpillCompare:
+    """bench_replay_spill_compare: the in-process all-RAM vs hot/cold
+    tiered-store A/B whose verdict gates data/replay_spill's
+    auto-enable (runtime/replay_shard.spill_auto_enabled). Driven
+    directly at a tiny spill-forcing config — the committed
+    adjudication numbers live in benchmarks/replay_spill_verdict.json."""
+
+    def test_section_shape_and_verdict(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        bench = _load_bench()
+        r = bench.bench_replay_spill_compare(budget_mb=0.25,
+                                             capacity_mult=4, obs_dim=32,
+                                             seg_items=64, batch=16,
+                                             rounds=30, reps=1)
+        for side in ("all_ram", "tiered"):
+            assert r[side]["stored"] > 0, r
+            assert r[side]["transitions_per_gb"] > 0
+            assert r[side]["sample_tr_per_s"] > 0
+        # The hot budget really forced segments to disk — a spill-free
+        # run would adjudicate nothing (the section asserts this too).
+        tiered = r["tiered"]
+        assert tiered["spilled_segments"] > 0
+        assert tiered["disk_mb"] > 0
+        assert tiered["stored"] > r["all_ram"]["stored"]  # the point
+        # Delivery honesty: no draw was ever padded with a wrong item
+        # and no segment was lost to corruption.
+        assert tiered["forced_pads"] == 0 and tiered["crc_dropped"] == 0
+        assert r["density_ratio"] > 0 and r["sample_parity"] > 0
+        assert r["auto_enable"] == (r["density_ratio"] >= 4.0
+                                    and r["sample_parity"] >= 0.9)
+        assert r["verdict"].startswith("tiered replay ") and (
+            "auto-on" in r["verdict"] or "opt-in" in r["verdict"])
+
+    def test_compact_line_carries_spill_verdict_key(self):
+        bench = _load_bench()
+        assert "replay_spill_verdict" in bench._COMPACT_KEYS
+        # The trimmed env the failure-mode subprocess tests run under
+        # must gate this (disk-churning, timed) section off.
+        assert _TRIMMED["BENCH_REPLAY_SPILL"] == "0"
+
+    def test_committed_verdict_file_consistent(self, monkeypatch):
+        """The committed adjudication parses, meets the issue's density
+        bar when auto-on, and spill_auto_enabled() follows it when
+        DRL_REPLAY_SPILL is unset (env force > committed verdict >
+        off)."""
+        monkeypatch.delenv("DRL_REPLAY_SPILL", raising=False)
+        path = REPO / "benchmarks" / "replay_spill_verdict.json"
+        verdict = json.loads(path.read_text())
+        assert isinstance(verdict["auto_enable"], bool)
+        assert verdict["ratio_runs"] and verdict["bar"] == 4.0
+        assert verdict["parity_runs"] and verdict["parity_bar"] == 0.9
+        if verdict["auto_enable"]:
+            assert verdict["ratio_median"] >= 4.0
+            assert verdict["parity_median"] >= 0.9
+        from distributed_reinforcement_learning_tpu.runtime.replay_shard import (
+            spill_auto_enabled)
+
+        assert spill_auto_enabled(str(path)) is verdict["auto_enable"]
+        monkeypatch.setenv("DRL_REPLAY_SPILL", "1")
+        assert spill_auto_enabled(str(path))
+        monkeypatch.setenv("DRL_REPLAY_SPILL", "0")
+        assert not spill_auto_enabled(str(path))
+
+
 class TestAdmissionCompare:
     """bench_admission_compare: the two-process scored-vs-stamped
     sample-at-source A/B whose verdict gates data/admission's
@@ -404,6 +469,11 @@ class TestAdmissionCompare:
         assert isinstance(verdict["actor_priority_auto_enable"], bool)
         assert isinstance(verdict["admission_auto_enable"], bool)
         assert verdict["ratio_runs"] and verdict["bar"] == 1.2
+        # The sequence-mode (R2D2) re-adjudication the original
+        # verdict's honest-negative note called for is recorded.
+        rerun = verdict["rerun_sequence_mode"]
+        assert isinstance(rerun["auto_enable"], bool)
+        assert rerun["ratio_runs"] and rerun["bar"] == 1.2
         from distributed_reinforcement_learning_tpu.data import admission
 
         admission.refresh_flags()

@@ -672,6 +672,10 @@ class TestCliAndTreeGate:
             "data/fifo.py": 1,
             "data/replay.py": 3,         # Native/Array backends + doc note
             "data/replay_service.py": 2,  # ReplayShard + ShardedReplayService
+            "data/replay_spill.py": 1,   # TieredStore (doc form: externally
+            #                              synchronized under the owning
+            #                              ReplayShard._lock; the manifest
+            #                              write cursor under _io_lock)
             "runtime/replay_shard.py": 1,  # ReplayIngestFifo
             "data/device_path.py": 1,    # DeviceSamplePath (doc form:
             #                              SPSC queue + atomic cfg swap)

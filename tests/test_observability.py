@@ -390,6 +390,47 @@ def test_obs_report_merges_two_role_run_dir(tmp_path):
     assert len({e["pid"] for e in spans}) == 2
 
 
+def test_obs_report_tiered_replay_section(tmp_path):
+    """The 'Tiered replay' section renders the spill tier's gauges and
+    counters (hot/cold fill, ram/disk footprint, spill/promote traffic,
+    promote-wait percentiles) and keeps the raw replay_spill/ counters
+    out of the generic Throughput section."""
+    tdir = tmp_path / "telemetry"
+    learner = Telemetry()
+    learner.configure(str(tdir), "learner", rank=0, flush_interval=0)
+    for wait in (1.0, 2.0, 40.0):
+        learner.gauge("replay_spill/0/hot_items", 1000.0)
+        learner.gauge("replay_spill/0/cold_items", 7000.0)
+        learner.gauge("replay_spill/0/ram_bytes", 2.0 * 2**20)
+        learner.gauge("replay_spill/0/disk_bytes", 3.0 * 2**30)
+        learner.gauge("replay_spill/0/queue_depth", 2.0)
+        learner.gauge("replay_spill/0/promote_wait_ms", wait)
+        learner.flush()
+    learner.count("replay_spill/0/spilled_segments_total", 83)
+    learner.count("replay_spill/0/promoted_segments_total", 28)
+    learner.count("replay_spill/0/spilled_bytes", 21 * 2**20)
+    learner.count("replay_spill/0/promoted_bytes", 7 * 2**20)
+    learner.count("replay_spill/0/crc_dropped_total", 0)
+    learner.count("replay_spill/0/forced_pads_total", 0)
+    learner.close()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "obs_report.py"),
+         str(tmp_path), "--no-merge"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-800:]
+    report = proc.stdout
+    assert "Tiered replay (hot/cold spill)" in report
+    assert "hot 1000 / cold 7000 items (12% resident)" in report
+    assert "ram 2.0 MB" in report and "disk 3.00 GB" in report
+    assert "spilled 83 segments (21.0 MB" in report
+    assert "promoted 28 (7.0 MB" in report
+    assert "promote wait p50 2.00ms" in report  # series percentiles
+    assert "p99 " in report and "max 40.00ms" in report
+    # Raw counter names stay out of the generic Throughput section.
+    assert "replay_spill/0/spilled_bytes" not in report
+
+
 def test_obs_report_no_merge_flag(tmp_path):
     run_dir = _synthetic_run_dir(tmp_path)
     proc = subprocess.run(

@@ -342,3 +342,76 @@ class TestRingQueueBackpressure:
         finally:
             rq.close()
             ring.unlink()
+
+
+class TestRingPressureWord:
+    """Backpressure parity with TCP actors (PR 20): the learner's live
+    ingest pressure permille rides a word in the shared ring header, so
+    co-hosted ring producers run the SAME admission ladder TCP actors
+    drive from PUT-reply pressure."""
+
+    def test_header_word_round_trip_and_clamp(self, ring):
+        assert ring.pressure() == 0  # fresh ring publishes idle
+        ring.set_pressure(437)
+        assert ring.pressure() == 437
+        ring.set_pressure(5000)
+        assert ring.pressure() == 1000  # clamped to permille
+        ring.set_pressure(-3)
+        assert ring.pressure() == 0
+
+    def test_drainer_publishes_queue_pressure(self):
+        """The drain thread publishes the queue facade's
+        `ingest_pressure()` (the value the TCP server appends to PUT
+        replies) into the ring header, throttled — producers read it on
+        their next PUT."""
+
+        class PressureQueue(TrajectoryQueue):
+            def ingest_pressure(self):
+                return 612
+
+        ring = ShmRing.create(f"drltest-pw-{os.getpid()}", 8192)
+        drainer = RingDrainer([ring], PressureQueue(capacity=4)).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while ring.pressure() != 612 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ring.pressure() == 612
+        finally:
+            drainer.stop()
+
+    def test_ring_queue_feeds_pressure_to_admission(self):
+        """Producer side: each PUT reads the header word into the
+        attached admission controller — the ring-path mirror of the TCP
+        client's PUT-reply observe_pressure."""
+
+        class _Recorder:
+            def __init__(self):
+                self.seen = []
+
+            def observe_pressure(self, permille):
+                self.seen.append(int(permille))
+
+            def admit(self, item):  # score+stamp path exercised via
+                from distributed_reinforcement_learning_tpu.data.admission import (  # noqa: E501
+                    Decision)
+                return Decision(send=True, tree=None,
+                                stamp={"scorer": "max", "mode": "transition",
+                                       "pri": [1.0], "t": 1})
+
+            def note_wire(self, nbytes, decision):
+                pass
+
+        ring = ShmRing.create(f"drltest-adm-{os.getpid()}", 65536)
+        rq = RingQueue(ring, _FakeClient())
+        rec = _Recorder()
+        rq.set_admission(rec)
+        ring.set_pressure(333)
+        try:
+            assert rq.put({"x": np.zeros(8, np.float32)}) is True
+            assert rec.seen == [333]
+            ring.set_pressure(901)
+            assert rq.put_many([{"x": np.zeros(8, np.float32)}] * 2) == 2
+            assert rec.seen == [333, 901]
+        finally:
+            rq.close()
+            ring.unlink()

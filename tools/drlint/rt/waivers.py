@@ -99,6 +99,10 @@ EDGE_WAIVERS: dict[tuple[tuple[str, str], tuple[str, str]], str] = {
         "lock is a leaf — its methods make no outward calls",
     (("ReplayShard", "_lock"), ("NativePrioritizedReplay", "_lock")):
         "same layered shard->backend edge with the native backend",
+    (("ReplayShard", "_lock"), ("TieredStore", "_io_lock")):
+        "restart() closes the old factory-returned tiered backend under "
+        "the shard lock; _io_lock is a leaf (manifest write cursor + "
+        "closed flag, no outward calls), so the edge cannot cycle",
     (("ReplayShard", "_lock"),
      ("distributed_reinforcement_learning_tpu/data/native.py", "_lib_lock")):
         "backend probe compiles the cpp lib exactly once under the "
